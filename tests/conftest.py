@@ -1,0 +1,95 @@
+"""Test configuration: simulated 8-device CPU mesh, array-type parameterization.
+
+Mirrors the reference CI shape (SURVEY.md §4): the whole suite runs in one
+process on fake XLA devices; the same tests re-run on real TPU by unsetting
+JAX_PLATFORMS. ArrayType parameterization follows test_allreduce.jl:4-9
+(Array vs CuArray) — here numpy vs device-resident jax (DeviceBuffer).
+"""
+
+import os
+import sys
+
+# The CPU-sim test substrate needs JAX on 8 fake CPU devices, with the axon
+# TPU PJRT plugin (registered at interpreter start when PALLAS_AXON_POOL_IPS
+# is set) neutralized: its presence makes CPU-only backend init hang on the
+# TPU tunnel. This must run before any JAX *backend* is created (the plugin
+# may already be imported — that's fine).
+if "TPU_MPI_TEST_REAL_TPU" not in os.environ:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    import jax._src.xla_bridge as _xb
+    jax.config.update("jax_platforms", "cpu")
+    _xb._backend_factories.pop("axon", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+import tpu_mpi
+from tpu_mpi.buffers import DeviceBuffer
+
+
+class NumpyFactory:
+    """ArrayType=Array analog."""
+    name = "numpy"
+
+    @staticmethod
+    def array(data, dtype=None):
+        return np.array(data, dtype=dtype)
+
+    @staticmethod
+    def empty(shape, dtype=np.float64):
+        return np.empty(shape, dtype=dtype)
+
+    @staticmethod
+    def zeros(shape, dtype=np.float64):
+        return np.zeros(shape, dtype=dtype)
+
+    @staticmethod
+    def full(shape, val, dtype=None):
+        return np.full(shape, val, dtype=dtype)
+
+
+class DeviceFactory:
+    """ArrayType=CuArray analog: device-resident jax arrays in mutable cells."""
+    name = "device"
+
+    @staticmethod
+    def array(data, dtype=None):
+        return DeviceBuffer(np.array(data, dtype=dtype))
+
+    @staticmethod
+    def empty(shape, dtype=np.float64):
+        return DeviceBuffer(np.zeros(shape, dtype=dtype))
+
+    @staticmethod
+    def zeros(shape, dtype=np.float64):
+        return DeviceBuffer(np.zeros(shape, dtype=dtype))
+
+    @staticmethod
+    def full(shape, val, dtype=None):
+        return DeviceBuffer(np.full(shape, val, dtype=dtype))
+
+
+_param = os.environ.get("TPU_MPI_TEST_ARRAYTYPE", "")
+if _param == "device":
+    _FACTORIES = [DeviceFactory]
+elif _param == "numpy":
+    _FACTORIES = [NumpyFactory]
+else:
+    _FACTORIES = [NumpyFactory, DeviceFactory]
+
+
+@pytest.fixture(params=_FACTORIES, ids=[f.name for f in _FACTORIES])
+def AT(request):
+    """Array-type factory fixture (the JULIA_MPI_TEST_ARRAYTYPE switch)."""
+    return request.param
+
+
+@pytest.fixture
+def nprocs():
+    return int(os.environ.get("TPU_MPI_TEST_NPROCS", tpu_mpi.testing.DEFAULT_NPROCS))

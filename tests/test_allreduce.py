@@ -1,0 +1,94 @@
+"""Allreduce tests (reference: test/test_allreduce.jl)."""
+
+import numpy as np
+import pytest
+
+import tpu_mpi as MPI
+from tpu_mpi.testing import aeq, run_spmd
+
+OPERATORS = [MPI.SUM, lambda x, y: 2 * x + y - x]
+
+
+def test_allreduce_variants(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        comm_size = MPI.Comm_size(comm)
+        for dims in (1, 2, 3):
+            shape = (3,) * dims
+            base = np.arange(1, 3 ** dims + 1, dtype=np.int64).reshape(shape)
+            send_arr = AT.array(base)
+            for op in OPERATORS:
+                # Non-allocating
+                recv_arr = AT.empty(shape, dtype=np.int64)
+                MPI.Allreduce(send_arr, recv_arr, op, comm)
+                assert aeq(recv_arr, comm_size * base)
+
+                # Too-small output buffer raises (test_allreduce.jl:37-40)
+                small = AT.empty(tuple(s - 1 for s in shape), dtype=np.int64)
+                with pytest.raises(AssertionError):
+                    MPI.Allreduce(send_arr, small, base.size, op, comm)
+
+                # IN_PLACE (test_allreduce.jl:41-44)
+                recv_arr = AT.array(base)
+                MPI.Allreduce(MPI.IN_PLACE, recv_arr, op, comm)
+                assert aeq(recv_arr, comm_size * base)
+
+                # Allocating scalar (test_allreduce.jl:47-48)
+                val = MPI.Allreduce(2, op, comm)
+                assert val == comm_size * 2
+
+                # Allocating array (test_allreduce.jl:50-52)
+                vals = MPI.Allreduce(send_arr, op, comm)
+                assert type(vals) is type(send_arr)
+                assert aeq(vals, comm_size * base)
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs)
+
+
+def test_allreduce_builtin_ops(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        size = MPI.Comm_size(comm)
+        arr = AT.array(np.full(4, rank + 1, dtype=np.int64))
+        assert aeq(MPI.Allreduce(arr, MPI.MAX, comm), np.full(4, size))
+        assert aeq(MPI.Allreduce(arr, MPI.MIN, comm), np.full(4, 1))
+        assert MPI.Allreduce(rank + 1, MPI.PROD, comm) == int(np.prod(np.arange(1, size + 1)))
+        import operator
+        assert MPI.Allreduce(1, operator.add, comm) == size  # + -> SUM dispatch
+        assert aeq(MPI.Allreduce(arr, min, comm), np.full(4, 1))  # min -> MIN
+
+    run_spmd(body, nprocs)
+
+
+def test_allreduce_float_dtypes(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        size = MPI.Comm_size(comm)
+        for dtype in (np.float32, np.float64, np.int32, np.uint16, np.complex64):
+            base = np.arange(1, 9).astype(dtype)
+            out = MPI.Allreduce(AT.array(base), MPI.SUM, comm)
+            assert aeq(out, size * base)
+
+    run_spmd(body, nprocs)
+
+
+def test_allreduce_logical_bitwise(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        size = MPI.Comm_size(comm)
+        flags = np.array([1, rank == 0, 0], dtype=np.int32)
+        land = MPI.Allreduce(flags, MPI.LAND, comm)
+        assert aeq(land, [1, 1 if size == 1 else 0, 0])
+        lor = MPI.Allreduce(flags, MPI.LOR, comm)
+        assert aeq(lor, [1, 1, 0])
+        bits = np.array([1 << (rank % 8)], dtype=np.uint8)
+        bor = MPI.Allreduce(bits, MPI.BOR, comm)
+        expected = 0
+        for r in range(size):
+            expected |= 1 << (r % 8)
+        assert aeq(bor, [expected])
+
+    run_spmd(body, nprocs)

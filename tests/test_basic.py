@@ -1,0 +1,92 @@
+"""Environment lifecycle tests (reference: test/test_basic.jl)."""
+
+import pytest
+
+import tpu_mpi as MPI
+from tpu_mpi.testing import run_spmd
+
+
+def test_init_finalize_lifecycle(nprocs):
+    def body():
+        assert MPI.Initialized()
+        assert not MPI.Finalized()
+        comm = MPI.COMM_WORLD
+        size = MPI.Comm_size(comm)
+        rank = MPI.Comm_rank(comm)
+        assert size == nprocs
+        assert 0 <= rank < size
+        assert MPI.Is_thread_main()
+        assert MPI.Query_thread() == MPI.THREAD_MULTIPLE
+        t0 = MPI.Wtime()
+        assert MPI.Wtick() > 0
+        assert MPI.Wtime() >= t0
+        MPI.Finalize()
+        assert MPI.Finalized()
+
+    run_spmd(body, nprocs)
+
+
+def test_ranks_are_distinct(nprocs):
+    def body():
+        return MPI.Comm_rank(MPI.COMM_WORLD)
+
+    ranks = run_spmd(body, nprocs)
+    assert sorted(ranks) == list(range(nprocs))
+
+
+def test_double_init_raises():
+    def body():
+        with pytest.raises(MPI.MPIError):
+            MPI.Init()
+
+    run_spmd(body, 2)
+
+
+def test_singleton_init_world_of_one():
+    # Running without a launcher: world of size 1 (src/environment.jl Init).
+    import threading
+
+    result = {}
+
+    def standalone():
+        MPI.Init()
+        result["size"] = MPI.Comm_size(MPI.COMM_WORLD)
+        result["rank"] = MPI.Comm_rank(MPI.COMM_WORLD)
+        MPI.Finalize()
+
+    t = threading.Thread(target=standalone)
+    t.start()
+    t.join()
+    assert result == {"size": 1, "rank": 0}
+
+
+def test_universe_size(nprocs):
+    def body():
+        return MPI.universe_size()
+
+    assert run_spmd(body, nprocs) == [nprocs] * nprocs
+
+
+def test_rank_error_fails_whole_run(nprocs):
+    # A failing rank must fail the run (test/runtests.jl:37-39, test_error.jl).
+    def body():
+        rank = MPI.Comm_rank(MPI.COMM_WORLD)
+        if rank == 1:
+            raise ValueError("rank 1 exploded")
+        # Other ranks block in a collective; they must be released by abort.
+        MPI.Barrier(MPI.COMM_WORLD)
+
+    with pytest.raises((ValueError, MPI.AbortError)):
+        run_spmd(body, nprocs)
+
+
+def test_abort_releases_blocked_ranks(nprocs):
+    def body():
+        rank = MPI.Comm_rank(MPI.COMM_WORLD)
+        if rank == 0:
+            MPI.Abort(MPI.COMM_WORLD, 7)
+        else:
+            MPI.Barrier(MPI.COMM_WORLD)
+
+    with pytest.raises(MPI.AbortError):
+        run_spmd(body, nprocs)

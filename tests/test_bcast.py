@@ -1,0 +1,59 @@
+"""Bcast tests (reference: test/test_bcast.jl)."""
+
+import numpy as np
+
+import tpu_mpi as MPI
+from tpu_mpi.testing import aeq, run_spmd
+
+
+def test_bcast_array(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        root = 0
+        base = np.arange(16, dtype=np.float64)
+        buf = AT.array(base) if rank == root else AT.zeros(16)
+        MPI.Bcast(buf, root, comm)
+        assert aeq(buf, base)
+
+        # With explicit count
+        buf2 = AT.array(base) if rank == root else AT.zeros(16)
+        MPI.Bcast(buf2, 16, root, comm)
+        assert aeq(buf2, base)
+
+    run_spmd(body, nprocs)
+
+
+def test_bcast_objects(nprocs):
+    # test_bcast.jl broadcasts dicts and even functions (:38-55).
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        root = 0
+
+        obj = {"a": 1, "b": [1, 2, 3]} if rank == root else None
+        got = MPI.bcast(obj, root, comm)
+        assert got == {"a": 1, "b": [1, 2, 3]}
+        if rank != root:
+            got["mutated"] = True   # each rank owns its copy
+
+        # Broadcast a function (closure) — reference test_bcast.jl:38-55.
+        k = 7
+        f = (lambda x: x + k) if rank == root else None
+        g = MPI.bcast(f, root, comm)
+        assert g(1) == 8
+
+    run_spmd(body, nprocs)
+
+
+def test_bcast_from_nonzero_root(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        size = MPI.Comm_size(comm)
+        root = size - 1
+        buf = AT.full(8, float(rank))
+        MPI.Bcast(buf, root, comm)
+        assert aeq(buf, np.full(8, float(root)))
+
+    run_spmd(body, nprocs)

@@ -1,0 +1,102 @@
+"""Communicator tests (reference: test/test_comm.jl)."""
+
+import pytest
+
+import tpu_mpi as MPI
+from tpu_mpi.testing import run_spmd
+
+
+def test_compare_dup_free(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        assert MPI.Comm_compare(comm, comm) == MPI.IDENT
+        expected = MPI.CONGRUENT if MPI.Comm_size(comm) == 1 else MPI.UNEQUAL
+        assert MPI.Comm_compare(comm, MPI.COMM_SELF) == expected
+        MPI.Barrier(comm)
+        comm2 = MPI.Comm_dup(comm)
+        assert MPI.Comm_compare(comm, comm2) == MPI.CONGRUENT
+        MPI.Barrier(comm2)
+        comm3 = MPI.Comm_dup(comm2)
+        assert MPI.Comm_compare(comm, comm3) == MPI.CONGRUENT
+        MPI.Barrier(comm3)
+        MPI.free(comm2)
+        MPI.Barrier(comm3)
+        MPI.free(comm3)
+        with pytest.raises(MPI.InvalidCommError):
+            MPI.Comm_rank(comm3)
+
+    run_spmd(body, nprocs)
+
+
+def test_split(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        size = MPI.Comm_size(comm)
+        # Split into even/odd ranks, reverse order within each via key.
+        sub = MPI.Comm_split(comm, rank % 2, -rank)
+        subsize = (size + 1 - (rank % 2)) // 2 if size % 2 else size // 2
+        assert MPI.Comm_size(sub) == subsize
+        # Highest world rank of my parity gets rank 0 (key = -rank).
+        my_parity = [r for r in range(size) if r % 2 == rank % 2]
+        expect = sorted(my_parity, reverse=True).index(rank)
+        assert MPI.Comm_rank(sub) == expect
+        MPI.Barrier(sub)
+        return (rank, MPI.Comm_rank(sub))
+
+    run_spmd(body, nprocs)
+
+
+def test_split_undefined_gives_null(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        color = None if rank == 0 else 1
+        sub = MPI.Comm_split(comm, color, 0)
+        if rank == 0:
+            assert sub is MPI.COMM_NULL
+        else:
+            assert MPI.Comm_size(sub) == MPI.Comm_size(comm) - 1
+            MPI.Barrier(sub)
+
+    run_spmd(body, nprocs)
+
+
+def test_split_type_shared(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        node = MPI.Comm_split_type(comm, MPI.COMM_TYPE_SHARED, MPI.Comm_rank(comm))
+        # One controller process = one shared-memory domain.
+        assert MPI.Comm_size(node) == MPI.Comm_size(comm)
+
+    run_spmd(body, nprocs)
+
+
+def test_collective_mismatch_detected(nprocs):
+    # Mismatched collectives must raise, not deadlock (SURVEY.md §5 sequence
+    # check; regression: ctx.fail self-deadlocked on a non-reentrant lock).
+    import tpu_mpi
+
+    def body():
+        comm = MPI.COMM_WORLD
+        if MPI.Comm_rank(comm) == 0:
+            MPI.Barrier(comm)
+        else:
+            MPI.Allreduce(1, MPI.SUM, comm)
+
+    with pytest.raises((tpu_mpi.CollectiveMismatchError, MPI.AbortError)):
+        run_spmd(body, nprocs)
+
+
+def test_collectives_isolated_across_comms(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        comm2 = MPI.Comm_dup(comm)
+        a = MPI.Allreduce(rank, MPI.SUM, comm)
+        b = MPI.Allreduce(1, MPI.SUM, comm2)
+        size = MPI.Comm_size(comm)
+        assert a == size * (size - 1) // 2
+        assert b == size
+
+    run_spmd(body, nprocs)

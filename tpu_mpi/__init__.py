@@ -1,0 +1,48 @@
+"""tpu_mpi: a TPU-native message-passing framework.
+
+The capability surface of MPI.jl (/root/reference/src/MPI.jl — environment,
+communicators, point-to-point, collectives, reduction operators, derived
+datatypes, Cartesian topology, one-sided RMA, parallel I/O, launcher),
+re-designed for TPU: ranks are threads of one controller process bound to
+devices; the semantic path runs over a host rendezvous engine with zero-copy
+shared-memory placement; the performance path (``tpu_mpi.xla``) lowers the
+same collectives to XLA ICI ops (psum / all_gather / all_to_all / ppermute)
+inside jit/shard_map over a jax.sharding.Mesh.
+"""
+
+from .version import __version__
+
+# Wildcards / sentinels
+from ._runtime import (ANY_SOURCE, ANY_TAG, PROC_NULL, UNDEFINED,
+                       SpmdContext, spmd_run)
+from .error import (AbortError, CollectiveMismatchError, DeadlockError,
+                    InvalidCommError, MPIError, TruncationError)
+
+# Environment / lifecycle (src/environment.jl)
+from .environment import (Abort, Finalize, Finalized, Init, Init_thread,
+                          Initialized, Is_thread_main, Query_thread,
+                          THREAD_FUNNELED, THREAD_MULTIPLE, THREAD_SERIALIZED,
+                          THREAD_SINGLE, ThreadLevel, Wtick, Wtime, has_tpu,
+                          universe_size)
+
+# Communicators (src/comm.jl)
+from .comm import (COMM_NULL, COMM_SELF, COMM_TYPE_SHARED, COMM_WORLD,
+                   CONGRUENT, Comm, Comm_compare, Comm_dup, Comm_rank,
+                   Comm_size, Comm_split, Comm_split_type, Comparison, IDENT,
+                   SIMILAR, UNEQUAL, free)
+
+# Object model
+from .buffers import (BUFFER_NULL, Buffer, Buffer_send, DeviceBuffer, IN_PLACE,
+                      assert_minlength)
+from .datatypes import (BFLOAT16, BOOL, BYTE, CHAR, COMPLEX64, COMPLEX128,
+                        Datatype, FLOAT16, FLOAT32, FLOAT64, Get_address,
+                        INT8, INT16, INT32, INT64, Types, UINT8, UINT16,
+                        UINT32, UINT64, to_datatype)
+from .operators import (BAND, BOR, BXOR, LAND, LOR, LXOR, MAX, MIN, NO_OP, Op,
+                        PROD, REPLACE, SUM)
+
+# Collectives (src/collective.jl)
+from .collective import (Allgather, Allgatherv, Allreduce, Alltoall,
+                         Alltoallv, Barrier, Bcast, Exscan, Gather, Gatherv,
+                         Reduce, Reduce_scatter, Reduce_scatter_block, Scan,
+                         Scatter, Scatterv, bcast)

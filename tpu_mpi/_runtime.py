@@ -1,0 +1,419 @@
+"""Host-side SPMD runtime: the TPU-native analog of libmpi's progress engine.
+
+The reference launches N OS processes via mpiexec (/root/reference/bin/mpiexecjl:55-64)
+and the external C libmpi provides message matching, collective rendezvous and
+fate-sharing. On TPU the idiomatic model is a *single controller process* owning
+all local devices, so this runtime executes N ranks as threads of one process:
+
+- each rank is a thread with thread-local identity (``current_env``),
+- point-to-point messages move zero-copy through per-rank :class:`Mailbox` objects
+  with full MPI matching semantics (tags, ANY_SOURCE/ANY_TAG, non-overtaking order,
+  Probe on unexpected messages) — the analog of libmpi's matching engine,
+- collectives rendezvous through per-communicator :class:`CollectiveChannel`
+  objects; the last rank to arrive performs the combine (data placement happens
+  in shared memory / on device, so the "network" is a pointer exchange),
+- a failure in any rank fate-shares the whole job (test/runtests.jl:37-39 asserts
+  a single rank's error fails the run): every blocking wait polls the context's
+  failure flag and raises :class:`~tpu_mpi.error.AbortError`.
+
+Multi-process (one process per host over DCN) reuses the same Mailbox/Channel
+interfaces backed by the socket transport in ``tpu_mpi.backend``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Any, Callable, Optional, Sequence
+
+from .error import AbortError, CollectiveMismatchError, DeadlockError, MPIError
+
+# Wildcards / sentinels (values mirror the MPI spec's spirit; they are our own).
+ANY_SOURCE = -2
+ANY_TAG = -1
+PROC_NULL = -3
+UNDEFINED = -32766
+
+_DEADLOCK_TIMEOUT = float(os.environ.get("TPU_MPI_DEADLOCK_TIMEOUT", "60"))
+_POLL = 0.02
+
+_tls = threading.local()
+
+
+def current_env() -> Optional[tuple["SpmdContext", int]]:
+    """Return (context, rank) for the calling thread, or None outside SPMD."""
+    return getattr(_tls, "env", None)
+
+
+def set_env(env: Optional[tuple["SpmdContext", int]]) -> None:
+    _tls.env = env
+
+
+def require_env() -> tuple["SpmdContext", int]:
+    env = current_env()
+    if env is None:
+        raise MPIError("MPI has not been initialized on this thread; call Init() "
+                       "or run under spmd_run()/tpurun")
+    return env
+
+
+class _Waitable:
+    """Mixin: condition-variable wait loop with failure + deadlock checks."""
+
+    ctx: "SpmdContext"
+    cond: threading.Condition
+
+    def _wait_for(self, pred: Callable[[], bool], what: str,
+                  timeout: Optional[float] = None) -> bool:
+        """Wait (cond held) until pred() or failure/deadlock. Returns pred()."""
+        deadline = time.monotonic() + (_DEADLOCK_TIMEOUT if timeout is None else timeout)
+        while not pred():
+            self.ctx.check_failure()
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                if timeout is not None:
+                    return False
+                raise DeadlockError(f"deadlock suspected: blocked >{_DEADLOCK_TIMEOUT}s in {what}")
+            self.cond.wait(min(_POLL, remaining))
+        return True
+
+
+class Message:
+    """An in-flight point-to-point message (typed buffer or serialized object)."""
+
+    __slots__ = ("src", "tag", "cid", "payload", "count", "dtype", "kind")
+
+    def __init__(self, src: int, tag: int, cid: int, payload: Any,
+                 count: int, dtype: Any, kind: str):
+        self.src = src
+        self.tag = tag
+        self.cid = cid
+        self.payload = payload
+        self.count = count      # element count (typed) or byte length (object)
+        self.dtype = dtype
+        self.kind = kind        # "typed" | "object"
+
+
+class PendingRecv:
+    """A posted receive awaiting a matching message (Irecv/Recv)."""
+
+    __slots__ = ("src", "tag", "cid", "msg", "done", "cancelled")
+
+    def __init__(self, src: int, tag: int, cid: int):
+        self.src = src
+        self.tag = tag
+        self.cid = cid
+        self.msg: Optional[Message] = None
+        self.done = False
+        self.cancelled = False
+
+    def matches(self, m: Message) -> bool:
+        return (m.cid == self.cid
+                and (self.src == ANY_SOURCE or self.src == m.src)
+                and (self.tag == ANY_TAG or self.tag == m.tag))
+
+
+class Mailbox(_Waitable):
+    """Per-rank message matching engine.
+
+    Preserves MPI non-overtaking order: messages are matched FIFO, posted
+    receives are matched FIFO, and an incoming message first tries pending
+    receives before landing on the unexpected queue (where Probe sees it).
+    Mirrors the matching semantics the reference gets from libmpi
+    (/root/reference/src/pointtopoint.jl:121-148, :271-346).
+    """
+
+    def __init__(self, ctx: "SpmdContext"):
+        self.ctx = ctx
+        # RLock: ctx.fail() may notify a condition whose lock the failing
+        # thread itself holds (observed self-deadlock on collective mismatch).
+        self.lock = threading.RLock()
+        self.cond = threading.Condition(self.lock)
+        self.queue: list[Message] = []        # unexpected messages, FIFO
+        self.recvs: list[PendingRecv] = []    # posted receives, FIFO
+
+    def post(self, msg: Message) -> None:
+        """Deliver a message (called from the sender's thread)."""
+        with self.cond:
+            for pr in self.recvs:
+                if not pr.cancelled and pr.matches(msg):
+                    self.recvs.remove(pr)
+                    pr.msg = msg
+                    pr.done = True
+                    self.cond.notify_all()
+                    return
+            self.queue.append(msg)
+            self.cond.notify_all()
+
+    def post_recv(self, src: int, tag: int, cid: int) -> PendingRecv:
+        """Post a receive; matches the oldest queued message first (Irecv!)."""
+        pr = PendingRecv(src, tag, cid)
+        with self.cond:
+            for m in self.queue:
+                if pr.matches(m):
+                    self.queue.remove(m)
+                    pr.msg = m
+                    pr.done = True
+                    return pr
+            self.recvs.append(pr)
+        return pr
+
+    def wait_recv(self, pr: PendingRecv) -> Optional[Message]:
+        """Block until pr completes (Wait!); returns None if cancelled."""
+        with self.cond:
+            self._wait_for(lambda: pr.done or pr.cancelled, "Recv/Wait")
+            if pr.cancelled and not pr.done:
+                if pr in self.recvs:
+                    self.recvs.remove(pr)
+                return None
+            return pr.msg
+
+    def test_recv(self, pr: PendingRecv) -> bool:
+        with self.cond:
+            return pr.done or pr.cancelled
+
+    def cancel(self, pr: PendingRecv) -> None:
+        """Cancel a posted receive (src/pointtopoint.jl:677-681)."""
+        with self.cond:
+            if not pr.done:
+                pr.cancelled = True
+                if pr in self.recvs:
+                    self.recvs.remove(pr)
+                self.cond.notify_all()
+
+    def probe(self, src: int, tag: int, cid: int, block: bool) -> Optional[Message]:
+        """Find (without removing) a matching unexpected message (Probe/Iprobe)."""
+        probe_pr = PendingRecv(src, tag, cid)
+        with self.cond:
+            def find() -> Optional[Message]:
+                for m in self.queue:
+                    if probe_pr.matches(m):
+                        return m
+                return None
+            if not block:
+                return find()
+            self._wait_for(lambda: find() is not None, "Probe")
+            return find()
+
+    def notify(self) -> None:
+        with self.cond:
+            self.cond.notify_all()
+
+
+_EMPTY = object()   # distinct "no contribution yet" marker (None is a valid payload)
+
+
+class CollectiveChannel(_Waitable):
+    """Reusable all-rank rendezvous for one communicator.
+
+    Every collective round: each rank deposits a contribution; the last arriver
+    runs ``combine(contribs) -> per-rank results`` (doing any data placement —
+    all buffers are visible in the shared address space / on device); every rank
+    picks up its slot; the last picker resets the channel for the next round.
+
+    The ``opname`` tag is checked across ranks every round — calling mismatched
+    collectives on one communicator raises CollectiveMismatchError in all ranks
+    instead of deadlocking (SURVEY.md §5 "race detection").
+    """
+
+    def __init__(self, ctx: "SpmdContext", size: int):
+        self.ctx = ctx
+        self.size = size
+        self.lock = threading.RLock()   # see Mailbox.__init__ on reentrancy
+        self.cond = threading.Condition(self.lock)
+        self.contribs: list[Any] = [_EMPTY] * size
+        self.results: Optional[Sequence[Any]] = None
+        self.arrived = 0
+        self.picked = 0
+        self.opname: Optional[str] = None
+
+    def run(self, rank: int, contrib: Any, combine: Callable[[list[Any]], Sequence[Any]],
+            opname: str) -> Any:
+        with self.cond:
+            # Wait for the previous round to fully drain before joining a new one.
+            self._wait_for(
+                lambda: self.contribs[rank] is _EMPTY and self.results is None,
+                f"collective {opname} (waiting for previous round)")
+            if self.opname is None:
+                self.opname = opname
+            elif self.opname != opname:
+                err = CollectiveMismatchError(
+                    f"rank {rank} called {opname!r} while other ranks are in "
+                    f"{self.opname!r} on the same communicator")
+                self.ctx.fail(err)
+                raise err
+            self.contribs[rank] = contrib
+            self.arrived += 1
+            if self.arrived == self.size:
+                try:
+                    self.results = list(combine(list(self.contribs)))
+                except BaseException as e:
+                    self.ctx.fail(e)
+                    raise
+                if len(self.results) != self.size:
+                    err = MPIError(f"combine for {opname} returned {len(self.results)} "
+                                   f"results for {self.size} ranks")
+                    self.ctx.fail(err)
+                    raise err
+                self.picked = 0
+                self.cond.notify_all()
+            else:
+                self._wait_for(lambda: self.results is not None,
+                               f"collective {opname}")
+            assert self.results is not None
+            res = self.results[rank]
+            self.picked += 1
+            if self.picked == self.size:
+                self.contribs = [_EMPTY] * self.size
+                self.results = None
+                self.arrived = 0
+                self.opname = None
+                self.cond.notify_all()
+            return res
+
+
+class SpmdContext:
+    """State shared by all ranks of one SPMD job (the "world").
+
+    Analog of what mpiexec + libmpi set up before/at MPI_Init
+    (/root/reference/src/environment.jl:80-89): fixed world size, per-rank
+    mailboxes, communicator context-id allocation, and fate-sharing.
+    """
+
+    def __init__(self, size: int, universe_size: Optional[int] = None):
+        if size < 1:
+            raise ValueError("world size must be >= 1")
+        self.size = size
+        self.universe_size = universe_size if universe_size is not None else size
+        self.mailboxes = [Mailbox(self) for _ in range(size)]
+        self._channels: dict[int, CollectiveChannel] = {}
+        self._channels_lock = threading.Lock()
+        # cid 0 = COMM_WORLD, 1 = COMM_SELF; dynamic cids start at 2.
+        self._next_cid = itertools.count(2)
+        self.failure: Optional[BaseException] = None
+        self.failed_rank: Optional[int] = None
+        self._failure_lock = threading.Lock()
+        # Per-rank lifecycle flags (src/environment.jl:267-287 queries).
+        self.initialized = [False] * size
+        self.finalized = [False] * size
+        self.thread_level = [None] * size
+        self.main_threads: list[Optional[int]] = [None] * size
+        # Attribute store for windows/files keyed by (kind, id).
+        self.objects: dict[Any, Any] = {}
+        self.objects_lock = threading.Lock()
+
+    # -- failure fate-sharing ------------------------------------------------
+    def fail(self, exc: BaseException, rank: Optional[int] = None) -> None:
+        with self._failure_lock:
+            if self.failure is None:
+                self.failure = exc
+                self.failed_rank = rank
+        for mb in self.mailboxes:
+            mb.notify()
+        with self._channels_lock:
+            chans = list(self._channels.values())
+        for ch in chans:
+            with ch.cond:
+                ch.cond.notify_all()
+
+    def check_failure(self) -> None:
+        if self.failure is not None:
+            raise AbortError(
+                f"job aborted ({type(self.failure).__name__}: {self.failure})"
+                + (f" originating on rank {self.failed_rank}" if self.failed_rank is not None else ""))
+
+    # -- communicator context ids -------------------------------------------
+    def alloc_cid(self) -> int:
+        """Allocate a fresh communicator context id (call from combine only,
+        so all members of the parent communicator agree on the value)."""
+        return next(self._next_cid)
+
+    def channel(self, cid: int, size: int) -> CollectiveChannel:
+        with self._channels_lock:
+            ch = self._channels.get(cid)
+            if ch is None:
+                ch = CollectiveChannel(self, size)
+                self._channels[cid] = ch
+            return ch
+
+    # -- device binding ------------------------------------------------------
+    def device_for(self, rank: int):
+        """The JAX device owned by a rank (rank i ↔ device i, wrapping)."""
+        import jax
+        devs = jax.devices()
+        return devs[rank % len(devs)]
+
+
+_jax_warmed = False
+
+
+def _warm_jax_backend() -> None:
+    """Initialize the JAX backend once, serially, before rank threads start.
+
+    PJRT client creation is not safe under concurrent first-initialization
+    from many threads (observed hang in make_c_api_client); the launcher owns
+    backend bring-up, like mpiexec owns process bring-up in the reference.
+    """
+    global _jax_warmed
+    if _jax_warmed:
+        return
+    try:
+        import jax
+        jax.devices()
+        import jax.numpy as jnp
+        jnp.zeros(1).block_until_ready()
+    except Exception:
+        pass
+    _jax_warmed = True
+
+
+def spmd_run(fn: Callable[[], Any], size: int, *, args: tuple = (),
+             universe_size: Optional[int] = None,
+             timeout: Optional[float] = None) -> list[Any]:
+    """Run ``fn()`` as an SPMD program on ``size`` ranks (threads).
+
+    The TPU-native mpiexec: where the reference forks N OS processes
+    (/root/reference/bin/mpiexecjl:55-64, test/runtests.jl:28-45), we run N rank
+    threads in one controller process sharing the JAX runtime. Returns the list
+    of per-rank return values; re-raises the first rank failure (so a failing
+    rank fails the whole run, matching test/runtests.jl:37-39).
+    """
+    _warm_jax_backend()
+    ctx = SpmdContext(size, universe_size=universe_size)
+    results: list[Any] = [None] * size
+    first_error: list[Optional[BaseException]] = [None]
+    error_lock = threading.Lock()
+
+    def runner(rank: int) -> None:
+        set_env((ctx, rank))
+        try:
+            results[rank] = fn(*args)
+        except BaseException as e:
+            with error_lock:
+                if first_error[0] is None:
+                    first_error[0] = e
+            ctx.fail(e, rank)
+        finally:
+            set_env(None)
+
+    threads = [threading.Thread(target=runner, args=(r,), name=f"tpu-mpi-rank-{r}",
+                                daemon=True) for r in range(size)]
+    for t in threads:
+        t.start()
+    deadline = None if timeout is None else time.monotonic() + timeout
+    for t in threads:
+        t.join(None if deadline is None else max(0.0, deadline - time.monotonic()))
+        if t.is_alive():
+            ctx.fail(DeadlockError("spmd_run timeout"), None)
+    for t in threads:
+        t.join(5.0)
+    err = first_error[0]
+    if err is None and ctx.failure is not None:
+        # e.g. a rank stuck in pure compute past the timeout: the failure was
+        # recorded on the context but no rank thread surfaced it.
+        err = ctx.failure
+    if err is not None:
+        raise err
+    return results

@@ -1,0 +1,246 @@
+"""Buffers: the array-type registry and send/recv operand normalization.
+
+Reference: /root/reference/src/buffers.jl — MPIBuffertype union (:9), MPIPtr
+conversion (:13-23), @assert_minlength bounds guard (:25-31), the
+Buffer(data,count,datatype) triple (:78-91) with constructors for arrays, Refs
+and three SubArray flavors that auto-derive vector/subarray datatypes
+(:101-117), Buffer_send for isbits scalars (:125), and the CUDA extension
+(src/cuda.jl:6-28) that plugs device arrays into the same conversion.
+
+TPU mapping (SURVEY.md §2.2/§2.3): a buffer is either a host numpy array
+(mutable, views welcome — numpy's strided views subsume the reference's
+auto-derived SubArray datatypes) or a device-resident jax.Array. jax.Arrays are
+immutable, so the mutating API accepts :class:`DeviceBuffer`, a thin rebinding
+cell whose ``__setitem__`` lowers to functional ``.at[].set`` updates — the
+pluggable array-registry pattern BASELINE.json asks for, with numpy and jax
+registered by default.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from .datatypes import Datatype, to_datatype
+from .error import MPIError
+
+
+class _InPlace:
+    """Sentinel for in-place collectives (src/collective.jl:1 IN_PLACE)."""
+
+    def __repr__(self) -> str:
+        return "IN_PLACE"
+
+
+IN_PLACE = _InPlace()
+BUFFER_NULL = None
+
+
+def is_jax_array(x: Any) -> bool:
+    return type(x).__module__.startswith("jax") and hasattr(x, "dtype")
+
+
+class DeviceBuffer:
+    """A mutable cell holding a device-resident jax.Array.
+
+    The analog of passing a CuArray to MPI.jl (src/cuda.jl:26-28): device data
+    is a first-class communication operand. Mutation rebinds via functional
+    updates, so the mutating API (Recv!, Allreduce! with a recv buffer, …)
+    works identically for host and device arrays.
+    """
+
+    def __init__(self, value: Any, dtype: Any = None, device: Any = None):
+        import jax.numpy as jnp
+        arr = jnp.asarray(value, dtype=dtype)
+        if device is not None:
+            import jax
+            arr = jax.device_put(arr, device)
+        self.value = arr
+
+    # -- constructors mirroring ArrayType{T}(undef, dims) test usage ---------
+    @classmethod
+    def empty(cls, shape: Any, dtype: Any = np.float64) -> "DeviceBuffer":
+        import jax.numpy as jnp
+        return cls(jnp.zeros(shape, dtype=dtype))
+
+    @property
+    def shape(self):
+        return self.value.shape
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    @property
+    def size(self) -> int:
+        return int(self.value.size)
+
+    def __len__(self) -> int:
+        return int(self.value.shape[0]) if self.value.ndim else 0
+
+    def __array__(self, dtype=None):
+        out = np.asarray(self.value)
+        return out.astype(dtype) if dtype is not None else out
+
+    def __getitem__(self, idx):
+        return self.value[idx]
+
+    def __setitem__(self, idx, val):
+        self.value = self.value.at[idx].set(val)
+
+    def setflat(self, src: Any, count: Optional[int] = None) -> None:
+        """Assign the first ``count`` flat elements from src."""
+        import jax.numpy as jnp
+        flat = jnp.ravel(jnp.asarray(src, dtype=self.value.dtype))
+        n = flat.size if count is None else count
+        if n == self.value.size and self.value.shape == tuple(np.shape(src)):
+            self.value = jnp.asarray(src, dtype=self.value.dtype)
+        else:
+            out = jnp.ravel(self.value).at[:n].set(flat[:n])
+            self.value = out.reshape(self.value.shape)
+
+    def copy(self) -> "DeviceBuffer":
+        return DeviceBuffer(self.value)
+
+    def fill(self, v: Any) -> None:
+        import jax.numpy as jnp
+        self.value = jnp.full(self.value.shape, v, dtype=self.value.dtype)
+
+    def __repr__(self) -> str:
+        return f"DeviceBuffer({self.value!r})"
+
+
+class Buffer:
+    """(data, count, datatype) communication operand (src/buffers.jl:78-91)."""
+
+    def __init__(self, data: Any, count: Optional[int] = None,
+                 datatype: Optional[Datatype] = None):
+        self.data = data
+        arr = extract_array(data)
+        if arr is None:
+            raise MPIError(f"not a communication buffer: {type(data).__name__}")
+        self.count = count if count is not None else int(arr.size)
+        self.datatype = datatype if datatype is not None else to_datatype(arr.dtype)
+
+    @property
+    def array(self):
+        return extract_array(self.data)
+
+
+def Buffer_send(x: Any) -> Buffer:
+    """Normalize any send operand, incl. scalars (src/buffers.jl:125)."""
+    if isinstance(x, Buffer):
+        return x
+    if np.isscalar(x) or isinstance(x, (int, float, complex, bool, np.generic)):
+        return Buffer(np.asarray(x))
+    return Buffer(x)
+
+
+def extract_array(x: Any):
+    """The underlying numpy/jax array of an operand, or None.
+
+    The array-type registry: numpy arrays (incl. non-contiguous views — strided
+    views play the role of the reference's auto-derived SubArray datatypes,
+    src/buffers.jl:101-117), jax.Arrays, DeviceBuffer cells, scalars, and
+    nested sequences.
+    """
+    if isinstance(x, DeviceBuffer):
+        return x.value
+    if isinstance(x, np.ndarray) or is_jax_array(x):
+        return x
+    if isinstance(x, (np.generic, int, float, complex, bool)):
+        return np.asarray(x)
+    if isinstance(x, (list, tuple)) and x and not isinstance(x[0], (list, tuple)):
+        return None  # plain sequences must be wrapped explicitly to avoid surprises
+    return None
+
+
+def element_count(x: Any) -> int:
+    arr = extract_array(x)
+    if arr is None:
+        raise MPIError(f"not a communication buffer: {type(x).__name__}")
+    return int(arr.size)
+
+
+def assert_minlength(buf: Any, count: int) -> None:
+    """Bounds guard; raises AssertionError like the reference's
+    @assert_minlength (src/buffers.jl:25-31)."""
+    n = element_count(buf)
+    assert n >= count, f"buffer has {n} elements, needs at least {count}"
+
+
+def is_writable(x: Any) -> bool:
+    if isinstance(x, DeviceBuffer):
+        return True
+    if isinstance(x, np.ndarray):
+        return x.flags.writeable
+    return False
+
+
+def write_flat(dest: Any, src: Any, count: Optional[int] = None) -> Any:
+    """Write the first ``count`` flat elements of src into dest.
+
+    dest: numpy array (strided views fine) or DeviceBuffer. Returns dest.
+    """
+    if isinstance(dest, DeviceBuffer):
+        dest.setflat(src, count)
+        return dest
+    if isinstance(dest, np.ndarray):
+        srcarr = np.asarray(src)
+        n = srcarr.size if count is None else count
+        if n == dest.size and srcarr.size == dest.size:
+            # strided-safe elementwise assignment
+            dest[...] = srcarr.reshape(dest.shape).astype(dest.dtype, copy=False) \
+                if srcarr.shape != dest.shape else srcarr.astype(dest.dtype, copy=False)
+        else:
+            flat = dest.reshape(-1) if dest.flags.contiguous else None
+            if flat is None:
+                # non-contiguous: go element-by-element via flat iterator
+                it = np.nditer(dest, flags=["multi_index"], op_flags=["writeonly"])
+                sflat = srcarr.reshape(-1)
+                i = 0
+                for slot in it:
+                    if i >= n:
+                        break
+                    slot[...] = sflat[i]
+                    i += 1
+            else:
+                flat[:n] = srcarr.reshape(-1)[:n].astype(dest.dtype, copy=False)
+        return dest
+    if is_jax_array(dest):
+        raise MPIError("jax.Array is immutable; wrap it in DeviceBuffer for "
+                       "the mutating API, or use the allocating variant")
+    raise MPIError(f"cannot write into {type(dest).__name__}")
+
+
+def clone_like(x: Any, value: Any) -> Any:
+    """An operand of the same registry kind as x holding ``value``."""
+    if isinstance(x, DeviceBuffer):
+        return DeviceBuffer(value)
+    if is_jax_array(x):
+        import jax.numpy as jnp
+        return jnp.asarray(value)
+    return np.array(value, copy=True)
+
+
+def to_wire(x: Any, count: Optional[int] = None) -> Any:
+    """A contiguous, immutable-by-convention snapshot of a send operand.
+
+    Host arrays are copied (the sender may mutate after a buffered Isend);
+    device arrays are immutable so the reference is the snapshot — the zero-copy
+    win of device-native buffers (SURVEY.md L5).
+    """
+    if isinstance(x, DeviceBuffer):
+        arr = x.value
+    elif is_jax_array(x):
+        arr = x
+    else:
+        arr = np.ascontiguousarray(np.asarray(x))
+        arr = arr.copy() if arr is x else arr
+    if count is not None:
+        # Always hand out a flat view: collectives slice wire buffers by
+        # flat element offset regardless of the operand's rank.
+        flat = arr.reshape(-1)
+        return flat if flat.size == count else flat[:count]
+    return arr

@@ -1,0 +1,569 @@
+"""Collectives over a communicator's rendezvous channel (host path).
+
+Reference: /root/reference/src/collective.jl — Barrier (:15-19), Bcast! (:29-42)
++ serialized bcast (:44-60), Scatter(!*) (:90-129), Scatterv(!*) (:156-196),
+Gather(!*) (:230-275), Allgather(!*) (:295-335), Gatherv(!*) (:363-403),
+Allgatherv(!*) (:424-461), Alltoall(!*) (:489-532), Alltoallv(!*) (:545-578),
+Reduce(!*) (:605-666), Allreduce(!*) (:691-738), Scan(!*) (:760-808),
+Exscan(!*) (:834-882). Each exists in mutating, allocating, IN_PLACE and
+scalar-object flavors; ``*v`` displacements are exclusive prefix sums.
+``Reduce_scatter`` is absent in v0.14.2 — added here natively since XLA has it
+(SURVEY.md §2.3 note).
+
+API convention (Julia ``!`` does not exist in Python): one name per collective;
+the *arity and argument kinds* select the flavor exactly as the reference's
+method table does — ``Allreduce(send, op, comm)`` allocates,
+``Allreduce(send, recv, op, comm)`` mutates, ``Allreduce(IN_PLACE, buf, op,
+comm)`` is in-place; the scatter/gather family also accepts ``None`` for the
+insignificant buffer like the reference accepts ``nothing``.
+
+This is the *semantic* path, running over the thread rendezvous with zero-copy
+shared-memory data placement. The compiled high-bandwidth path — the same
+operations as XLA ICI collectives inside jit/shard_map — lives in
+``tpu_mpi.xla`` (SURVEY.md §3.2: the whole stack collapses to one lax op).
+"""
+
+from __future__ import annotations
+
+import functools
+import pickle
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from .buffers import (IN_PLACE, DeviceBuffer, _InPlace, assert_minlength,
+                      clone_like, element_count, extract_array, to_wire,
+                      write_flat)
+from .comm import Comm
+from .error import MPIError
+from .operators import Op, as_op
+
+
+def _run(comm: Comm, contrib: Any, combine, opname: str) -> Any:
+    return comm.channel().run(comm.rank(), contrib, combine, opname)
+
+
+def _reduce_arrays(arrs: Sequence[Any], op: Op) -> Any:
+    """Rank-ordered elementwise reduction (deterministic; MPI rank order)."""
+    return functools.reduce(op, arrs)
+
+
+def _is_none(x: Any) -> bool:
+    return x is None or isinstance(x, _InPlace)
+
+
+# ---------------------------------------------------------------------------
+# Barrier
+# ---------------------------------------------------------------------------
+
+def Barrier(comm: Comm) -> None:
+    """Block until every rank of comm arrives (src/collective.jl:15-19)."""
+    _run(comm, None, lambda cs: [None] * len(cs), f"Barrier@{comm.cid}")
+
+
+# ---------------------------------------------------------------------------
+# Bcast / bcast
+# ---------------------------------------------------------------------------
+
+def Bcast(buf: Any, *args) -> Any:
+    """``Bcast(buf, [count,] root, comm)`` — broadcast root's buffer into every
+    rank's buffer, mutating (src/collective.jl:29-42). Returns buf."""
+    if len(args) == 2:
+        count, (root, comm) = None, args
+    elif len(args) == 3:
+        count, root, comm = args
+    else:
+        raise TypeError("Bcast(buf, [count,] root, comm)")
+    rank = comm.rank()
+    n = element_count(buf) if count is None else count
+    assert_minlength(buf, n)
+    payload = to_wire(buf, n) if rank == root else None
+
+    def combine(cs):
+        val = next(c for c in cs if c is not None)
+        return [val] * len(cs)
+
+    val = _run(comm, payload, combine, f"Bcast@{comm.cid}")
+    if rank != root:
+        write_flat(buf, val, n)
+    return buf
+
+
+def bcast(obj: Any, root: int, comm: Comm) -> Any:
+    """Broadcast an arbitrary serialized object (src/collective.jl:44-60).
+
+    The reference's two-phase length+payload dance collapses: the rendezvous
+    carries dynamic sizes natively. Pickle round-trips give each rank its own
+    copy; unpicklable objects (closures) are shared by reference in-process."""
+    rank = comm.rank()
+    if rank == root:
+        try:
+            payload = ("pickle", pickle.dumps(obj))
+        except Exception:
+            payload = ("ref", obj)
+    else:
+        payload = None
+
+    def combine(cs):
+        val = next(c for c in cs if c is not None)
+        return [val] * len(cs)
+
+    kind, data = _run(comm, payload, combine, f"bcast@{comm.cid}")
+    if rank == root:
+        return obj
+    return pickle.loads(data) if kind == "pickle" else data
+
+
+# ---------------------------------------------------------------------------
+# Scatter / Scatterv
+# ---------------------------------------------------------------------------
+
+def Scatter(*args) -> Any:
+    """``Scatter(send, recv, [count,] root, comm)`` mutating |
+    ``Scatter(send, count, root, comm)`` allocating (src/collective.jl:90-129).
+    Root's send buffer is split into comm-size equal chunks in rank order;
+    ``None``/IN_PLACE marks the insignificant buffer."""
+    if len(args) == 5:
+        sendbuf, recvbuf, count, root, comm = args
+        alloc = False
+    elif len(args) == 4 and isinstance(args[1], (int, np.integer)):
+        sendbuf, count, root, comm = args
+        recvbuf, alloc = None, True
+    elif len(args) == 4:
+        sendbuf, recvbuf, root, comm = args
+        count, alloc = None, False
+    else:
+        raise TypeError("Scatter(send, recv, [count,] root, comm) or Scatter(send, count, root, comm)")
+    rank, size = comm.rank(), comm.size()
+    isroot = rank == root
+    if count is None and not alloc:
+        count = element_count(recvbuf) if not _is_none(recvbuf) else element_count(sendbuf) // size
+    if isroot:
+        if _is_none(sendbuf):
+            raise MPIError("root must supply a send buffer to Scatter")
+        assert_minlength(sendbuf, count * size)
+    payload = to_wire(sendbuf, count * size) if isroot else None
+
+    def combine(cs):
+        data = next(c for c in cs if c is not None)
+        return [data[r * count:(r + 1) * count] for r in range(len(cs))]
+
+    chunk = _run(comm, payload, combine, f"Scatter@{comm.cid}")
+    if alloc:
+        template = sendbuf if isroot else None
+        return clone_like(template, chunk) if template is not None else np.array(chunk)
+    if isroot and _is_none(recvbuf):
+        return sendbuf          # IN_PLACE at root: data already in place
+    assert_minlength(recvbuf, count)
+    write_flat(recvbuf, chunk, count)
+    return recvbuf
+
+
+def Scatterv(*args) -> Any:
+    """``Scatterv(send, recv, counts, root, comm)`` mutating |
+    ``Scatterv(send, counts, root, comm)`` allocating (src/collective.jl:156-196).
+    Displacements are the exclusive prefix sum of counts (:169)."""
+    if len(args) == 5:
+        sendbuf, recvbuf, counts, root, comm = args
+        alloc = False
+    elif len(args) == 4:
+        sendbuf, counts, root, comm = args
+        recvbuf, alloc = None, True
+    else:
+        raise TypeError("Scatterv(send, [recv,] counts, root, comm)")
+    rank, size = comm.rank(), comm.size()
+    isroot = rank == root
+    counts = [int(c) for c in counts]
+    if isroot:
+        if _is_none(sendbuf):
+            raise MPIError("root must supply a send buffer to Scatterv")
+        assert_minlength(sendbuf, sum(counts))
+    payload = to_wire(sendbuf, sum(counts)) if isroot else None
+
+    def combine(cs):
+        data = next(c for c in cs if c is not None)
+        displs = np.concatenate([[0], np.cumsum(counts)])
+        return [data[displs[r]:displs[r] + counts[r]] for r in range(len(cs))]
+
+    chunk = _run(comm, payload, combine, f"Scatterv@{comm.cid}")
+    if alloc:
+        template = sendbuf if isroot else None
+        return clone_like(template, chunk) if template is not None else np.array(chunk)
+    if isroot and _is_none(recvbuf):
+        return sendbuf
+    assert_minlength(recvbuf, counts[rank])
+    write_flat(recvbuf, chunk, counts[rank])
+    return recvbuf
+
+
+# ---------------------------------------------------------------------------
+# Gather / Gatherv / Allgather / Allgatherv
+# ---------------------------------------------------------------------------
+
+def Gather(*args) -> Any:
+    """``Gather(send, recv, [count,] root, comm)`` mutating |
+    ``Gather(send, [count,] root, comm)`` allocating — works for arrays and
+    scalar objects (src/collective.jl:230-275)."""
+    if len(args) == 5:
+        sendbuf, recvbuf, count, root, comm = args
+        alloc = False
+    elif len(args) == 4 and isinstance(args[1], (int, np.integer)):
+        sendbuf, count, root, comm = args
+        recvbuf, alloc = None, True
+    elif len(args) == 4:
+        sendbuf, recvbuf, root, comm = args
+        count, alloc = None, False
+    elif len(args) == 3:
+        sendbuf, root, comm = args
+        recvbuf, count, alloc = None, None, True
+    else:
+        raise TypeError("Gather(send, [recv,] [count,] root, comm)")
+    return _gather_impl(sendbuf, recvbuf, count, root, comm, alloc, all_ranks=False)
+
+
+def Allgather(*args) -> Any:
+    """``Allgather(send, recv, count, comm)`` | ``Allgather(IN_PLACE, buf,
+    count, comm)`` | ``Allgather(send, [count,] comm)`` allocating
+    (src/collective.jl:295-335). Every rank receives the concatenation."""
+    if len(args) == 4:
+        sendbuf, recvbuf, count, comm = args
+        alloc = False
+    elif len(args) == 3 and isinstance(args[1], (int, np.integer)):
+        sendbuf, count, comm = args
+        recvbuf, alloc = None, True
+    elif len(args) == 2:
+        sendbuf, comm = args
+        recvbuf, count, alloc = None, None, True
+    else:
+        raise TypeError("Allgather(send, [recv,] [count,] comm)")
+    return _gather_impl(sendbuf, recvbuf, count, None, comm, alloc, all_ranks=True)
+
+
+def _gather_impl(sendbuf, recvbuf, count, root, comm, alloc, all_ranks):
+    rank, size = comm.rank(), comm.size()
+    isroot = all_ranks or rank == root
+    inplace = isinstance(sendbuf, _InPlace) or sendbuf is None
+    if inplace:
+        # IN_PLACE: rank's own chunk already sits at recvbuf[rank*count:...]
+        # (src/collective.jl:309-313 in-place Allgather!).
+        if _is_none(recvbuf):
+            raise MPIError("IN_PLACE gather needs the send-recv buffer")
+        if count is None:
+            count = element_count(recvbuf) // size
+        arr = to_wire(recvbuf, element_count(recvbuf))
+        payload = arr.reshape(-1)[rank * count:(rank + 1) * count]
+    else:
+        if count is None:
+            count = element_count(sendbuf)
+        assert_minlength(sendbuf, count)
+        payload = to_wire(sendbuf, count)
+
+    def combine(cs):
+        xp = np
+        try:
+            if any(type(c).__module__.startswith("jax") for c in cs):
+                import jax.numpy as xp  # type: ignore
+        except Exception:
+            pass
+        full = xp.concatenate([xp.asarray(c).reshape(-1) for c in cs])
+        return [full] * len(cs)
+
+    full = _run(comm, payload, combine, f"Gather@{comm.cid}")
+    if not isroot:
+        return None if alloc else recvbuf
+    if alloc:
+        template = sendbuf if not inplace else recvbuf
+        return clone_like(template, full)
+    assert_minlength(recvbuf, count * size)
+    write_flat(recvbuf, full, count * size)
+    return recvbuf
+
+
+def Gatherv(*args) -> Any:
+    """``Gatherv(send, recv, counts, root, comm)`` mutating |
+    ``Gatherv(send, counts, root, comm)`` allocating (src/collective.jl:363-403)."""
+    if len(args) == 5:
+        sendbuf, recvbuf, counts, root, comm = args
+        alloc = False
+    elif len(args) == 4:
+        sendbuf, counts, root, comm = args
+        recvbuf, alloc = None, True
+    else:
+        raise TypeError("Gatherv(send, [recv,] counts, root, comm)")
+    return _gatherv_impl(sendbuf, recvbuf, counts, root, comm, alloc, all_ranks=False)
+
+
+def Allgatherv(*args) -> Any:
+    """``Allgatherv(send, recv, counts, comm)`` | ``Allgatherv(IN_PLACE, buf,
+    counts, comm)`` | allocating ``Allgatherv(send, counts, comm)``
+    (src/collective.jl:424-461)."""
+    if len(args) == 4:
+        sendbuf, recvbuf, counts, comm = args
+        alloc = False
+    elif len(args) == 3:
+        sendbuf, counts, comm = args
+        recvbuf, alloc = None, True
+    else:
+        raise TypeError("Allgatherv(send, [recv,] counts, comm)")
+    return _gatherv_impl(sendbuf, recvbuf, counts, None, comm, alloc, all_ranks=True)
+
+
+def _gatherv_impl(sendbuf, recvbuf, counts, root, comm, alloc, all_ranks):
+    rank, size = comm.rank(), comm.size()
+    isroot = all_ranks or rank == root
+    counts = [int(c) for c in counts]
+    displs = np.concatenate([[0], np.cumsum(counts)])  # exclusive prefix (:365,:425)
+    inplace = isinstance(sendbuf, _InPlace) or sendbuf is None
+    if inplace:
+        if _is_none(recvbuf):
+            raise MPIError("IN_PLACE gatherv needs the send-recv buffer")
+        arr = to_wire(recvbuf, element_count(recvbuf))
+        payload = arr.reshape(-1)[displs[rank]:displs[rank] + counts[rank]]
+    else:
+        assert_minlength(sendbuf, counts[rank])
+        payload = to_wire(sendbuf, counts[rank])
+
+    def combine(cs):
+        xp = np
+        if any(type(c).__module__.startswith("jax") for c in cs):
+            import jax.numpy as xp  # type: ignore
+        full = xp.concatenate([xp.asarray(c).reshape(-1) for c in cs])
+        return [full] * len(cs)
+
+    full = _run(comm, payload, combine, f"Gatherv@{comm.cid}")
+    if not isroot:
+        return None if alloc else recvbuf
+    if alloc:
+        template = sendbuf if not inplace else recvbuf
+        return clone_like(template, full)
+    assert_minlength(recvbuf, sum(counts))
+    write_flat(recvbuf, full, sum(counts))
+    return recvbuf
+
+
+# ---------------------------------------------------------------------------
+# Alltoall / Alltoallv
+# ---------------------------------------------------------------------------
+
+def Alltoall(*args) -> Any:
+    """``Alltoall(send, recv, count, comm)`` | ``Alltoall(IN_PLACE, buf, count,
+    comm)`` | allocating ``Alltoall(send, count, comm)``
+    (src/collective.jl:489-532). Rank r sends its chunk j to rank j's slot r."""
+    if len(args) == 4:
+        sendbuf, recvbuf, count, comm = args
+        alloc = False
+    elif len(args) == 3:
+        sendbuf, count, comm = args
+        recvbuf, alloc = None, True
+    else:
+        raise TypeError("Alltoall(send, [recv,] count, comm)")
+    rank, size = comm.rank(), comm.size()
+    count = int(count)
+    inplace = isinstance(sendbuf, _InPlace) or sendbuf is None
+    src = recvbuf if inplace else sendbuf
+    assert_minlength(src, count * size)
+    payload = to_wire(src, count * size)
+
+    def combine(cs):
+        xp = np
+        if any(type(c).__module__.startswith("jax") for c in cs):
+            import jax.numpy as xp  # type: ignore
+        mats = [xp.asarray(c).reshape(len(cs), count) for c in cs]
+        return [xp.concatenate([m[r] for m in mats]) for r in range(len(cs))]
+
+    mine = _run(comm, payload, combine, f"Alltoall@{comm.cid}")
+    if alloc:
+        return clone_like(src, mine)
+    assert_minlength(recvbuf, count * size)
+    write_flat(recvbuf, mine, count * size)
+    return recvbuf
+
+
+def Alltoallv(*args) -> Any:
+    """``Alltoallv(send, recv, scounts, rcounts, comm)`` mutating | allocating
+    ``Alltoallv(send, scounts, rcounts, comm)`` (src/collective.jl:545-578)."""
+    if len(args) == 5:
+        sendbuf, recvbuf, scounts, rcounts, comm = args
+        alloc = False
+    elif len(args) == 4:
+        sendbuf, scounts, rcounts, comm = args
+        recvbuf, alloc = None, True
+    else:
+        raise TypeError("Alltoallv(send, [recv,] scounts, rcounts, comm)")
+    rank, size = comm.rank(), comm.size()
+    scounts = [int(c) for c in scounts]
+    rcounts = [int(c) for c in rcounts]
+    assert_minlength(sendbuf, sum(scounts))
+    payload = (to_wire(sendbuf, sum(scounts)), scounts)
+
+    def combine(cs):
+        xp = np
+        if any(type(c[0]).__module__.startswith("jax") for c in cs):
+            import jax.numpy as xp  # type: ignore
+        outs = []
+        for r in range(len(cs)):
+            parts = []
+            for s in range(len(cs)):
+                data, sc = cs[s]
+                d = int(np.sum(sc[:r]))
+                parts.append(xp.asarray(data).reshape(-1)[d:d + sc[r]])
+            outs.append(xp.concatenate(parts) if parts else xp.zeros(0))
+        return outs
+
+    mine = _run(comm, payload, combine, f"Alltoallv@{comm.cid}")
+    if alloc:
+        return clone_like(sendbuf, mine)
+    assert_minlength(recvbuf, sum(rcounts))
+    write_flat(recvbuf, mine, sum(rcounts))
+    return recvbuf
+
+
+# ---------------------------------------------------------------------------
+# Reduce / Allreduce / Scan / Exscan / Reduce_scatter
+# ---------------------------------------------------------------------------
+
+def _parse_reduce_args(args, has_root: bool, name: str):
+    """Shared arg parsing: (send, [recv, [count,]] op, [root,] comm)."""
+    tail = 2 if has_root else 1
+    n = len(args)
+    comm = args[-1]
+    root = int(args[-2]) if has_root else None
+    op = args[-(tail + 1)]
+    head = args[:n - tail - 1]
+    if len(head) == 1:
+        sendbuf, recvbuf, count = head[0], None, None
+        alloc = not isinstance(sendbuf, _InPlace)
+    elif len(head) == 2:
+        sendbuf, recvbuf, count = head[0], head[1], None
+        alloc = False
+    elif len(head) == 3:
+        sendbuf, recvbuf, count = head
+        count = int(count)
+        alloc = False
+    else:
+        raise TypeError(f"{name}(send, [recv, [count,]] op, "
+                        + ("root, comm)" if has_root else "comm)"))
+    return sendbuf, recvbuf, count, as_op(op), root, comm, alloc
+
+
+def _reduce_family(args, has_root: bool, mode: str, name: str) -> Any:
+    sendbuf, recvbuf, count, op, root, comm, alloc = _parse_reduce_args(args, has_root, name)
+    rank, size = comm.rank(), comm.size()
+    scalar_in = np.isscalar(sendbuf) or isinstance(sendbuf, (int, float, complex, bool, np.generic))
+    inplace = isinstance(sendbuf, _InPlace)
+    if inplace:
+        if _is_none(recvbuf):
+            raise MPIError(f"IN_PLACE {name} needs a buffer")
+        sendbuf = recvbuf
+    if count is None:
+        count = element_count(sendbuf)
+    assert_minlength(sendbuf, count)
+    if recvbuf is not None and not _is_none(recvbuf) and not inplace:
+        assert_minlength(recvbuf, count)
+    payload = to_wire(sendbuf, count)
+
+    def combine(cs):
+        n = len(cs)
+        if mode == "reduce":
+            total = _reduce_arrays(cs, op)
+            return [total] * n
+        if mode == "scan":
+            outs, acc = [], None
+            for c in cs:
+                acc = c if acc is None else op(acc, c)
+                outs.append(acc)
+            return outs
+        if mode == "exscan":
+            outs, acc = [None], None
+            for c in cs[:-1]:
+                acc = c if acc is None else op(acc, c)
+                outs.append(acc)
+            return outs
+        raise AssertionError(mode)
+
+    result = _run(comm, payload, combine, f"{name}@{comm.cid}")
+    i_get_result = (not has_root) or rank == root
+    if mode == "exscan" and result is None:
+        # rank 0's Exscan output is undefined (src/collective.jl:834-855);
+        # leave buffers untouched, return the input unchanged.
+        if alloc:
+            return sendbuf if scalar_in else clone_like(sendbuf, np.asarray(sendbuf))
+        return recvbuf if not inplace else sendbuf
+    if not i_get_result:
+        return None if alloc else recvbuf
+    if alloc:
+        if scalar_in:
+            out = np.asarray(result)
+            return out.item() if out.ndim == 0 or out.size == 1 else out
+        shaped = _shape_result(result, sendbuf, count)
+        return clone_like(sendbuf, shaped)
+    target = sendbuf if inplace else recvbuf
+    write_flat(target, result, count)
+    return target
+
+
+def _shape_result(result: Any, like: Any, count: int) -> Any:
+    arr = extract_array(like)
+    if arr is not None and arr.size == count and np.asarray(result).size == count:
+        return np.asarray(result).reshape(arr.shape) if not type(result).__module__.startswith("jax") \
+            else result.reshape(arr.shape)
+    return result
+
+
+def Reduce(*args) -> Any:
+    """``Reduce(send, recv, [count,] op, root, comm)`` | ``Reduce(IN_PLACE,
+    buf, op, root, comm)`` | allocating ``Reduce(send, op, root, comm)``
+    (src/collective.jl:605-666). Result lands on root only."""
+    return _reduce_family(args, has_root=True, mode="reduce", name="Reduce")
+
+
+def Allreduce(*args) -> Any:
+    """``Allreduce(send, recv, [count,] op, comm)`` | ``Allreduce(IN_PLACE,
+    buf, op, comm)`` | allocating ``Allreduce(send, op, comm)``
+    (src/collective.jl:691-738). Deterministic rank-ordered reduction."""
+    return _reduce_family(args, has_root=False, mode="reduce", name="Allreduce")
+
+
+def Scan(*args) -> Any:
+    """Inclusive prefix reduction over ranks (src/collective.jl:760-808)."""
+    return _reduce_family(args, has_root=False, mode="scan", name="Scan")
+
+
+def Exscan(*args) -> Any:
+    """Exclusive prefix reduction; rank 0's result undefined
+    (src/collective.jl:834-882)."""
+    return _reduce_family(args, has_root=False, mode="exscan", name="Exscan")
+
+
+def Reduce_scatter(sendbuf: Any, recvbuf: Any, counts: Sequence[int], op: Any,
+                   comm: Comm) -> Any:
+    """Reduce then scatter by counts — absent from the reference (SURVEY.md
+    §2.3: trivially composable / native in XLA as psum_scatter); provided
+    natively here."""
+    rank, size = comm.rank(), comm.size()
+    op = as_op(op)
+    counts = [int(c) for c in counts]
+    total = sum(counts)
+    assert_minlength(sendbuf, total)
+    payload = to_wire(sendbuf, total)
+
+    def combine(cs):
+        red = _reduce_arrays(cs, op)
+        displs = np.concatenate([[0], np.cumsum(counts)])
+        return [red.reshape(-1)[displs[r]:displs[r] + counts[r]] for r in range(len(cs))]
+
+    mine = _run(comm, payload, combine, f"Reduce_scatter@{comm.cid}")
+    if recvbuf is None:
+        return clone_like(sendbuf, mine)
+    assert_minlength(recvbuf, counts[rank])
+    write_flat(recvbuf, mine, counts[rank])
+    return recvbuf
+
+
+def Reduce_scatter_block(sendbuf: Any, recvbuf: Any, op: Any, comm: Comm) -> Any:
+    """Equal-block Reduce_scatter (recvcount = sendcount / comm size)."""
+    size = comm.size()
+    n = element_count(sendbuf)
+    if n % size != 0:
+        raise MPIError(f"send count {n} not divisible by comm size {size}")
+    return Reduce_scatter(sendbuf, recvbuf, [n // size] * size, op, comm)

@@ -1,0 +1,279 @@
+"""Communicators: ordered device/rank groups bound to the SPMD world.
+
+Reference: /root/reference/src/comm.jl — Comm handle (:6), COMM_NULL/WORLD/SELF
+(:8-23), Comm_rank (:49-53), Comm_size (:66-70), Comm_dup (:78-84),
+Comm_split (:92-99), Comm_split_type (:107-115), Comm_get_parent (:123-127),
+Comm_spawn (:135-147), Intercomm_merge (:155-162), universe_size (:171-181),
+Comm_compare + Comparison enum (:197-218).
+
+TPU mapping (SURVEY.md §2.2): a Comm is an ordered subset of the world's ranks
+(each rank owning a device); ``Comm_split`` regroups ranks into sub-worlds. A
+communicator's *context id* (cid) isolates its point-to-point and collective
+traffic, allocated collectively on the parent so all members agree — the analog
+of MPI context ids that libmpi manages internally.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Sequence
+
+from ._runtime import UNDEFINED, CollectiveChannel, require_env
+from .error import InvalidCommError, MPIError
+
+
+class Comparison(enum.IntEnum):
+    """Result of Comm_compare (src/comm.jl:197-204)."""
+    IDENT = 0
+    CONGRUENT = 1
+    SIMILAR = 2
+    UNEQUAL = 3
+
+
+IDENT = Comparison.IDENT
+CONGRUENT = Comparison.CONGRUENT
+SIMILAR = Comparison.SIMILAR
+UNEQUAL = Comparison.UNEQUAL
+
+# Split type for Comm_split_type (src/comm.jl:107-115): ranks sharing a host.
+COMM_TYPE_SHARED = 1
+
+
+class Comm:
+    """An ordered group of ranks with an isolated communication context.
+
+    ``group[i]`` is the world rank of this communicator's rank i; the calling
+    rank's position defines ``Comm_rank``.
+    """
+
+    def __init__(self, group: Sequence[int], cid: int, *, ctx=None, name: str = "comm"):
+        self._group = tuple(group)
+        self._cid = cid
+        self._fixed_ctx = ctx
+        self.name = name
+        self._freed = False
+
+    # -- context / group resolution -----------------------------------------
+    @property
+    def ctx(self):
+        if self._fixed_ctx is not None:
+            return self._fixed_ctx
+        ctx, _ = require_env()
+        return ctx
+
+    @property
+    def group(self) -> tuple[int, ...]:
+        return self._group
+
+    @property
+    def cid(self) -> int:
+        return self._cid
+
+    def _check(self) -> None:
+        if self._freed:
+            raise InvalidCommError("operation on a freed communicator")
+
+    def rank(self) -> int:
+        self._check()
+        _, world_rank = require_env()
+        try:
+            return self._group.index(world_rank)
+        except ValueError:
+            raise InvalidCommError(
+                f"world rank {world_rank} is not a member of {self.name}") from None
+
+    def size(self) -> int:
+        self._check()
+        return len(self._group)
+
+    def world_rank_of(self, comm_rank: int) -> int:
+        """Translate a rank of this communicator to a world rank."""
+        return self._group[comm_rank]
+
+    def channel(self) -> CollectiveChannel:
+        """The collective rendezvous channel for this communicator."""
+        self._check()
+        return self.ctx.channel(self._cid, len(self._group))
+
+    @property
+    def device(self):
+        """The JAX device owned by the calling rank (SURVEY.md §2.3: buffers
+        are device-resident by construction; each rank binds one device)."""
+        ctx, world_rank = require_env()
+        return ctx.device_for(world_rank)
+
+    def py2f(self) -> int:
+        return self._cid
+
+    def __repr__(self) -> str:
+        return f"<Comm {self.name} cid={self._cid} size={len(self._group)}>"
+
+
+class _WorldComm(Comm):
+    """COMM_WORLD: all ranks of the ambient context, resolved dynamically so
+    the module-level constant works on every rank-thread (src/comm.jl:13-17)."""
+
+    def __init__(self):
+        super().__init__((), 0, name="COMM_WORLD")
+
+    @property
+    def group(self) -> tuple[int, ...]:
+        ctx, _ = require_env()
+        return tuple(range(ctx.size))
+
+    def rank(self) -> int:
+        _, world_rank = require_env()
+        return world_rank
+
+    def size(self) -> int:
+        ctx, _ = require_env()
+        return ctx.size
+
+    def world_rank_of(self, comm_rank: int) -> int:
+        return comm_rank
+
+    def channel(self) -> CollectiveChannel:
+        ctx, _ = require_env()
+        return ctx.channel(0, ctx.size)
+
+
+class _SelfComm(Comm):
+    """COMM_SELF: just the calling rank (src/comm.jl:19-23)."""
+
+    def __init__(self):
+        super().__init__((), 1, name="COMM_SELF")
+
+    @property
+    def group(self) -> tuple[int, ...]:
+        _, world_rank = require_env()
+        return (world_rank,)
+
+    def rank(self) -> int:
+        return 0
+
+    def size(self) -> int:
+        return 1
+
+    def world_rank_of(self, comm_rank: int) -> int:
+        _, world_rank = require_env()
+        return world_rank
+
+    def channel(self) -> CollectiveChannel:
+        ctx, world_rank = require_env()
+        # Per-rank channel: cid 1 is logically distinct per rank; key it so.
+        return ctx.channel((1, world_rank), 1)
+
+
+class _NullComm(Comm):
+    """COMM_NULL sentinel (src/comm.jl:8-11)."""
+
+    def __init__(self):
+        super().__init__((), -1, name="COMM_NULL")
+
+    def rank(self) -> int:
+        raise InvalidCommError("Comm_rank on COMM_NULL")
+
+    def size(self) -> int:
+        raise InvalidCommError("Comm_size on COMM_NULL")
+
+    def channel(self):
+        raise InvalidCommError("collective on COMM_NULL")
+
+
+COMM_WORLD = _WorldComm()
+COMM_SELF = _SelfComm()
+COMM_NULL = _NullComm()
+
+
+def Comm_rank(comm: Comm) -> int:
+    """Rank of the calling process in comm (src/comm.jl:49-53)."""
+    return comm.rank()
+
+
+def Comm_size(comm: Comm) -> int:
+    """Number of ranks in comm (src/comm.jl:66-70)."""
+    return comm.size()
+
+
+def Comm_dup(comm: Comm) -> Comm:
+    """Collective: duplicate comm with a fresh context id (src/comm.jl:78-84)."""
+    my_rank = comm.rank()
+    group = comm.group
+
+    def combine(contribs):
+        ctx = comm.ctx
+        cid = ctx.alloc_cid()
+        return [cid] * len(contribs)
+
+    cid = comm.channel().run(my_rank, None, combine, f"Comm_dup@{comm.cid}")
+    return Comm(group, cid, name=f"{comm.name}.dup")
+
+
+def Comm_split(comm: Comm, color: Optional[int], key: int) -> Comm:
+    """Collective: partition ranks by color, order by (key, rank)
+    (src/comm.jl:92-99). ``color=None`` (UNDEFINED) returns COMM_NULL."""
+    my_rank = comm.rank()
+    group = comm.group
+    c = UNDEFINED if color is None else int(color)
+
+    def combine(contribs):
+        ctx = comm.ctx
+        colors: dict[int, list[tuple[int, int]]] = {}
+        for r, (col, k) in enumerate(contribs):
+            if col != UNDEFINED:
+                colors.setdefault(col, []).append((k, r))
+        new_comms: dict[int, tuple[tuple[int, ...], int]] = {}
+        for col in sorted(colors):
+            members = [r for (_, r) in sorted(colors[col])]
+            new_group = tuple(group[r] for r in members)
+            new_comms[col] = (new_group, ctx.alloc_cid())
+        out = []
+        for r, (col, _) in enumerate(contribs):
+            out.append(None if col == UNDEFINED else new_comms[col])
+        return out
+
+    res = comm.channel().run(my_rank, (c, int(key)), combine, f"Comm_split@{comm.cid}")
+    if res is None:
+        return COMM_NULL
+    new_group, cid = res
+    return Comm(new_group, cid, name=f"{comm.name}.split({c})")
+
+
+def Comm_split_type(comm: Comm, split_type: int, key: int) -> Comm:
+    """Split into groups that can share memory (src/comm.jl:107-115).
+
+    All rank-threads of one controller process share an address space, so with
+    COMM_TYPE_SHARED every member lands in one group (per host in multi-process
+    mode, the backend supplies a host id)."""
+    if split_type != COMM_TYPE_SHARED:
+        return Comm_split(comm, None, key)
+    host_id = getattr(comm.ctx, "host_id", 0)
+    return Comm_split(comm, host_id, key)
+
+
+def Comm_compare(comm1: Comm, comm2: Comm) -> Comparison:
+    """Compare two communicators (src/comm.jl:197-218).
+
+    IDENT: same context; CONGRUENT: same members, same order; SIMILAR: same
+    members, different order; UNEQUAL otherwise.
+    """
+    if comm1 is comm2 or comm1.cid == comm2.cid:
+        return Comparison.IDENT
+    g1, g2 = comm1.group, comm2.group
+    if g1 == g2:
+        return Comparison.CONGRUENT
+    if sorted(g1) == sorted(g2):
+        return Comparison.SIMILAR
+    return Comparison.UNEQUAL
+
+
+def free(obj) -> None:
+    """Release a communicator/window/datatype (src/handle.jl:50, src/comm.jl).
+
+    No C resources back these objects; freeing just marks them unusable."""
+    if isinstance(obj, (_WorldComm, _SelfComm, _NullComm)):
+        raise MPIError("cannot free a builtin communicator")
+    if hasattr(obj, "_freed"):
+        obj._freed = True
+    elif hasattr(obj, "free"):
+        obj.free()

@@ -1,0 +1,163 @@
+"""Environment / lifecycle: Init, Finalize, Abort, thread levels, wall clock.
+
+Reference: /root/reference/src/environment.jl — Init (:80-89), Init_thread +
+ThreadLevel (:111-162), Query_thread (:173-180), Is_thread_main (:191-197),
+Finalize (:220-236), Abort (:252-254), Initialized/Finalized (:267-287),
+Wtick/Wtime (:289-295), has_cuda (:308-323).
+
+TPU-native mapping: there is no C library to spin up. ``Init`` attaches the
+calling rank-thread to the ambient :class:`~tpu_mpi._runtime.SpmdContext`
+(created by ``spmd_run``/``tpurun``); run standalone it creates a singleton
+world of size 1, exactly like running an MPI program without mpiexec. The
+reference's REFCOUNT machinery (src/environment.jl:26-62) exists to defer
+MPI_Finalize past C-object finalizers; with no C resources we keep only the
+init-once / finalize-once contract and the query functions.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import threading
+import time
+from typing import Optional
+
+from . import _runtime
+from ._runtime import SpmdContext, current_env, require_env, set_env
+from .error import AbortError, MPIError
+
+
+class ThreadLevel(enum.IntEnum):
+    """Thread support levels (src/environment.jl:111-116)."""
+    THREAD_SINGLE = 0
+    THREAD_FUNNELED = 1
+    THREAD_SERIALIZED = 2
+    THREAD_MULTIPLE = 3
+
+
+THREAD_SINGLE = ThreadLevel.THREAD_SINGLE
+THREAD_FUNNELED = ThreadLevel.THREAD_FUNNELED
+THREAD_SERIALIZED = ThreadLevel.THREAD_SERIALIZED
+THREAD_MULTIPLE = ThreadLevel.THREAD_MULTIPLE
+
+
+def Init() -> None:
+    """Initialize the environment on this rank (src/environment.jl:80-89).
+
+    Must be called exactly once per rank before any communication. Under
+    ``spmd_run``/``tpurun`` it attaches to the launcher's world; standalone it
+    creates a world of size 1.
+    """
+    Init_thread(ThreadLevel.THREAD_MULTIPLE)
+
+
+def Init_thread(required: ThreadLevel) -> ThreadLevel:
+    """Initialize requesting a thread level (src/environment.jl:148-162).
+
+    The host runtime is thread-safe by construction (it *is* threads), so the
+    granted level is always THREAD_MULTIPLE.
+    """
+    env = current_env()
+    if env is None:
+        ctx = SpmdContext(1)
+        set_env((ctx, 0))
+        env = (ctx, 0)
+    ctx, rank = env
+    if ctx.initialized[rank]:
+        raise MPIError("MPI.Init() was already called on this rank")
+    if ctx.finalized[rank]:
+        raise MPIError("MPI.Init() called after MPI.Finalize()")
+    ctx.initialized[rank] = True
+    ctx.thread_level[rank] = ThreadLevel(required)
+    ctx.main_threads[rank] = threading.get_ident()
+    return ThreadLevel.THREAD_MULTIPLE
+
+
+def Query_thread() -> ThreadLevel:
+    """Granted thread level (src/environment.jl:173-180)."""
+    require_env()
+    return ThreadLevel.THREAD_MULTIPLE
+
+
+def Is_thread_main() -> bool:
+    """True on the thread that called Init (src/environment.jl:191-197)."""
+    ctx, rank = require_env()
+    return ctx.main_threads[rank] == threading.get_ident()
+
+
+def Initialized() -> bool:
+    """Whether Init has been called on this rank (src/environment.jl:267-273)."""
+    env = current_env()
+    if env is None:
+        return False
+    ctx, rank = env
+    return ctx.initialized[rank]
+
+
+def Finalized() -> bool:
+    """Whether Finalize has been called on this rank (src/environment.jl:281-287)."""
+    env = current_env()
+    if env is None:
+        return False
+    ctx, rank = env
+    return ctx.finalized[rank]
+
+
+def Finalize() -> None:
+    """Tear down the environment on this rank (src/environment.jl:220-236).
+
+    After this, communication calls on this rank raise. Unlike the reference
+    there are no C finalizers to sequence, so no refcount dance is needed.
+    """
+    ctx, rank = require_env()
+    if not ctx.initialized[rank]:
+        raise MPIError("MPI.Finalize() before MPI.Init()")
+    if ctx.finalized[rank]:
+        raise MPIError("MPI.Finalize() was already called on this rank")
+    ctx.finalized[rank] = True
+
+
+def Abort(comm=None, errorcode: int = 1) -> None:
+    """Terminate the whole job (src/environment.jl:252-254).
+
+    Fate-shares: every rank blocked in the runtime raises AbortError. In the
+    multi-process launcher the process additionally exits with ``errorcode``.
+    """
+    env = current_env()
+    if env is None:
+        raise SystemExit(errorcode)
+    ctx, rank = env
+    err = AbortError(f"MPI.Abort called on rank {rank} with errorcode {errorcode}")
+    err.code = errorcode
+    ctx.fail(err, rank)
+    raise err
+
+
+def Wtime() -> float:
+    """High-resolution wall clock in seconds (src/environment.jl:295)."""
+    return time.perf_counter()
+
+
+def Wtick() -> float:
+    """Resolution of Wtime (src/environment.jl:289)."""
+    info = time.get_clock_info("perf_counter")
+    return info.resolution
+
+
+def universe_size() -> Optional[int]:
+    """Max processes the runtime can host (src/comm.jl:171-181 attribute)."""
+    ctx, _ = require_env()
+    return ctx.universe_size
+
+
+def has_tpu() -> bool:
+    """Whether a real TPU backend is attached (analog of has_cuda,
+    src/environment.jl:308-323, including the env-var override)."""
+    flag = os.environ.get("TPU_MPI_HAS_TPU")
+    if flag is not None:
+        return flag.lower() in ("1", "true", "yes")
+    try:
+        import jax
+        return any(d.platform not in ("cpu",) for d in jax.devices())
+    except Exception:
+        return False

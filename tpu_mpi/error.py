@@ -1,0 +1,51 @@
+"""Typed exception hierarchy.
+
+Reference: MPI.jl wraps every ccall in ``@mpichk`` and raises ``MPIError(code)``
+(/root/reference/src/error.jl:1-23). There is no C error-code table here — the
+TPU-native runtime raises typed Python exceptions directly, with an ``MPIError``
+root so user code can catch the whole family.
+"""
+
+from __future__ import annotations
+
+
+class MPIError(RuntimeError):
+    """Root of all framework errors (analog of MPI.jl's MPIError, src/error.jl:1-3)."""
+
+    def __init__(self, msg: str = "MPI error", code: int = 1):
+        super().__init__(msg)
+        self.code = code
+
+    def __str__(self) -> str:  # pretty-print like src/error.jl:21-23
+        return f"{self.args[0]} (code {self.code})"
+
+
+class AbortError(MPIError):
+    """Raised in every rank when the job is fate-shared down.
+
+    The reference's ``MPI.Abort`` kills the whole job (src/environment.jl:252-254)
+    and a single failing rank fails the run (test/runtests.jl:37-39). In the
+    threaded host runtime, failure is propagated by raising this in every rank
+    blocked in the runtime.
+    """
+
+
+class DeadlockError(MPIError):
+    """A blocking operation exceeded the runtime's deadlock timeout."""
+
+
+class TruncationError(MPIError):
+    """Receive buffer smaller than the incoming message (MPI_ERR_TRUNCATE)."""
+
+
+class CollectiveMismatchError(MPIError):
+    """Ranks of one communicator called different collectives in the same round.
+
+    The reference has no such check (libmpi would hang or corrupt); SURVEY.md §5
+    calls for a debug-mode sequence check — here it is always on, since the host
+    rendezvous sees every call.
+    """
+
+
+class InvalidCommError(MPIError):
+    """Operation on COMM_NULL or a freed communicator."""

@@ -1,0 +1,102 @@
+"""tpurun: the SPMD launcher (the mpiexecjl analog).
+
+Reference: /root/reference/bin/mpiexecjl (sh, :29-64) resolves the right
+mpiexec and forks N OS processes each running ``julia script.jl``; ranks are
+bound by libmpi at MPI_Init. TPU-native launch model (SURVEY.md §3.5):
+
+- single host: ONE controller process owning all devices runs the script on
+  N rank threads (rank i ↔ device i) — ``tpurun -n 4 script.py``;
+- CPU-sim: same, with ``--sim N`` forcing N fake XLA CPU devices — the
+  "cluster on a laptop" mode the reference gets from ``--oversubscribe``;
+- multi-host: one process per host over DCN (``tpu_mpi.backend``), each
+  launched with TPU_MPI_{NPROCS,RANK,COORD} set by the cluster scheduler.
+
+Each rank executes the script the way ``runpy`` runs ``__main__``, with its
+own module namespace; a nonzero exit of any rank fails the whole run
+(test/runtests.jl:37-39 semantics).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+from typing import Optional
+
+from ._runtime import spmd_run
+from .error import MPIError
+
+
+def _force_sim_devices(n: int) -> None:
+    """Force n fake XLA CPU devices; must run before JAX backend init."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    if os.environ.get("PALLAS_AXON_POOL_IPS") and "jax" in sys.modules:
+        import jax
+        import jax._src.xla_bridge as xb
+        jax.config.update("jax_platforms", "cpu")
+        xb._backend_factories.pop("axon", None)
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+
+def launch_script(path: str, nprocs: int, script_args: Optional[list[str]] = None,
+                  timeout: Optional[float] = None) -> None:
+    """Run a Python script as an SPMD program on nprocs rank threads."""
+    argv = [path] + list(script_args or [])
+
+    def rank_main() -> None:
+        old_argv = sys.argv
+        sys.argv = list(argv)
+        try:
+            runpy.run_path(path, run_name="__main__")
+        finally:
+            sys.argv = old_argv
+
+    spmd_run(rank_main, nprocs, timeout=timeout)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpurun",
+        description="Run an SPMD tpu_mpi program on N ranks (mpiexec analog)")
+    p.add_argument("-n", "--np", type=int,
+                   default=int(os.environ.get("TPU_MPI_NPROCS", "0")) or None,
+                   help="number of ranks (default: number of local devices)")
+    p.add_argument("--sim", type=int, default=None, metavar="N",
+                   help="simulate N XLA CPU devices (test mode)")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="abort the job after SECONDS")
+    p.add_argument("script", help="Python script to run on every rank")
+    p.add_argument("script_args", nargs=argparse.REMAINDER,
+                   help="arguments passed to the script")
+    args = p.parse_args(argv)
+
+    if args.sim is not None:
+        _force_sim_devices(args.sim)
+        if args.np is None:
+            args.np = args.sim
+    if args.np is None:
+        try:
+            import jax
+            args.np = len(jax.devices())
+        except Exception:
+            args.np = 1
+    try:
+        launch_script(args.script, args.np, args.script_args, timeout=args.timeout)
+    except SystemExit as e:
+        return int(e.code or 0)
+    except MPIError as e:
+        print(f"tpurun: job failed: {e}", file=sys.stderr)
+        return getattr(e, "code", 1) or 1
+    except BaseException as e:
+        print(f"tpurun: job failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,155 @@
+"""Reduction operators.
+
+Reference: /root/reference/src/operators.jl — Op handle (:20), predefined
+BAND/BOR/BXOR/LAND/LOR/LXOR/MAX/MIN/PROD/SUM/REPLACE/NO_OP (:22-37), dispatch
+mapping Julia functions to builtins (:39-45), custom OpWrapper via @cfunction +
+MPI_Op_create (:56-88).
+
+TPU mapping (SURVEY.md §2.2): ops are elementwise binary functions applied
+array-at-a-time. Custom ops are *strictly easier* here — any jittable binary
+function works both on the host path (applied to numpy/jax arrays directly)
+and in-graph (compiled into the XLA collective); no function-pointer machinery.
+"""
+
+from __future__ import annotations
+
+import operator as _pyop
+from typing import Any, Callable, Optional
+
+
+def _xp(a: Any):
+    """numpy-or-jax.numpy for a value (host path works on both array types)."""
+    mod = type(a).__module__
+    if mod.startswith("jax") or "Array" in type(a).__name__ and "jax" in mod:
+        import jax.numpy as jnp
+        return jnp
+    import numpy as np
+    return np
+
+
+def _is_jax(a: Any) -> bool:
+    return type(a).__module__.startswith("jax")
+
+
+class Op:
+    """A reduction operator: an elementwise binary function.
+
+    ``commutative`` matters only for documentation/assertions — the host path
+    always reduces in rank order (deterministic, and what Scan/Exscan require).
+    """
+
+    def __init__(self, fn: Callable[[Any, Any], Any], commutative: bool = False,
+                 name: Optional[str] = None):
+        self.fn = fn
+        self.commutative = commutative
+        self.name = name or getattr(fn, "__name__", "custom")
+
+    def __call__(self, a: Any, b: Any) -> Any:
+        try:
+            return self.fn(a, b)
+        except (TypeError, ValueError):
+            # Scalar-only user function: apply elementwise (the analog of
+            # OpWrapper's element loop, src/operators.jl:56-69).
+            import numpy as np
+            if _is_jax(a) or _is_jax(b):
+                import jax.numpy as jnp
+                a2, b2 = np.asarray(a), np.asarray(b)
+                return jnp.asarray(np.frompyfunc(self.fn, 2, 1)(a2, b2).astype(a2.dtype))
+            a2, b2 = np.asarray(a), np.asarray(b)
+            out = np.frompyfunc(self.fn, 2, 1)(a2, b2)
+            return out.astype(a2.dtype) if a2.dtype.kind != "O" else out
+
+    def __repr__(self) -> str:
+        return f"<Op {self.name}>"
+
+
+def _sum(a, b):
+    return a + b
+
+
+def _prod(a, b):
+    return a * b
+
+
+def _min(a, b):
+    return _xp(a).minimum(a, b)
+
+
+def _max(a, b):
+    return _xp(a).maximum(a, b)
+
+
+def _land(a, b):
+    xp = _xp(a)
+    out = xp.logical_and(a != 0, b != 0)
+    return out.astype(getattr(a, "dtype", None)) if hasattr(a, "dtype") else type(a)(out)
+
+
+def _lor(a, b):
+    xp = _xp(a)
+    out = xp.logical_or(a != 0, b != 0)
+    return out.astype(getattr(a, "dtype", None)) if hasattr(a, "dtype") else type(a)(out)
+
+
+def _lxor(a, b):
+    xp = _xp(a)
+    out = xp.logical_xor(a != 0, b != 0)
+    return out.astype(getattr(a, "dtype", None)) if hasattr(a, "dtype") else type(a)(out)
+
+
+def _band(a, b):
+    return a & b
+
+
+def _bor(a, b):
+    return a | b
+
+
+def _bxor(a, b):
+    return a ^ b
+
+
+def _replace(a, b):
+    return b
+
+
+def _no_op(a, b):
+    return a
+
+
+SUM = Op(_sum, commutative=True, name="SUM")
+PROD = Op(_prod, commutative=True, name="PROD")
+MIN = Op(_min, commutative=True, name="MIN")
+MAX = Op(_max, commutative=True, name="MAX")
+LAND = Op(_land, commutative=True, name="LAND")
+LOR = Op(_lor, commutative=True, name="LOR")
+LXOR = Op(_lxor, commutative=True, name="LXOR")
+BAND = Op(_band, commutative=True, name="BAND")
+BOR = Op(_bor, commutative=True, name="BOR")
+BXOR = Op(_bxor, commutative=True, name="BXOR")
+REPLACE = Op(_replace, commutative=False, name="REPLACE")
+NO_OP = Op(_no_op, commutative=False, name="NO_OP")
+
+# Function → builtin Op dispatch (src/operators.jl:39-45 maps + * min max & | ⊻).
+_BUILTIN_MAP: dict[Any, Op] = {
+    _pyop.add: SUM,
+    _pyop.mul: PROD,
+    min: MIN,
+    max: MAX,
+    _pyop.and_: BAND,
+    _pyop.or_: BOR,
+    _pyop.xor: BXOR,
+    sum: SUM,
+}
+
+
+def as_op(op: Any) -> Op:
+    """Normalize a user-supplied operator: Op | known builtin fn | any callable."""
+    if isinstance(op, Op):
+        return op
+    mapped = _BUILTIN_MAP.get(op)
+    if mapped is not None:
+        return mapped
+    if callable(op):
+        return Op(op)
+    raise TypeError(f"not a reduction operator: {op!r}")

@@ -1,0 +1,47 @@
+"""Test substrate: run SPMD programs on simulated ranks in one process.
+
+Mirrors the reference's test driver (/root/reference/test/runtests.jl:28-45),
+which launches every test file under ``mpiexec -n N``; here each test body runs
+under :func:`tpu_mpi.spmd_run` on N rank-threads, with JAX on N fake XLA CPU
+devices (``--xla_force_host_platform_device_count``, SURVEY.md §3.5) so the
+same suite later runs unchanged on a real TPU slice.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ._runtime import spmd_run
+from . import environment
+
+
+DEFAULT_NPROCS = 4   # clamp(CPU_THREADS, 2, 4) in test/runtests.jl:20-21
+
+
+def mpi_main(fn: Callable[[], Any]) -> Callable[[], Any]:
+    """Wrap a test body in Init/Finalize like every reference test file."""
+    @functools.wraps(fn)
+    def body(*args: Any) -> Any:
+        environment.Init()
+        try:
+            return fn(*args)
+        finally:
+            if not environment.Finalized():
+                environment.Finalize()
+    return body
+
+
+def run_spmd(fn: Callable[[], Any], nprocs: int = DEFAULT_NPROCS, *,
+             init: bool = True, args: tuple = (),
+             timeout: Optional[float] = 120.0) -> list:
+    """Run fn as an SPMD program on nprocs ranks; Init/Finalize automatically."""
+    body = mpi_main(fn) if init else fn
+    return spmd_run(body, nprocs, args=args, timeout=timeout)
+
+
+def aeq(a: Any, b: Any) -> bool:
+    """Array equality across the array-type registry (numpy / jax / DeviceBuffer)."""
+    return bool(np.array_equal(np.asarray(a), np.asarray(b)))
