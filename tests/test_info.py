@@ -1,0 +1,42 @@
+"""Info tests (reference: test/test_info.jl)."""
+
+import pytest
+
+import tpu_mpi as MPI
+from tpu_mpi.info import Info, infoval
+
+
+def test_info_dict_behavior():
+    info = Info()
+    info["wdir"] = "/tmp"
+    info["nprocs"] = 4
+    info["flag"] = True
+    info["hosts"] = ["a", "b"]
+    assert info["wdir"] == "/tmp"
+    assert info["nprocs"] == "4"
+    assert info["flag"] == "true"
+    assert info["hosts"] == "a, b"
+    assert len(info) == 4
+    assert set(info) == {"wdir", "nprocs", "flag", "hosts"}
+    del info["flag"]
+    assert len(info) == 3
+    with pytest.raises(KeyError):
+        info["flag"]
+
+
+def test_info_validation():
+    info = Info()
+    with pytest.raises(MPI.MPIError):
+        info["ключ"] = "x"          # non-ASCII key
+    with pytest.raises(MPI.MPIError):
+        info["k" * 300] = "x"       # key too long
+    with pytest.raises(MPI.MPIError):
+        info["k"] = "v" * 2000      # value too long
+    assert infoval(False) == "false"
+
+
+def test_info_free():
+    info = Info({"a": 1})
+    info.free()
+    with pytest.raises(MPI.MPIError):
+        info["a"]

@@ -1,0 +1,267 @@
+"""Point-to-point tests (reference: test/test_sendrecv.jl)."""
+
+import numpy as np
+import pytest
+
+import tpu_mpi as MPI
+from tpu_mpi.testing import aeq, run_spmd
+
+
+def test_isend_irecv_ring(AT, nprocs):
+    # Ring exchange with tags (test_sendrecv.jl:17-40).
+    def body():
+        comm = MPI.COMM_WORLD
+        size = MPI.Comm_size(comm)
+        rank = MPI.Comm_rank(comm)
+        dst = (rank + 1) % size
+        src = (rank - 1) % size
+        N = 32
+        send_mesg = AT.full(N, float(rank))
+        recv_mesg = AT.zeros(N)
+        rreq = MPI.Irecv(recv_mesg, src, src + 32, comm)
+        sreq = MPI.Isend(send_mesg, dst, rank + 32, comm)
+        stats = MPI.Waitall([sreq, rreq])
+        assert isinstance(rreq, MPI.Request) and isinstance(sreq, MPI.Request)
+        assert MPI.Get_source(stats[1]) == src
+        assert MPI.Get_tag(stats[1]) == src + 32
+        assert aeq(recv_mesg, np.full(N, float(src)))
+        done, _ = MPI.Testall([sreq, rreq])
+        assert done
+
+    run_spmd(body, nprocs)
+
+
+def test_serialized_send_recv_chain(nprocs):
+    # send/recv of objects down a chain (test_sendrecv.jl:42-51).
+    def body():
+        comm = MPI.COMM_WORLD
+        size = MPI.Comm_size(comm)
+        rank = MPI.Comm_rank(comm)
+        dst = (rank + 1) % size
+        src = (rank - 1) % size
+        payload = {"rank": rank, "data": list(range(3))}
+        if rank == 0:
+            MPI.send(payload, dst, rank + 32, comm)
+            got = {"rank": src, "data": list(range(3))}
+        elif rank == size - 1:
+            got, _ = MPI.recv(src, src + 32, comm)
+        else:
+            got, _ = MPI.recv(src, src + 32, comm)
+            MPI.send(payload, dst, rank + 32, comm)
+        assert got == {"rank": src, "data": [0, 1, 2]}
+
+    run_spmd(body, nprocs)
+
+
+def test_typed_scalar_send_recv(nprocs):
+    # Send/Recv of isbits scalars (test_sendrecv.jl:54-63).
+    def body():
+        comm = MPI.COMM_WORLD
+        size = MPI.Comm_size(comm)
+        rank = MPI.Comm_rank(comm)
+        dst = (rank + 1) % size
+        src = (rank - 1) % size
+        if rank == 0:
+            MPI.Send(float(rank), dst, rank + 32, comm)
+            recv_val = float(src)
+        elif rank == size - 1:
+            recv_val, _ = MPI.Recv(float, src, src + 32, comm)
+        else:
+            recv_val, _ = MPI.Recv(float, src, src + 32, comm)
+            MPI.Send(float(rank), dst, rank + 32, comm)
+        assert recv_val == float(src)
+
+    run_spmd(body, nprocs)
+
+
+def test_waitsome_then_test(AT, nprocs):
+    # Waitsome + Test (test_sendrecv.jl:66-74).
+    def body():
+        comm = MPI.COMM_WORLD
+        size = MPI.Comm_size(comm)
+        rank = MPI.Comm_rank(comm)
+        dst = (rank + 1) % size
+        src = (rank - 1) % size
+        recv_mesg = AT.zeros(8)
+        rreq = MPI.Irecv(recv_mesg, src, src + 32, comm)
+        sreq = MPI.Isend(AT.full(8, float(rank)), dst, rank + 32, comm)
+        reqs = [sreq, rreq]
+        inds, stats = MPI.Waitsome(reqs)
+        assert len(inds) >= 1
+        for i in inds:
+            done, _ = MPI.Test(reqs[i])
+            assert done
+        MPI.Waitall(reqs)
+
+    run_spmd(body, nprocs)
+
+
+def test_waitany_deactivates_requests(nprocs):
+    # A consumed request must not be returned again (MPI_REQUEST_NULL
+    # semantics); draining N completions yields N distinct indices.
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        if rank == 0:
+            bufs = [np.zeros(1, dtype=np.int64) for _ in range(2)]
+            reqs = [MPI.Irecv(bufs[i], 1, i, comm) for i in range(2)]
+            seen = set()
+            for _ in range(2):
+                i, st = MPI.Waitany(reqs)
+                seen.add(i)
+            assert seen == {0, 1}
+            # all inactive now
+            assert MPI.Waitany(reqs) == (None, MPI.STATUS_EMPTY)
+            assert MPI.Waitsome(reqs) == ([], [])
+            found, idx, _ = MPI.Testany(reqs)
+            assert found and idx is None
+            assert sorted(int(b[0]) for b in bufs) == [10, 11]
+        elif rank == 1:
+            for i in range(2):
+                MPI.Send(np.array([10 + i]), 0, i, comm)
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs)
+
+
+def test_proc_null_everywhere(nprocs):
+    # PROC_NULL short-circuits every receive/probe flavor (MPI semantics;
+    # needed by non-periodic Cart_shift boundaries).
+    def body():
+        comm = MPI.COMM_WORLD
+        buf = np.zeros(2)
+        st = MPI.Recv(buf, MPI.PROC_NULL, 0, comm)
+        assert st.source == MPI.PROC_NULL
+        obj, st = MPI.recv(MPI.PROC_NULL, 0, comm)
+        assert obj is None and st.source == MPI.PROC_NULL
+        flag, obj, st = MPI.irecv(MPI.PROC_NULL, 0, comm)
+        assert flag and obj is None
+        assert MPI.Probe(MPI.PROC_NULL, 0, comm).source == MPI.PROC_NULL
+        flag, st = MPI.Iprobe(MPI.PROC_NULL, 0, comm)
+        assert flag
+        MPI.Send(buf, MPI.PROC_NULL, 0, comm)
+        req = MPI.Isend(buf, MPI.PROC_NULL, 0, comm)
+        MPI.Wait(req)
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs)
+
+
+def test_cancel(AT, nprocs):
+    # Cancel a never-matched receive (test_sendrecv.jl:76-79).
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        recv_mesg = AT.zeros(8)
+        rreq = MPI.Irecv(recv_mesg, rank, 12345, comm)
+        MPI.Cancel(rreq)
+        MPI.Wait(rreq)
+        assert rreq.buffer is None
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs)
+
+
+def test_sendrecv_cart_shift(nprocs):
+    # Left shift through a periodic 1-d Cartesian topology with views
+    # (test_sendrecv.jl:100-133).
+    def body():
+        comm = MPI.COMM_WORLD
+        comm_rank = MPI.Comm_rank(comm)
+        comm_size = MPI.Comm_size(comm)
+        a = np.array([comm_rank, comm_rank, comm_rank], dtype=np.float64)
+
+        comm_cart = MPI.Cart_create(comm, 1, [comm_size], [1], False)
+        src_rank, dest_rank = MPI.Cart_shift(comm_cart, 0, -1)
+
+        # shift the first element left into the last slot, via views
+        MPI.Sendrecv(a[0:1], dest_rank, 0, a[2:3], src_rank, 0, comm_cart)
+        assert aeq(a, [comm_rank, comm_rank, (comm_rank + 1) % comm_size])
+
+        # partial-buffer views
+        a = np.array([comm_rank] * 3, dtype=np.float64)
+        b = np.array([-1.0, -1.0, -1.0])
+        MPI.Sendrecv(a[0:2], dest_rank, 1, b[0:2], src_rank, 1, comm_cart)
+        assert aeq(b, [(comm_rank + 1) % comm_size] * 2 + [-1.0])
+
+        # whole buffers
+        a = np.array([comm_rank] * 3, dtype=np.float64)
+        b = np.array([-1.0, -1.0, -1.0])
+        MPI.Sendrecv(a, dest_rank, 2, b, src_rank, 2, comm_cart)
+        assert aeq(b, [(comm_rank + 1) % comm_size] * 3)
+
+    run_spmd(body, nprocs)
+
+
+def test_any_source_any_tag_probe(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        size = MPI.Comm_size(comm)
+        if rank == 0:
+            got = set()
+            for _ in range(size - 1):
+                st = MPI.Probe(MPI.ANY_SOURCE, MPI.ANY_TAG, comm)
+                n = MPI.Get_count(st, np.int64)
+                buf = np.zeros(n, dtype=np.int64)
+                st2 = MPI.Recv(buf, st.source, st.tag, comm)
+                assert st2.source == st.source
+                got.add((st2.source, st2.tag, int(buf[0])))
+            assert got == {(r, 100 + r, r * 10) for r in range(1, size)}
+        else:
+            MPI.Send(np.full(rank, rank * 10, dtype=np.int64), 0, 100 + rank, comm)
+
+    run_spmd(body, nprocs)
+
+
+def test_nonovertaking_order(nprocs):
+    # Messages from one source with the same tag arrive in order.
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        if rank == 1:
+            for i in range(10):
+                MPI.Send(np.array([i]), 0, 7, comm)
+        elif rank == 0:
+            for i in range(10):
+                buf = np.zeros(1, dtype=np.int64)
+                MPI.Recv(buf, 1, 7, comm)
+                assert buf[0] == i
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs)
+
+
+def test_truncation_error(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        if rank == 0:
+            MPI.Send(np.arange(8, dtype=np.float64), 1, 3, comm)
+        elif rank == 1:
+            small = np.zeros(4)
+            with pytest.raises(MPI.TruncationError):
+                MPI.Recv(small, 0, 3, comm)
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs)
+
+
+def test_iprobe_and_irecv_object(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        if rank == 0:
+            flag, obj, st = MPI.irecv(1, 5, comm)
+            # may or may not have arrived yet
+            while not flag:
+                flag, obj, st = MPI.irecv(1, 5, comm)
+            assert obj == "hello"
+            assert st.source == 1
+        elif rank == 1:
+            ok, _ = MPI.Iprobe(0, 99, comm)
+            assert not ok
+            MPI.send("hello", 0, 5, comm)
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs)

@@ -1,0 +1,118 @@
+"""Cartesian topology tests (reference: test/test_cart_*.jl, test_dims_create.jl)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import tpu_mpi as MPI
+from tpu_mpi.testing import run_spmd
+
+
+def test_dims_create():
+    # Balanced factorizations (test_dims_create.jl:9-21).
+    assert math.prod(MPI.Dims_create(8, [0, 0, 0])) == 8
+    assert sorted(MPI.Dims_create(8, [0, 0, 0])) == [2, 2, 2]
+    assert MPI.Dims_create(6, [0, 0]) in ([3, 2], [2, 3])
+    assert MPI.Dims_create(4, [2, 0]) == [2, 2]
+    assert MPI.Dims_create(7, [0]) == [7]
+    assert math.prod(MPI.Dims_create(12, [0, 0])) == 12
+    with pytest.raises(MPI.MPIError):
+        MPI.Dims_create(7, [2, 0])
+
+
+def test_cart_create_coords_rank(nprocs):
+    # (test_cart_create.jl, test_cart_coords.jl, test_cart_rank.jl)
+    def body():
+        comm = MPI.COMM_WORLD
+        nnodes = MPI.Comm_size(comm)
+        dims = MPI.Dims_create(nnodes, [0, 0])
+        cart = MPI.Cart_create(comm, dims, [0, 1], True)
+        assert MPI.Comm_size(cart) == nnodes
+        assert MPI.Cartdim_get(cart) == 2
+
+        rank = MPI.Comm_rank(cart)
+        coords = MPI.Cart_coords(cart)
+        assert all(0 <= c < d for c, d in zip(coords, dims))
+        assert MPI.Cart_rank(cart, coords) == rank
+
+        # round-trip every rank
+        for r in range(nnodes):
+            assert MPI.Cart_rank(cart, MPI.Cart_coords(cart, r)) == r
+
+        gdims, gperiods, gcoords = MPI.Cart_get(cart)
+        assert gdims == list(dims)
+        assert gperiods == [0, 1]
+        assert gcoords == coords
+        MPI.free(cart)
+
+    run_spmd(body, nprocs)
+
+
+def test_cart_shift(nprocs):
+    # (test_cart_shift.jl:13-19)
+    def body():
+        comm = MPI.COMM_WORLD
+        size = MPI.Comm_size(comm)
+        # periodic ring
+        ring = MPI.Cart_create(comm, [size], [1], False)
+        rank = MPI.Comm_rank(ring)
+        src, dest = MPI.Cart_shift(ring, 0, 1)
+        assert dest == (rank + 1) % size
+        assert src == (rank - 1) % size
+        # non-periodic line: boundaries get PROC_NULL
+        line = MPI.Cart_create(comm, [size], [0], False)
+        src, dest = MPI.Cart_shift(line, 0, 1)
+        assert dest == (MPI.PROC_NULL if rank == size - 1 else rank + 1)
+        assert src == (MPI.PROC_NULL if rank == 0 else rank - 1)
+
+    run_spmd(body, nprocs)
+
+
+def test_cart_sub(nprocs):
+    # (test_cart_create.jl:24-32)
+    def body():
+        comm = MPI.COMM_WORLD
+        nnodes = MPI.Comm_size(comm)
+        dims = MPI.Dims_create(nnodes, [0, 0])
+        cart = MPI.Cart_create(comm, dims, [0, 0], False)
+        sub_rows = MPI.Cart_sub(cart, [False, True])
+        assert MPI.Comm_size(sub_rows) == dims[1]
+        sub_cols = MPI.Cart_sub(cart, [True, False])
+        assert MPI.Comm_size(sub_cols) == dims[0]
+        # sub-comm rank matches the kept coordinate
+        assert MPI.Comm_rank(sub_rows) == MPI.Cart_coords(cart)[1]
+        assert MPI.Comm_rank(sub_cols) == MPI.Cart_coords(cart)[0]
+
+    run_spmd(body, nprocs)
+
+
+def test_cart_halo_allreduce_combo(nprocs):
+    # 2-d halo exchange then a grid allreduce — the stencil pattern
+    # (SURVEY.md §2.5 halo row).
+    def body():
+        comm = MPI.COMM_WORLD
+        size = MPI.Comm_size(comm)
+        dims = MPI.Dims_create(size, [0, 0])
+        cart = MPI.Cart_create(comm, dims, [1, 1], False)
+        rank = MPI.Comm_rank(cart)
+        interior = np.full(4, float(rank))
+        # exchange along each dim, accumulate neighbor values
+        acc = 0.0
+        for d in range(2):
+            src, dest = MPI.Cart_shift(cart, d, 1)
+            halo = np.zeros(4)
+            MPI.Sendrecv(interior, dest, d, halo, src, d, cart)
+            acc += float(halo[0])
+        total = MPI.Allreduce(acc, MPI.SUM, cart)
+        # every rank contributed each of its 2 neighbors' values once
+        expect = 0.0
+        for r in range(size):
+            coords = MPI.Cart_coords(cart, r)
+            for d in range(2):
+                nb = list(coords)
+                nb[d] = (nb[d] - 1) % dims[d]
+                expect += MPI.Cart_rank(cart, nb)
+        assert total == pytest.approx(expect)
+
+    run_spmd(body, nprocs)

@@ -32,6 +32,7 @@ from .comm import (COMM_NULL, COMM_SELF, COMM_TYPE_SHARED, COMM_WORLD,
                    SIMILAR, UNEQUAL, free)
 
 # Object model
+from .info import INFO_NULL, Info, infoval
 from .buffers import (BUFFER_NULL, Buffer, Buffer_send, DeviceBuffer, IN_PLACE,
                       assert_minlength)
 from .datatypes import (BFLOAT16, BOOL, BYTE, CHAR, COMPLEX64, COMPLEX128,
@@ -46,3 +47,15 @@ from .collective import (Allgather, Allgatherv, Allreduce, Alltoall,
                          Alltoallv, Barrier, Bcast, Exscan, Gather, Gatherv,
                          Reduce, Reduce_scatter, Reduce_scatter_block, Scan,
                          Scatter, Scatterv, bcast)
+
+# Point-to-point (src/pointtopoint.jl)
+from .pointtopoint import (Cancel, Get_count, Get_error, Get_source, Get_tag,
+                           Iprobe, Irecv, Isend, Probe, Recv, Request,
+                           REQUEST_NULL, Send, Sendrecv, Status, STATUS_EMPTY,
+                           Test, Testall, Testany, Testsome, Wait, Waitall,
+                           Waitany, Waitsome, irecv, isend, recv, send)
+
+# Topology (src/topology.jl)
+from .topology import (Cart_coords, Cart_create, Cart_get, Cart_rank,
+                       Cart_shift, Cart_sub, CartComm, Cartdim_get,
+                       Dims_create)

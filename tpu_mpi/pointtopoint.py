@@ -1,0 +1,430 @@
+"""Point-to-point messaging: Send/Recv, nonblocking requests, probes, waits.
+
+Reference: /root/reference/src/pointtopoint.jl — Status (:5-79), Request
+(:96-99), Probe (:121-127), Iprobe (:138-148), Get_count (:160-167), Send
+(:179-200), serialized send (:208-211), Isend (:226-252), Recv!/Recv/recv
+(:271-318), Irecv!/irecv (:333-358), Sendrecv! (:376-393), Wait!/Test!/
+Waitall!/Testall!/Waitany!/Testany!/Waitsome!/Testsome!/Cancel! (:404-681).
+
+TPU mapping (SURVEY.md §2.3): the *semantic* path runs through the host
+matching engine (tpu_mpi._runtime.Mailbox) — tags, ANY_SOURCE/ANY_TAG,
+non-overtaking order, Probe on unexpected messages, all the dynamic behavior
+XLA's static SPMD model cannot express. Sends are buffered (snapshot at post
+time; device arrays are immutable so the reference *is* the snapshot) and
+complete immediately; receives are matched by the engine and complete on
+Wait/Test in the receiving rank's thread, which also owns device placement.
+The compiled neighbor-exchange path (``ppermute``-shaped, static patterns)
+lives in ``tpu_mpi.xla``.
+
+Indices returned by Waitany/Waitsome are 0-based (Python), where the
+reference's are 1-based (Julia).
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Optional, Sequence
+
+from ._runtime import (ANY_SOURCE, ANY_TAG, PROC_NULL, Message, PendingRecv,
+                       require_env)
+from .buffers import element_count, to_wire, write_flat
+from .comm import Comm
+from .datatypes import Datatype, to_datatype
+from .error import MPIError, TruncationError
+
+_POLL = 0.001
+
+
+class Status:
+    """Completion metadata of a receive (src/pointtopoint.jl:5-79)."""
+
+    __slots__ = ("source", "tag", "error", "count", "dtype")
+
+    def __init__(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+                 error: int = 0, count: int = 0, dtype: Any = None):
+        self.source = source
+        self.tag = tag
+        self.error = error
+        self.count = count
+        self.dtype = dtype
+
+    def __repr__(self) -> str:
+        return f"Status(source={self.source}, tag={self.tag}, count={self.count})"
+
+
+STATUS_EMPTY = Status()
+
+
+def Get_source(status: Status) -> int:
+    return status.source
+
+
+def Get_tag(status: Status) -> int:
+    return status.tag
+
+
+def Get_error(status: Status) -> int:
+    return status.error
+
+
+def Get_count(status: Status, T: Any = None) -> int:
+    """Element count of the message in units of T (src/pointtopoint.jl:160-167)."""
+    if T is None or status.dtype is None:
+        return status.count
+    want = to_datatype(T)
+    have = status.dtype
+    nbytes = status.count * have.size_bytes
+    return nbytes // want.size_bytes
+
+
+def _status_of(msg: Message) -> Status:
+    return Status(source=msg.src, tag=msg.tag, count=msg.count, dtype=msg.dtype)
+
+
+class Request:
+    """Handle for a nonblocking operation (src/pointtopoint.jl:96-99).
+
+    Holds a reference to the live buffer (the reference roots it against GC;
+    here it also marks where a completed receive must be delivered). A send
+    request is complete at creation (buffered send). REQUEST_NULL is modeled
+    by a fresh inactive Request.
+    """
+
+    __slots__ = ("kind", "buffer", "status", "_pending", "_mailbox", "_count",
+                 "_done", "_inactive")
+
+    def __init__(self, kind: str = "null", buffer: Any = None,
+                 pending: Optional[PendingRecv] = None, mailbox=None,
+                 count: Optional[int] = None, status: Optional[Status] = None):
+        self.kind = kind              # "send" | "recv" | "null"
+        self.buffer = buffer
+        self.status = status
+        self._pending = pending
+        self._mailbox = mailbox
+        self._count = count
+        self._done = kind in ("send", "null")
+        # True once the completion has been surfaced to the caller: the
+        # request then behaves like MPI_REQUEST_NULL (libmpi writes the null
+        # handle back on completion; Waitany/Waitsome must not return it again).
+        self._inactive = kind == "null"
+
+    # -- completion machinery ------------------------------------------------
+    def _deliver(self) -> None:
+        """Move a matched message into the user buffer (receiver's thread)."""
+        pr = self._pending
+        assert pr is not None and pr.msg is not None
+        msg = pr.msg
+        if self.buffer is not None:
+            n = element_count(self.buffer)
+            if msg.count > (self._count if self._count is not None else n):
+                raise TruncationError(
+                    f"message of {msg.count} elements truncated to {n}")
+            write_flat(self.buffer, msg.payload, msg.count)
+        self.status = _status_of(msg)
+        self._done = True
+
+    def test(self) -> bool:
+        """Nonblocking completion check; delivers on match."""
+        if self._done:
+            return True
+        if self.kind == "recv":
+            assert self._mailbox is not None and self._pending is not None
+            if self._mailbox.test_recv(self._pending):
+                if self._pending.cancelled and self._pending.msg is None:
+                    self.buffer = None
+                    self.status = STATUS_EMPTY
+                    self._done = True
+                else:
+                    self._deliver()
+                return True
+            return False
+        return self._done
+
+    def wait(self) -> Status:
+        """Block until complete; delivers the payload."""
+        if self._inactive:
+            return self.status or STATUS_EMPTY
+        if not self._done and self.kind == "recv":
+            assert self._mailbox is not None and self._pending is not None
+            msg = self._mailbox.wait_recv(self._pending)
+            if msg is None:          # cancelled (src/pointtopoint.jl:677-681)
+                self.buffer = None
+                self.status = STATUS_EMPTY
+                self._done = True
+            else:
+                self._deliver()
+        self._done = True
+        return self._consume()
+
+    def _consume(self) -> Status:
+        """Surface the completion: clear the buffer root, go inactive."""
+        st = self.status or STATUS_EMPTY
+        self.buffer = None           # request deallocation clears the root
+        self._inactive = True
+        return st
+
+    @property
+    def active(self) -> bool:
+        return not self._inactive
+
+    def cancel(self) -> None:
+        if self.kind == "recv" and not self._done:
+            assert self._mailbox is not None and self._pending is not None
+            self._mailbox.cancel(self._pending)
+
+    def __repr__(self) -> str:
+        return f"<Request {self.kind} done={self._done}>"
+
+
+REQUEST_NULL = Request()
+
+
+def _resolve(comm: Comm, comm_rank: int) -> int:
+    return comm.world_rank_of(comm_rank)
+
+
+def _my_mailbox(comm: Comm):
+    ctx, world_rank = require_env()
+    return ctx.mailboxes[world_rank]
+
+
+def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
+          dtype: Optional[Datatype], kind: str) -> None:
+    ctx, _ = require_env()
+    ctx.check_failure()
+    my_rank = comm.rank()
+    msg = Message(my_rank, int(tag), comm.cid, payload, count, dtype, kind)
+    ctx.mailboxes[_resolve(comm, dest)].post(msg)
+
+
+# ---------------------------------------------------------------------------
+# Blocking / nonblocking send
+# ---------------------------------------------------------------------------
+
+def Send(buf: Any, dest: int, tag: int, comm: Comm) -> None:
+    """Blocking typed send (src/pointtopoint.jl:179-200); scalars welcome.
+
+    Buffered-send semantics: the payload is snapshotted at call time and the
+    call returns immediately (libmpi may do the same for small messages)."""
+    if dest == PROC_NULL:
+        return
+    count = element_count(buf)
+    arr = to_wire(buf, count)
+    _post(comm, dest, tag, arr, count, to_datatype(arr.dtype), "typed")
+
+
+def Isend(buf: Any, dest: int, tag: int, comm: Comm) -> Request:
+    """Nonblocking send (src/pointtopoint.jl:226-239); completes immediately."""
+    if dest == PROC_NULL:
+        return Request("null", status=STATUS_EMPTY)
+    Send(buf, dest, tag, comm)
+    return Request("send", buffer=buf, status=STATUS_EMPTY)
+
+
+def send(obj: Any, dest: int, tag: int, comm: Comm) -> None:
+    """Serialized-object send (src/pointtopoint.jl:208-211)."""
+    if dest == PROC_NULL:
+        return
+    try:
+        data = pickle.dumps(obj)
+        _post(comm, dest, tag, data, len(data), None, "object")
+    except Exception:
+        # In-process transport: unpicklable objects travel by reference.
+        _post(comm, dest, tag, obj, 0, None, "objref")
+
+
+def isend(obj: Any, dest: int, tag: int, comm: Comm) -> Request:
+    """Nonblocking serialized send (src/pointtopoint.jl:249-252)."""
+    send(obj, dest, tag, comm)
+    return Request("send", status=STATUS_EMPTY)
+
+
+# ---------------------------------------------------------------------------
+# Blocking / nonblocking receive
+# ---------------------------------------------------------------------------
+
+def Recv(buf_or_type: Any, src: int, tag: int, comm: Comm):
+    """``Recv(buf, src, tag, comm) -> Status`` fills an existing buffer
+    (ref ``Recv!`` :271-281); ``Recv(T, src, tag, comm) -> (value, Status)``
+    receives one scalar of type T (:296-302)."""
+    if isinstance(buf_or_type, type) or isinstance(buf_or_type, Datatype):
+        import numpy as np
+        dt = to_datatype(buf_or_type)
+        tmp = np.zeros(1, dtype=dt.np_dtype)
+        st = Recv(tmp, src, tag, comm)
+        return (tmp[0].item() if dt.np_dtype.fields is None else tmp[0]), st
+    if src == PROC_NULL:
+        return Status(source=PROC_NULL, tag=ANY_TAG, count=0)
+    req = Irecv(buf_or_type, src, tag, comm)
+    return req.wait()
+
+
+def Irecv(buf: Any, src: int, tag: int, comm: Comm) -> Request:
+    """Nonblocking receive into buf (ref ``Irecv!`` :333-346)."""
+    if src == PROC_NULL:
+        return Request("null", status=Status(source=PROC_NULL, tag=ANY_TAG))
+    mb = _my_mailbox(comm)
+    pr = mb.post_recv(int(src), int(tag), comm.cid)
+    return Request("recv", buffer=buf, pending=pr, mailbox=mb,
+                   count=element_count(buf))
+
+
+def recv(src: int, tag: int, comm: Comm):
+    """Blocking serialized-object receive -> (obj, Status)
+    (src/pointtopoint.jl:312-318, via Probe + Get_count)."""
+    if src == PROC_NULL:
+        return None, Status(source=PROC_NULL, tag=ANY_TAG, count=0)
+    mb = _my_mailbox(comm)
+    pr = mb.post_recv(int(src), int(tag), comm.cid)
+    msg = mb.wait_recv(pr)
+    assert msg is not None
+    return _object_of(msg), _status_of(msg)
+
+
+def irecv(src: int, tag: int, comm: Comm):
+    """Nonblocking object receive -> (flag, obj|None, Status|None)
+    (src/pointtopoint.jl:349-358, via Iprobe)."""
+    if src == PROC_NULL:
+        return (True, None, Status(source=PROC_NULL, tag=ANY_TAG, count=0))
+    mb = _my_mailbox(comm)
+    msg = mb.probe(int(src), int(tag), comm.cid, block=False)
+    if msg is None:
+        return (False, None, None)
+    pr = mb.post_recv(msg.src, msg.tag, comm.cid)
+    got = mb.wait_recv(pr)
+    assert got is not None
+    return (True, _object_of(got), _status_of(got))
+
+
+def _object_of(msg: Message) -> Any:
+    if msg.kind == "object":
+        return pickle.loads(msg.payload)
+    if msg.kind == "objref":
+        return msg.payload
+    raise MPIError("typed message received with object API; use Recv")
+
+
+def Sendrecv(sendbuf: Any, dest: int, sendtag: int,
+             recvbuf: Any, src: int, recvtag: int, comm: Comm) -> Status:
+    """Combined send+receive (ref ``Sendrecv!`` :376-393); safe against
+    head-of-line blocking because sends are buffered."""
+    rreq = Irecv(recvbuf, src, recvtag, comm) if src != PROC_NULL else None
+    Send(sendbuf, dest, sendtag, comm)
+    if rreq is None:
+        return Status(source=PROC_NULL, tag=ANY_TAG, count=0)
+    return rreq.wait()
+
+
+# ---------------------------------------------------------------------------
+# Probe
+# ---------------------------------------------------------------------------
+
+def Probe(src: int, tag: int, comm: Comm) -> Status:
+    """Block until a matching message is enqueued (src/pointtopoint.jl:121-127)."""
+    if src == PROC_NULL:
+        return Status(source=PROC_NULL, tag=ANY_TAG, count=0)
+    mb = _my_mailbox(comm)
+    msg = mb.probe(int(src), int(tag), comm.cid, block=True)
+    assert msg is not None
+    return _status_of(msg)
+
+
+def Iprobe(src: int, tag: int, comm: Comm):
+    """Nonblocking probe -> (flag, Status|None) (src/pointtopoint.jl:138-148)."""
+    if src == PROC_NULL:
+        return (True, Status(source=PROC_NULL, tag=ANY_TAG, count=0))
+    mb = _my_mailbox(comm)
+    msg = mb.probe(int(src), int(tag), comm.cid, block=False)
+    if msg is None:
+        return (False, None)
+    return (True, _status_of(msg))
+
+
+# ---------------------------------------------------------------------------
+# Completion: Wait/Test families (src/pointtopoint.jl:404-681)
+# ---------------------------------------------------------------------------
+
+def Wait(req: Request) -> Status:
+    """Block until req completes (ref ``Wait!`` :404-416)."""
+    return req.wait()
+
+
+def Test(req: Request):
+    """(done, Status|None) without blocking (ref ``Test!`` :426-442).
+    An inactive (already-consumed / null) request tests as done with an
+    empty status, like MPI_REQUEST_NULL."""
+    if not req.active:
+        return (True, req.status or STATUS_EMPTY)
+    if req.test():
+        return (True, req._consume())
+    return (False, None)
+
+
+def Waitall(reqs: Sequence[Request]) -> list[Status]:
+    """Block until all complete (ref ``Waitall!`` :453-471)."""
+    return [r.wait() for r in reqs]
+
+
+def Testall(reqs: Sequence[Request]):
+    """(all_done, [Status]) — only consumes requests if all are done
+    (ref ``Testall!`` :484-506)."""
+    if all((not r.active) or r.test() for r in reqs):
+        return (True, [r._consume() if r.active else (r.status or STATUS_EMPTY)
+                       for r in reqs])
+    return (False, [])
+
+
+def _poll_ready(reqs: Sequence[Request]) -> list[int]:
+    """Spin (with failure checks) until ≥1 *active* request completes.
+    Returns [] when no request is active."""
+    ctx, _ = require_env()
+    while True:
+        if not any(r.active for r in reqs):
+            return []
+        ready = [i for i, r in enumerate(reqs) if r.active and r.test()]
+        if ready:
+            return ready
+        ctx.check_failure()
+        time.sleep(_POLL)
+
+
+def Waitany(reqs: Sequence[Request]):
+    """(index, Status) of one newly-completed request, 0-based; (None,
+    STATUS_EMPTY) when no request is active (ref ``Waitany!`` :520-541,
+    which is 1-based and maps MPI_UNDEFINED to 0)."""
+    ready = _poll_ready(reqs)
+    if not ready:
+        return (None, STATUS_EMPTY)
+    i = ready[0]
+    return (i, reqs[i]._consume())
+
+
+def Testany(reqs: Sequence[Request]):
+    """(found, index|None, Status|None); (True, None, STATUS_EMPTY) when no
+    request is active (ref ``Testany!`` :557-581)."""
+    if not any(r.active for r in reqs):
+        return (True, None, STATUS_EMPTY)
+    for i, r in enumerate(reqs):
+        if r.active and r.test():
+            return (True, i, r._consume())
+    return (False, None, None)
+
+
+def Waitsome(reqs: Sequence[Request]):
+    """(indices, [Status]) of ≥1 newly-completed requests; ([], []) when no
+    request is active (ref ``Waitsome!`` :594-624)."""
+    ready = _poll_ready(reqs)
+    return (ready, [reqs[i]._consume() for i in ready])
+
+
+def Testsome(reqs: Sequence[Request]):
+    """(indices, [Status]) of currently-completed active requests
+    (ref ``Testsome!`` :635-665)."""
+    ready = [i for i, r in enumerate(reqs) if r.active and r.test()]
+    return (ready, [reqs[i]._consume() for i in ready])
+
+
+def Cancel(req: Request) -> None:
+    """Cancel a pending receive (ref ``Cancel!`` :677-681)."""
+    req.cancel()

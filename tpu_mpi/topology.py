@@ -1,0 +1,196 @@
+"""Cartesian topology: process grids, neighbor discovery, sub-grids.
+
+Reference: /root/reference/src/topology.jl — Dims_create! (:9-20), Cart_create
+(:30-49), Cart_rank (:60-72), Cart_get (:85-96), Cartdim_get (:106-113),
+Cart_coords (:123-144), Cart_shift (:155-164), Cart_sub (:178-194).
+
+TPU mapping (SURVEY.md §2.3): a Cartesian communicator *is* the device-mesh
+concept — ``jax.sharding.Mesh`` is an N-d grid of devices with named axes.
+``CartComm`` carries (dims, periods) and exposes ``mesh_axes()`` so the
+in-graph layer can bind mesh axes to grid dimensions; ``Cart_shift`` yields
+exactly the permutation ``lax.ppermute`` needs for halo exchange or ring
+steps. Rank ordering is row-major (last dim fastest), matching MPI.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ._runtime import PROC_NULL
+from .comm import COMM_NULL, Comm, Comm_split
+from .error import MPIError
+
+
+def Dims_create(nnodes: int, dims: Sequence[int]) -> list[int]:
+    """Balanced factorization of nnodes over len(dims) dimensions
+    (ref ``Dims_create!`` :9-20). Nonzero entries are constraints; zero
+    entries are filled so the dims are as close to each other as possible
+    (larger dims first), and prod(dims) == nnodes."""
+    dims = [int(d) for d in dims]
+    if any(d < 0 for d in dims):
+        raise MPIError(f"negative entry in dims {dims}")
+    fixed = math.prod(d for d in dims if d > 0) if any(d > 0 for d in dims) else 1
+    free = [i for i, d in enumerate(dims) if d == 0]
+    if fixed <= 0 or nnodes % fixed != 0:
+        raise MPIError(f"cannot partition {nnodes} nodes over fixed dims {dims}")
+    rem = nnodes // fixed
+    if not free:
+        if rem != 1:
+            raise MPIError(f"dims {dims} do not multiply to {nnodes}")
+        return dims
+    # Greedy balanced factorization: repeatedly take the largest factor of
+    # `rem` not exceeding its k-th root.
+    k = len(free)
+    factors: list[int] = []
+    for i in range(k, 0, -1):
+        target = round(rem ** (1.0 / i))
+        f = 1
+        for cand in range(target, 0, -1):
+            if rem % cand == 0:
+                f = cand
+                break
+        # Prefer a slightly larger divisor when the rounded root misses.
+        cand = target + 1
+        while f == 1 and cand <= rem:
+            if rem % cand == 0:
+                f = cand
+                break
+            cand += 1
+        factors.append(f)
+        rem //= f
+    factors.sort(reverse=True)
+    for i, f in zip(free, factors):
+        dims[i] = f
+    return dims
+
+
+class CartComm(Comm):
+    """A communicator with an attached N-d grid (ref Cart_create :30-49)."""
+
+    def __init__(self, group, cid, dims: Sequence[int], periods: Sequence[bool],
+                 name: str = "cart"):
+        super().__init__(group, cid, name=name)
+        self.dims = tuple(int(d) for d in dims)
+        self.periods = tuple(bool(p) for p in periods)
+
+    # -- rank <-> coords (row-major, last dim fastest: MPI order) ------------
+    def rank_of_coords(self, coords: Sequence[int]) -> int:
+        r = 0
+        for d, p, c in zip(self.dims, self.periods, coords):
+            c = int(c)
+            if c < 0 or c >= d:
+                if not p:
+                    raise MPIError(f"coordinate {c} out of range for non-periodic "
+                                   f"dim of size {d}")
+                c %= d
+            r = r * d + c
+        return r
+
+    def coords_of_rank(self, rank: int) -> list[int]:
+        coords = []
+        r = int(rank)
+        for d in reversed(self.dims):
+            coords.append(r % d)
+            r //= d
+        return list(reversed(coords))
+
+    def mesh_axes(self) -> dict[str, int]:
+        """Axis-name → size mapping for building a jax.sharding.Mesh with the
+        same shape as this grid (the TPU-native face of Cart topology)."""
+        return {f"cart{i}": d for i, d in enumerate(self.dims)}
+
+
+def Cart_create(comm: Comm, *args) -> Comm:
+    """``Cart_create(comm, [ndims,] dims, periods, reorder)`` — collective;
+    ranks beyond prod(dims) get COMM_NULL (ref :30-49). ``reorder`` is
+    accepted for API parity; rank order is preserved (the TPU backend instead
+    exposes physical-torus-aware ordering via the mesh layer)."""
+    if len(args) == 4:
+        ndims, dims, periods, reorder = args
+        dims = list(dims)[:int(ndims)]
+        periods = list(periods)[:int(ndims)]
+    elif len(args) == 3:
+        dims, periods, reorder = args
+        dims = [int(d) for d in np.ravel(np.asarray(dims))]
+        periods = list(np.ravel(np.asarray(periods)))
+    else:
+        raise TypeError("Cart_create(comm, [ndims,] dims, periods, reorder)")
+    dims = [int(d) for d in dims]
+    periods = [bool(p) for p in periods]
+    n = math.prod(dims)
+    if n > comm.size():
+        raise MPIError(f"grid {dims} needs {n} ranks, comm has {comm.size()}")
+    rank = comm.rank()
+    color = 0 if rank < n else None
+    sub = Comm_split(comm, color, rank)
+    if sub is COMM_NULL:
+        return COMM_NULL
+    return CartComm(sub.group, sub.cid, dims, periods,
+                    name=f"{comm.name}.cart{tuple(dims)}")
+
+
+def Cart_rank(comm: CartComm, coords: Sequence[int]) -> int:
+    """Rank at grid coordinates (ref :60-72)."""
+    return comm.rank_of_coords(coords)
+
+
+def Cart_coords(comm: CartComm, rank: Optional[int] = None) -> list[int]:
+    """Grid coordinates of a rank (calling rank by default) (ref :123-144)."""
+    return comm.coords_of_rank(comm.rank() if rank is None else rank)
+
+
+def Cart_get(comm: CartComm):
+    """(dims, periods, coords) of the calling rank (ref :85-96)."""
+    return (list(comm.dims), [int(p) for p in comm.periods],
+            comm.coords_of_rank(comm.rank()))
+
+
+def Cartdim_get(comm: CartComm) -> int:
+    """Number of grid dimensions (ref :106-113)."""
+    return len(comm.dims)
+
+
+def Cart_shift(comm: CartComm, direction: int, disp: int):
+    """(source, dest) ranks for a shift along a dimension (ref :155-164).
+
+    ``dest`` is ``disp`` steps forward, ``source`` is ``disp`` steps backward;
+    off-grid neighbors of non-periodic dimensions are PROC_NULL — exactly the
+    permutation table a ``ppermute`` halo exchange needs."""
+    coords = comm.coords_of_rank(comm.rank())
+    d = comm.dims[direction]
+    periodic = comm.periods[direction]
+
+    def neighbor(offset: int) -> int:
+        c = coords[direction] + offset
+        if 0 <= c < d or periodic:
+            nc = list(coords)
+            nc[direction] = c % d
+            return comm.rank_of_coords(nc)
+        return PROC_NULL
+
+    return neighbor(-disp), neighbor(disp)
+
+
+def Cart_sub(comm: CartComm, remain_dims: Sequence) -> Comm:
+    """Sub-grid keeping the dimensions flagged in remain_dims (ref :178-194).
+
+    Ranks sharing the coordinates of the *dropped* dimensions form one
+    sub-communicator — axis subsetting of the device mesh."""
+    remain = [bool(r) for r in remain_dims]
+    if len(remain) != len(comm.dims):
+        raise MPIError("remain_dims length mismatch")
+    coords = comm.coords_of_rank(comm.rank())
+    dropped = tuple(c for c, r in zip(coords, remain) if not r)
+    # Color by dropped coordinates -> unique int
+    color = 0
+    for c, d in zip(dropped, (dim for dim, r in zip(comm.dims, remain) if not r)):
+        color = color * d + c
+    key = comm.rank()
+    sub = Comm_split(comm, color, key)
+    sub_dims = [d for d, r in zip(comm.dims, remain) if r]
+    sub_periods = [p for p, r in zip(comm.periods, remain) if r]
+    return CartComm(sub.group, sub.cid, sub_dims or [1], sub_periods or [False],
+                    name=f"{comm.name}.sub")
