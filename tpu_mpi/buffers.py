@@ -194,19 +194,10 @@ def write_flat(dest: Any, src: Any, count: Optional[int] = None) -> Any:
             dest[...] = srcarr.reshape(dest.shape).astype(dest.dtype, copy=False) \
                 if srcarr.shape != dest.shape else srcarr.astype(dest.dtype, copy=False)
         else:
-            flat = dest.reshape(-1) if dest.flags.contiguous else None
-            if flat is None:
-                # non-contiguous: go element-by-element via flat iterator
-                it = np.nditer(dest, flags=["multi_index"], op_flags=["writeonly"])
-                sflat = srcarr.reshape(-1)
-                i = 0
-                for slot in it:
-                    if i >= n:
-                        break
-                    slot[...] = sflat[i]
-                    i += 1
-            else:
-                flat[:n] = srcarr.reshape(-1)[:n].astype(dest.dtype, copy=False)
+            # ndarray.flat is a logical C-order view regardless of the
+            # underlying strides, so partial writes land at the right logical
+            # positions even for reversed/transposed/F-ordered views.
+            dest.flat[:n] = srcarr.reshape(-1)[:n]
         return dest
     if is_jax_array(dest):
         raise MPIError("jax.Array is immutable; wrap it in DeviceBuffer for "

@@ -178,12 +178,15 @@ def Scatterv(*args) -> Any:
         if _is_none(sendbuf):
             raise MPIError("root must supply a send buffer to Scatterv")
         assert_minlength(sendbuf, sum(counts))
-    payload = to_wire(sendbuf, sum(counts)) if isroot else None
+    # counts are significant only at the root (MPI semantics): ship them in
+    # the root's contribution so a divergent non-root list cannot influence
+    # the slicing depending on rendezvous arrival order.
+    payload = (to_wire(sendbuf, sum(counts)), counts) if isroot else None
 
     def combine(cs):
-        data = next(c for c in cs if c is not None)
-        displs = np.concatenate([[0], np.cumsum(counts)])
-        return [data[displs[r]:displs[r] + counts[r]] for r in range(len(cs))]
+        data, root_counts = cs[root]
+        displs = np.concatenate([[0], np.cumsum(root_counts)])
+        return [data[displs[r]:displs[r] + root_counts[r]] for r in range(len(cs))]
 
     chunk = _run(comm, payload, combine, f"Scatterv@{comm.cid}")
     if alloc:
@@ -191,8 +194,9 @@ def Scatterv(*args) -> Any:
         return clone_like(template, chunk) if template is not None else np.array(chunk)
     if isroot and _is_none(recvbuf):
         return sendbuf
-    assert_minlength(recvbuf, counts[rank])
-    write_flat(recvbuf, chunk, counts[rank])
+    n = int(np.asarray(chunk).size)
+    assert_minlength(recvbuf, n)
+    write_flat(recvbuf, chunk, n)
     return recvbuf
 
 
@@ -545,12 +549,17 @@ def Reduce_scatter(sendbuf: Any, recvbuf: Any, counts: Sequence[int], op: Any,
     counts = [int(c) for c in counts]
     total = sum(counts)
     assert_minlength(sendbuf, total)
-    payload = to_wire(sendbuf, total)
+    payload = (to_wire(sendbuf, total), counts)
 
     def combine(cs):
-        red = _reduce_arrays(cs, op)
-        displs = np.concatenate([[0], np.cumsum(counts)])
-        return [red.reshape(-1)[displs[r]:displs[r] + counts[r]] for r in range(len(cs))]
+        # Reduce_scatter has no root: every rank's counts must agree.
+        lists = [c[1] for c in cs]
+        if any(l != lists[0] for l in lists[1:]):
+            raise MPIError(f"Reduce_scatter counts differ across ranks: {lists}")
+        red = _reduce_arrays([c[0] for c in cs], op)
+        displs = np.concatenate([[0], np.cumsum(lists[0])])
+        return [red.reshape(-1)[displs[r]:displs[r] + lists[0][r]]
+                for r in range(len(cs))]
 
     mine = _run(comm, payload, combine, f"Reduce_scatter@{comm.cid}")
     if recvbuf is None:

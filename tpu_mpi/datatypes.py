@@ -125,16 +125,7 @@ def _blocks_from_np_dtype(dt: np.dtype, base: int = 0) -> list[tuple[int, np.dty
         fdt, foff = dt.fields[fname][:2]
         out.extend(_blocks_from_np_dtype(fdt, base + foff))
     # Coalesce adjacent equal-dtype runs (src/datatypes.jl:283-292).
-    merged: list[tuple[int, np.dtype, int]] = []
-    for blk in sorted(out):
-        if merged:
-            poff, pdt, pc = merged[-1]
-            off, bdt, c = blk
-            if pdt == bdt and poff + pdt.itemsize * pc == off:
-                merged[-1] = (poff, pdt, pc + c)
-                continue
-        merged.append(blk)
-    return merged
+    return _coalesce(out)
 
 
 # -- predefined datatypes (src/datatypes.jl:29-60) ----------------------------

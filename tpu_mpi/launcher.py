@@ -49,14 +49,17 @@ def launch_script(path: str, nprocs: int, script_args: Optional[list[str]] = Non
     argv = [path] + list(script_args or [])
 
     def rank_main() -> None:
-        old_argv = sys.argv
-        sys.argv = list(argv)
-        try:
-            runpy.run_path(path, run_name="__main__")
-        finally:
-            sys.argv = old_argv
+        runpy.run_path(path, run_name="__main__")
 
-    spmd_run(rank_main, nprocs, timeout=timeout)
+    # sys.argv is process-global; set it once around the whole SPMD run
+    # rather than per rank-thread (a per-thread restore races with ranks
+    # still running).
+    old_argv = sys.argv
+    sys.argv = list(argv)
+    try:
+        spmd_run(rank_main, nprocs, timeout=timeout)
+    finally:
+        sys.argv = old_argv
 
 
 def main(argv: Optional[list[str]] = None) -> int:
@@ -88,7 +91,12 @@ def main(argv: Optional[list[str]] = None) -> int:
     try:
         launch_script(args.script, args.np, args.script_args, timeout=args.timeout)
     except SystemExit as e:
-        return int(e.code or 0)
+        if e.code is None:
+            return 0
+        if isinstance(e.code, int):
+            return e.code
+        print(e.code, file=sys.stderr)   # sys.exit("message") idiom
+        return 1
     except MPIError as e:
         print(f"tpurun: job failed: {e}", file=sys.stderr)
         return getattr(e, "code", 1) or 1

@@ -1,0 +1,12 @@
+"""Demonstration models proving the communication substrate end-to-end.
+
+The reference ships no models (it is a communication library); SURVEY.md §7's
+build plan nonetheless requires "one model e2e" — a data-parallel step built
+on rank/size + Bcast + Allreduce + Barrier — and §5 asks for a
+ring-attention-shaped demo of the long-context substrate. These models are
+that proof, written on the primitive layer (tpu_mpi.xla + tpu_mpi.parallel).
+"""
+
+from .mlp import mlp_init, mlp_train_step_dp
+from .transformer import (TransformerConfig, transformer_forward,
+                          transformer_init, transformer_train_step)

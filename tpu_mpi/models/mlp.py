@@ -1,0 +1,50 @@
+"""Data-parallel MLP: the minimum end-to-end slice (SURVEY.md §7 step 3).
+
+Exercises launcher → mesh → collective → op → buffer: params broadcast from
+rank 0 (Bcast analog: params enter replicated), per-shard forward/backward on
+the MXU, one psum of gradients over the 'dp' axis (Allreduce analog), SGD.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.dp import allreduce_grads
+
+
+def mlp_init(key, sizes: list[int]) -> list[dict[str, jnp.ndarray]]:
+    params = []
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        params.append({
+            "w": jax.random.normal(sub, (a, b), jnp.float32) * (2.0 / a) ** 0.5,
+            "b": jnp.zeros((b,), jnp.float32),
+        })
+    return params
+
+
+def _forward(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i != len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def mlp_train_step_dp(params: Any, x: jnp.ndarray, y: jnp.ndarray,
+                      lr: float = 1e-2, axis: str = "dp"):
+    """One SGD step on a batch shard; grads all-reduced over ``axis``.
+    Call inside shard_map with x/y sharded over the batch dim."""
+
+    def loss_fn(p):
+        pred = _forward(p, x)
+        return jnp.mean((pred - y) ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    grads = allreduce_grads(grads, axis=axis, mean=True)
+    new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+    from jax import lax
+    return new_params, lax.pmean(loss, axis)

@@ -1,0 +1,26 @@
+"""Data parallelism: gradient synchronization over a 'data' mesh axis.
+
+Reference primitives: Allreduce! of gradients + Bcast! of params
+(SURVEY.md §2.5; /root/reference/src/collective.jl:691-738,29-42).
+TPU realization: one ``lax.psum``/``pmean`` per gradient pytree inside the
+compiled step — XLA overlaps the all-reduce with backward compute.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def allreduce_grads(grads: Any, axis: str = "dp", mean: bool = True) -> Any:
+    """Sum (or average) a gradient pytree across the data axis."""
+    import jax
+    from jax import lax
+    op = lax.pmean if mean else lax.psum
+    return jax.tree_util.tree_map(lambda g: op(g, axis), grads)
+
+
+def pmean_tree(tree: Any, axis: str = "dp") -> Any:
+    """Average any pytree (metrics, losses) across the data axis."""
+    import jax
+    from jax import lax
+    return jax.tree_util.tree_map(lambda x: lax.pmean(x, axis), tree)

@@ -1,0 +1,50 @@
+"""Expert parallelism: capacity-bounded token routing over an 'ep' axis.
+
+Reference primitive: Alltoallv! — variable-size token routing (SURVEY.md §2.5;
+/root/reference/src/collective.jl:545-578). TPU realization: XLA needs static
+shapes, so variable counts become a fixed per-expert *capacity* with masking
+(the padded-all_to_all strategy SURVEY.md §2.3 prescribes for `*v` ops);
+one ``lax.all_to_all`` ships token buffers to their experts and one ships
+results back.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def moe_dispatch_combine(tokens: jnp.ndarray, expert_idx: jnp.ndarray,
+                         expert_fn: Callable[[jnp.ndarray], jnp.ndarray], *,
+                         capacity: int, axis: str = "ep") -> jnp.ndarray:
+    """Top-1 Mixture-of-Experts dispatch/combine.
+
+    tokens: (t, d) local tokens; expert_idx: (t,) target expert (== rank on
+    ``axis``) per token; expert_fn: the local expert applied to (n*capacity, d).
+    Tokens over capacity are dropped (returned as zeros), the standard
+    static-shape MoE contract. Returns (t, d).
+    """
+    t, d = tokens.shape
+    n = lax.axis_size(axis) if hasattr(lax, "axis_size") else lax.psum(1, axis)
+
+    # position of each token within its expert's capacity window
+    onehot = jax.nn.one_hot(expert_idx, n, dtype=jnp.int32)       # (t, n)
+    pos = jnp.cumsum(onehot, axis=0) * onehot                     # 1-based
+    slot = (pos.sum(axis=1) - 1).astype(jnp.int32)                # (t,)
+    keep = slot < capacity
+
+    # scatter local tokens into per-expert send buffers (n, capacity, d)
+    send = jnp.zeros((n, capacity, d), tokens.dtype)
+    send = send.at[expert_idx, jnp.clip(slot, 0, capacity - 1)].add(
+        jnp.where(keep[:, None], tokens, 0.0))
+
+    recv = lax.all_to_all(send, axis, split_axis=0, concat_axis=0, tiled=True)
+    out = expert_fn(recv.reshape(n * capacity, d)).reshape(n, capacity, d)
+    back = lax.all_to_all(out, axis, split_axis=0, concat_axis=0, tiled=True)
+
+    # gather results back to token order
+    gathered = back[expert_idx, jnp.clip(slot, 0, capacity - 1)]
+    return jnp.where(keep[:, None], gathered, 0.0)

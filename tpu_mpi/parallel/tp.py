@@ -1,0 +1,92 @@
+"""Tensor (model) parallelism: Megatron-style sharded matmuls.
+
+Reference primitives: Allreduce!/Allgather!/Reduce_scatter over the model
+axis (SURVEY.md §2.5; /root/reference/src/collective.jl:295-335,691-738).
+TPU realization: column-parallel layers shard the output feature dim (no
+communication), row-parallel layers shard the input feature dim and psum
+partial products; the f/g identity-psum conjugate pair carries the right
+gradients, and XLA schedules the psum on ICI overlapped with the matmul.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _ident(x, axis: str):
+    return x
+
+
+def _ident_fwd(x, axis):
+    return x, None
+
+
+def _ident_bwd(axis, _res, g):
+    from jax import lax
+    # f's input is replicated over `axis`, so the psum'd cotangent (invariant
+    # over `axis`) already has the matching static type.
+    return (lax.psum(g, axis),)
+
+
+_ident.defvjp(_ident_fwd, _ident_bwd)
+
+
+def tp_identity_fwd_psum_bwd(x: Any, axis: str = "tp"):
+    """Megatron's ``f`` operator: identity forward, psum backward — placed
+    where a replicated activation enters a column-parallel layer."""
+    return _ident(x, axis)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _psum_op(x, axis: str):
+    from jax import lax
+    return lax.psum(x, axis)
+
+
+def _psum_fwd(x, axis):
+    from jax import lax
+    return lax.psum(x, axis), None
+
+
+def _psum_bwd(axis, _res, g):
+    from jax._src.lax.parallel import pvary
+    # the cotangent flows back identically to every tp rank; mark it varying
+    # to match the primal input's type.
+    return (pvary(g, axis),)
+
+
+_psum_op.defvjp(_psum_fwd, _psum_bwd)
+
+
+def tp_psum_fwd_identity_bwd(x: Any, axis: str = "tp"):
+    """Megatron's ``g`` operator: psum forward, identity backward — placed
+    where row-parallel partial sums are combined."""
+    return _psum_op(x, axis)
+
+
+def column_parallel(x: Any, w_shard: Any, b_shard: Optional[Any] = None,
+                    axis: str = "tp"):
+    """y_shard = f(x) @ W_shard: output features sharded, no forward comm."""
+    y = tp_identity_fwd_psum_bwd(x, axis) @ w_shard
+    return y + b_shard if b_shard is not None else y
+
+
+def row_parallel(x_shard: Any, w_shard: Any, b: Optional[Any] = None,
+                 axis: str = "tp"):
+    """y = g(x_shard @ W_shard): input features sharded, psum combines."""
+    y = tp_psum_fwd_identity_bwd(x_shard @ w_shard, axis)
+    return y + b if b is not None else y
+
+
+def all_gather_output(y_shard: Any, axis: str = "tp", dim: int = -1):
+    """Materialize a column-parallel output fully (e.g. for logits)."""
+    from jax import lax
+    if dim < 0:
+        dim = y_shard.ndim + dim
+    return lax.all_gather(y_shard, axis, axis=dim, tiled=True)

@@ -1,0 +1,39 @@
+"""Ulysses-style sequence parallelism: all_to_all head↔sequence reshard.
+
+Reference primitive: Alltoall! (SURVEY.md §2.5;
+/root/reference/src/collective.jl:489-532). TPU realization: one
+``lax.all_to_all`` flips which dimension is sharded — sequence-sharded
+activations become head-sharded for exact local attention, then flip back.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def seq_to_heads(x: jnp.ndarray, *, axis: str = "sp") -> jnp.ndarray:
+    """(b, h, t/n, d) sequence-sharded → (b, h/n, t, d) head-sharded."""
+    return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def heads_to_seq(x: jnp.ndarray, *, axis: str = "sp") -> jnp.ndarray:
+    """(b, h/n, t, d) head-sharded → (b, h, t/n, d) sequence-sharded."""
+    return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+
+def ulysses_attention(q, k, v, *, axis: str = "sp", causal: bool = True):
+    """Exact attention for sequence-sharded q/k/v via the head reshard."""
+    qh = seq_to_heads(q, axis=axis)
+    kh = seq_to_heads(k, axis=axis)
+    vh = seq_to_heads(v, axis=axis)
+    d = qh.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh * (d ** -0.5), kh)
+    if causal:
+        t = s.shape[-1]
+        mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    return heads_to_seq(o, axis=axis)

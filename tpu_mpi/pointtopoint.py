@@ -377,8 +377,12 @@ def Testall(reqs: Sequence[Request]):
 
 def _poll_ready(reqs: Sequence[Request]) -> list[int]:
     """Spin (with failure checks) until ≥1 *active* request completes.
-    Returns [] when no request is active."""
+    Returns [] when no request is active; raises DeadlockError after the
+    runtime's deadlock timeout like every other blocking wait."""
+    from ._runtime import _DEADLOCK_TIMEOUT
+    from .error import DeadlockError
     ctx, _ = require_env()
+    deadline = time.monotonic() + _DEADLOCK_TIMEOUT
     while True:
         if not any(r.active for r in reqs):
             return []
@@ -386,6 +390,9 @@ def _poll_ready(reqs: Sequence[Request]) -> list[int]:
         if ready:
             return ready
         ctx.check_failure()
+        if time.monotonic() > deadline:
+            raise DeadlockError(
+                f"deadlock suspected: blocked >{_DEADLOCK_TIMEOUT}s in Waitany/Waitsome")
         time.sleep(_POLL)
 
 

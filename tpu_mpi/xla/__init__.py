@@ -1,0 +1,18 @@
+"""tpu_mpi.xla: the compiled, in-graph communication layer.
+
+This is the performance face of the framework (SURVEY.md §3.2): where the
+host path gives MPI *semantics* (dynamic tags, wildcards, objects), this layer
+gives MPI *operations* as XLA collectives over ICI — ``psum`` / ``all_gather``
+/ ``psum_scatter`` / ``all_to_all`` / ``ppermute`` inside ``jax.shard_map``
+over a named ``jax.sharding.Mesh`` axis. Everything here is traceable: use it
+inside ``jit``, differentiate through it, let XLA overlap it with compute.
+
+The reference's entire call stack (user → Allreduce! → Buffer/Op/Datatype →
+@mpichk ccall → libmpi ring) collapses to one ``lax`` op per collective
+(SURVEY.md §3.2); rank = ``lax.axis_index(axis)``, comm = mesh axis.
+"""
+
+from .mesh import (comm_mesh, local_device_count, make_mesh, world_mesh)
+from .collectives import (allgather, allgatherv, allreduce, alltoall, barrier,
+                          bcast, exscan, gather, rank, reduce, reduce_scatter,
+                          ring_shift, scan, scatter, sendrecv, size)

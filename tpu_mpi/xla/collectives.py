@@ -1,0 +1,223 @@
+"""Compiled collectives: MPI operations as XLA ICI ops inside shard_map.
+
+Reference: /root/reference/src/collective.jl enumerates the operation set;
+SURVEY.md §2.3 gives the lowering table this module implements:
+
+- Allreduce  → ``lax.psum`` / ``lax.pmax`` / ``lax.pmin`` (custom ops compile
+  into an all_gather + unrolled reduction — any jittable binary fn works,
+  src/operators.jl:56-88's @cfunction machinery has no TPU analog because
+  none is needed)
+- Allgather  → ``lax.all_gather``; Reduce_scatter → ``lax.psum_scatter``
+- Alltoall   → ``lax.all_to_all``; Bcast → one-hot ``psum`` from the root
+- Scan/Exscan → ``lax.associative_scan`` over the gathered rank axis
+- Sendrecv/ring shifts → ``lax.ppermute``; Barrier → 1-element psum
+
+Every function must be called inside ``shard_map``/``pjit`` tracing over a
+mesh with the named axis. Rank = ``lax.axis_index(axis)``; there is no
+communicator object in-graph — the mesh axis *is* the communicator
+(SURVEY.md §2.2 Comm row).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+from ..operators import MAX, MIN, Op, PROD, SUM, as_op
+
+Axis = Union[str, Sequence[str]]
+
+
+def _lax():
+    from jax import lax
+    return lax
+
+
+def rank(axis: str):
+    """Rank along a mesh axis (Comm_rank analog, src/comm.jl:49-53)."""
+    return _lax().axis_index(axis)
+
+
+def size(axis: str) -> int:
+    """Static size of a mesh axis (Comm_size analog, src/comm.jl:66-70)."""
+    import jax
+    return jax.lax.axis_size(axis) if hasattr(jax.lax, "axis_size") else \
+        jax.lax.psum(1, axis)
+
+
+def barrier(axis: Axis):
+    """Synchronization point (src/collective.jl:15-19): a 1-element psum —
+    on TPU a collective is itself the barrier."""
+    import jax.numpy as jnp
+    return _lax().psum(jnp.zeros((), jnp.int32), axis)
+
+
+def _replicate(x: Any, axis: str):
+    """Assert replication to shard_map's static varying-axes system.
+
+    Values equal on every rank (e.g. an all_gather followed by identical
+    per-rank math) still count as 'varying' statically; a one-hot psum — a
+    broadcast from rank 0 — makes the invariance checkable. Costs one
+    payload-sized broadcast; only the non-native-op paths pay it."""
+    import jax.numpy as jnp
+    lax = _lax()
+    idx = lax.axis_index(axis)
+    return lax.psum(jnp.where(idx == 0, x, jnp.zeros_like(x)), axis)
+
+
+def _gather_reduce(x: Any, op: Op, axis: str):
+    """Generic rank-ordered reduction: all_gather + unrolled combine.
+    The per-rank unroll is static (axis size is known at trace time) and XLA
+    fuses it; this is the custom-op path (SURVEY.md: 'custom ops are strictly
+    easier on TPU')."""
+    lax = _lax()
+    g = lax.all_gather(x, axis)          # (n, ...)
+    acc = g[0]
+    for i in range(1, g.shape[0]):
+        acc = op(acc, g[i])
+    return _replicate(acc, axis)
+
+
+def allreduce(x: Any, op: Any = SUM, *, axis: Axis = "x"):
+    """Allreduce (src/collective.jl:691-738) → psum/pmax/pmin or the
+    gather-reduce path for PROD/bitwise/custom ops."""
+    lax = _lax()
+    op = as_op(op)
+    if op is SUM:
+        return lax.psum(x, axis)
+    if op is MAX:
+        return lax.pmax(x, axis)
+    if op is MIN:
+        return lax.pmin(x, axis)
+    if isinstance(axis, (tuple, list)):
+        acc = x
+        for a in axis:
+            acc = _gather_reduce(acc, op, a)
+        return acc
+    return _gather_reduce(x, op, axis)
+
+
+def reduce(x: Any, op: Any = SUM, *, root: int = 0, axis: Axis = "x"):
+    """Rooted reduce (src/collective.jl:605-666). SPMD programs compute the
+    value everywhere (free on ICI — the all-reduce *is* the reduce tree);
+    only root's shard is meaningful to the caller."""
+    return allreduce(x, op, axis=axis)
+
+
+def bcast(x: Any, *, root: int = 0, axis: str = "x"):
+    """Broadcast root's shard to every rank (src/collective.jl:29-42):
+    one-hot mask + psum, which XLA lowers to a broadcast from root."""
+    import jax.numpy as jnp
+    lax = _lax()
+    idx = lax.axis_index(axis)
+    contrib = jnp.where(idx == root, x, jnp.zeros_like(x))
+    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.bool_):
+        return lax.psum(contrib.astype(jnp.int32), axis).astype(jnp.bool_)
+    return lax.psum(contrib, axis)
+
+
+def allgather(x: Any, *, axis: str = "x", tiled: bool = False):
+    """Allgather (src/collective.jl:295-335) → lax.all_gather; ``tiled``
+    concatenates along the leading dim instead of stacking."""
+    return _lax().all_gather(x, axis, tiled=tiled)
+
+
+def gather(x: Any, *, root: int = 0, axis: str = "x", tiled: bool = False):
+    """Rooted gather (src/collective.jl:230-275); all ranks hold the result
+    (rooted-ness is a host-API concept — in-graph it is an all_gather)."""
+    return _lax().all_gather(x, axis, tiled=tiled)
+
+
+def allgatherv(x: Any, counts: Sequence[int], *, axis: str = "x"):
+    """Variable-count allgather (src/collective.jl:424-461): the static-shape
+    regime requires max-padding (SURVEY.md §2.3 '*v' note) — each rank pads
+    its shard to max(counts), gathers, and the caller slices by the static
+    per-rank counts."""
+    import jax.numpy as jnp
+    lax = _lax()
+    m = max(int(c) for c in counts)
+    pad = [(0, m - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+    g = lax.all_gather(jnp.pad(x, pad), axis)      # (n, m, ...)
+    parts = [g[i, :int(c)] for i, c in enumerate(counts)]
+    return _replicate(jnp.concatenate(parts, axis=0), axis)
+
+
+def scatter(x: Any, *, root: int = 0, axis: str = "x"):
+    """Scatter root's array in equal chunks (src/collective.jl:90-129).
+
+    In-graph the 'root array' is replicated input; each rank slices its own
+    chunk — the bcast happened in the sharding, the slice is free."""
+    lax = _lax()
+    n = size(axis)
+    idx = lax.axis_index(axis)
+    chunk = x.shape[0] // n
+    return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=0)
+
+
+def reduce_scatter(x: Any, op: Any = SUM, *, axis: str = "x",
+                   scatter_dimension: int = 0, tiled: bool = True):
+    """Reduce_scatter → lax.psum_scatter (XLA-native; absent from the
+    reference, SURVEY.md §2.3 note). Non-SUM ops take the gather-reduce +
+    slice path."""
+    lax = _lax()
+    op = as_op(op)
+    if op is SUM:
+        return lax.psum_scatter(x, axis, scatter_dimension=scatter_dimension,
+                                tiled=tiled)
+    full = allreduce(x, op, axis=axis)
+    n = size(axis)
+    idx = lax.axis_index(axis)
+    chunk = full.shape[scatter_dimension] // n
+    return lax.dynamic_slice_in_dim(full, idx * chunk, chunk,
+                                    axis=scatter_dimension)
+
+
+def alltoall(x: Any, *, axis: str = "x", split_axis: int = 0,
+             concat_axis: int = 0, tiled: bool = True):
+    """Alltoall (src/collective.jl:489-532) → lax.all_to_all — the Ulysses
+    head↔sequence reshard primitive (SURVEY.md §2.5)."""
+    return _lax().all_to_all(x, axis, split_axis=split_axis,
+                             concat_axis=concat_axis, tiled=tiled)
+
+
+def _assoc_scan_take(x: Any, op: Op, axis: str, *, exclusive: bool):
+    import jax.numpy as jnp
+    lax = _lax()
+    g = lax.all_gather(x, axis)                       # (n, ...)
+    scanned = lax.associative_scan(op, g, axis=0)     # inclusive prefixes
+    idx = lax.axis_index(axis)
+    if not exclusive:
+        return lax.dynamic_index_in_dim(scanned, idx, axis=0, keepdims=False)
+    prev = lax.dynamic_index_in_dim(scanned, jnp.maximum(idx - 1, 0),
+                                    axis=0, keepdims=False)
+    # rank 0's exscan is undefined (src/collective.jl:834-855); return x
+    # unchanged there so shapes/dtypes stay uniform.
+    return jnp.where(idx == 0, x, prev)
+
+
+def scan(x: Any, op: Any = SUM, *, axis: str = "x"):
+    """Inclusive prefix reduction over ranks (src/collective.jl:760-808) via
+    lax.associative_scan on the gathered rank axis."""
+    return _assoc_scan_take(x, as_op(op), axis, exclusive=False)
+
+
+def exscan(x: Any, op: Any = SUM, *, axis: str = "x"):
+    """Exclusive prefix reduction (src/collective.jl:834-882)."""
+    return _assoc_scan_take(x, as_op(op), axis, exclusive=True)
+
+
+def ring_shift(x: Any, *, axis: str = "x", shift: int = 1):
+    """Periodic ring step (the Cart_shift + Sendrecv! pattern,
+    test/test_sendrecv.jl:100-115) → lax.ppermute. ``shift=+1`` sends to the
+    next rank; data received comes from rank-shift."""
+    n = size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return _lax().ppermute(x, axis, perm)
+
+
+def sendrecv(x: Any, *, dest: Sequence[int], axis: str = "x"):
+    """Static neighbor exchange (src/pointtopoint.jl:376-393 in-graph):
+    ``dest[i]`` is where rank i's shard goes; pairs with PROC_NULL-style
+    holes simply omit the edge (the hole receives zeros, matching ppermute
+    semantics)."""
+    perm = [(i, int(d)) for i, d in enumerate(dest) if d is not None and d >= 0]
+    return _lax().ppermute(x, axis, perm)
