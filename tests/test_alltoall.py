@@ -1,0 +1,55 @@
+"""Alltoall tests (reference: test/test_alltoall.jl, test_alltoallv.jl)."""
+
+import numpy as np
+
+import tpu_mpi as MPI
+from tpu_mpi.testing import aeq, run_spmd
+
+
+def test_alltoall(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        # Rank r sends chunk j = [r*size+j] to rank j; rank r receives
+        # [s*size+r] from each s (test_alltoall.jl).
+        send = np.arange(size, dtype=np.int64) + rank * size
+        expected = np.array([s * size + rank for s in range(size)], dtype=np.int64)
+
+        out = MPI.Alltoall(AT.array(send), 1, comm)
+        assert aeq(out, expected)
+
+        recv = AT.zeros((size,), dtype=np.int64)
+        MPI.Alltoall(AT.array(send), recv, 1, comm)
+        assert aeq(recv, expected)
+
+        # IN_PLACE
+        buf = AT.array(send)
+        MPI.Alltoall(MPI.IN_PLACE, buf, 1, comm)
+        assert aeq(buf, expected)
+
+        # count > 1
+        send2 = np.repeat(send, 2)
+        out = MPI.Alltoall(AT.array(send2), 2, comm)
+        assert aeq(out, np.repeat(expected, 2))
+
+    run_spmd(body, nprocs)
+
+
+def test_alltoallv(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        # Rank r sends j+1 copies of r to rank j (test_alltoallv.jl:17-41).
+        scounts = [j + 1 for j in range(size)]
+        rcounts = [rank + 1] * size
+        send = np.concatenate([np.full(j + 1, rank, dtype=np.int64) for j in range(size)])
+        expected = np.concatenate([np.full(rank + 1, s, dtype=np.int64) for s in range(size)])
+
+        out = MPI.Alltoallv(AT.array(send), scounts, rcounts, comm)
+        assert aeq(out, expected)
+
+        recv = AT.zeros((sum(rcounts),), dtype=np.int64)
+        MPI.Alltoallv(AT.array(send), recv, scounts, rcounts, comm)
+        assert aeq(recv, expected)
+
+    run_spmd(body, nprocs)

@@ -1,0 +1,143 @@
+"""Gather/Scatter family tests (reference: test/test_gather.jl,
+test_gatherv.jl, test_scatter.jl, test_scatterv.jl, test_allgather.jl,
+test_allgatherv.jl)."""
+
+import numpy as np
+import pytest
+
+import tpu_mpi as MPI
+from tpu_mpi.testing import aeq, run_spmd
+
+ROOT = 0
+
+
+def test_gather(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        isroot = rank == ROOT
+        chunk = np.full(3, rank, dtype=np.int64)
+        expected = np.concatenate([np.full(3, r, dtype=np.int64) for r in range(size)])
+
+        # Allocating at root (test_gather.jl)
+        out = MPI.Gather(AT.array(chunk), ROOT, comm)
+        if isroot:
+            assert aeq(out, expected)
+        else:
+            assert out is None
+
+        # Mutating
+        recv = AT.zeros((3 * size,), dtype=np.int64) if isroot else None
+        MPI.Gather(AT.array(chunk), recv, ROOT, comm)
+        if isroot:
+            assert aeq(recv, expected)
+
+        # Too-small recv at root raises
+        if isroot:
+            with pytest.raises(AssertionError):
+                MPI.Gather(AT.array(chunk), AT.zeros((2,), dtype=np.int64), 3, ROOT, comm)
+        MPI.Barrier(comm)
+
+        # Scalar gather
+        vals = MPI.Gather(rank, ROOT, comm)
+        if isroot:
+            assert aeq(vals, np.arange(size))
+
+    run_spmd(body, nprocs)
+
+
+def test_allgather(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        chunk = np.full(2, rank + 1, dtype=np.float64)
+        expected = np.concatenate([np.full(2, r + 1.0) for r in range(size)])
+
+        out = MPI.Allgather(AT.array(chunk), comm)
+        assert aeq(out, expected)
+
+        recv = AT.zeros((2 * size,))
+        MPI.Allgather(AT.array(chunk), recv, 2, comm)
+        assert aeq(recv, expected)
+
+        # IN_PLACE: own chunk pre-placed at rank*count (test_allgather.jl)
+        buf = AT.zeros((2 * size,))
+        buf[2 * rank] = rank + 1.0
+        buf[2 * rank + 1] = rank + 1.0
+        MPI.Allgather(MPI.IN_PLACE, buf, 2, comm)
+        assert aeq(buf, expected)
+
+    run_spmd(body, nprocs)
+
+
+def test_gatherv_allgatherv(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        isroot = rank == ROOT
+        # Per-rank counts: rank r contributes r+1 elements (test_gatherv.jl:20-30)
+        counts = [r + 1 for r in range(size)]
+        mine = np.full(rank + 1, rank, dtype=np.int64)
+        expected = np.concatenate([np.full(r + 1, r, dtype=np.int64) for r in range(size)])
+
+        out = MPI.Gatherv(AT.array(mine), counts, ROOT, comm)
+        if isroot:
+            assert aeq(out, expected)
+
+        recv = AT.zeros((sum(counts),), dtype=np.int64) if isroot else None
+        MPI.Gatherv(AT.array(mine), recv, counts, ROOT, comm)
+        if isroot:
+            assert aeq(recv, expected)
+
+        out = MPI.Allgatherv(AT.array(mine), counts, comm)
+        assert aeq(out, expected)
+
+        recv = AT.zeros((sum(counts),), dtype=np.int64)
+        MPI.Allgatherv(AT.array(mine), recv, counts, comm)
+        assert aeq(recv, expected)
+
+    run_spmd(body, nprocs)
+
+
+def test_scatter(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        isroot = rank == ROOT
+        full = np.arange(2 * size, dtype=np.int64)
+        sendbuf = AT.array(full) if isroot else None
+
+        # Allocating (test_scatter.jl)
+        out = MPI.Scatter(sendbuf, 2, ROOT, comm)
+        assert aeq(out, full[2 * rank:2 * rank + 2])
+
+        # Mutating
+        recv = AT.zeros((2,), dtype=np.int64)
+        MPI.Scatter(sendbuf, recv, ROOT, comm)
+        assert aeq(recv, full[2 * rank:2 * rank + 2])
+
+        # Non-root send buffer is insignificant
+        recv = AT.zeros((2,), dtype=np.int64)
+        MPI.Scatter(sendbuf if isroot else None, recv, 2, ROOT, comm)
+        assert aeq(recv, full[2 * rank:2 * rank + 2])
+
+    run_spmd(body, nprocs)
+
+
+def test_scatterv(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        isroot = rank == ROOT
+        counts = [r + 1 for r in range(size)]
+        full = np.concatenate([np.full(r + 1, r, dtype=np.int64) for r in range(size)])
+        sendbuf = AT.array(full) if isroot else None
+
+        out = MPI.Scatterv(sendbuf, counts, ROOT, comm)
+        assert aeq(out, np.full(rank + 1, rank))
+
+        recv = AT.zeros((rank + 1,), dtype=np.int64)
+        MPI.Scatterv(sendbuf, recv, counts, ROOT, comm)
+        assert aeq(recv, np.full(rank + 1, rank))
+
+    run_spmd(body, nprocs)

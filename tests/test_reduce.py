@@ -1,0 +1,99 @@
+"""Reduce tests (reference: test/test_reduce.jl)."""
+
+import numpy as np
+import pytest
+
+import tpu_mpi as MPI
+from tpu_mpi.testing import aeq, run_spmd
+
+ROOT = 0
+
+
+def test_reduce_variants(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        size = MPI.Comm_size(comm)
+        isroot = rank == ROOT
+        base = np.arange(1, 9, dtype=np.float64)
+        send_arr = AT.array(base)
+
+        # Allocating (test_reduce.jl:43-52): result on root only.
+        out = MPI.Reduce(send_arr, MPI.SUM, ROOT, comm)
+        if isroot:
+            assert aeq(out, size * base)
+        else:
+            assert out is None
+
+        # Mutating
+        recv_arr = AT.zeros(8)
+        MPI.Reduce(send_arr, recv_arr, MPI.SUM, ROOT, comm)
+        if isroot:
+            assert aeq(recv_arr, size * base)
+
+        # Mutating with explicit count
+        recv_arr = AT.zeros(8)
+        MPI.Reduce(send_arr, recv_arr, 8, MPI.SUM, ROOT, comm)
+        if isroot:
+            assert aeq(recv_arr, size * base)
+
+        # Too-small recv buffer raises at root
+        small = AT.zeros(4)
+        if isroot:
+            with pytest.raises(AssertionError):
+                MPI.Reduce(send_arr, small, 8, MPI.SUM, ROOT, comm)
+            MPI.Barrier(comm)  # keep ranks in step after root's failed call
+        else:
+            MPI.Barrier(comm)
+
+        # IN_PLACE at every rank (test_reduce.jl:60-67)
+        buf = AT.array(base)
+        MPI.Reduce(MPI.IN_PLACE, buf, MPI.SUM, ROOT, comm)
+        if isroot:
+            assert aeq(buf, size * base)
+
+        # Scalar reduce
+        val = MPI.Reduce(rank + 1, MPI.SUM, ROOT, comm)
+        if isroot:
+            assert val == size * (size + 1) // 2
+
+    run_spmd(body, nprocs)
+
+
+def test_reduce_custom_op(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        size = MPI.Comm_size(comm)
+
+        # Custom associative op as a closure (test_reduce.jl:75-99).
+        def weighted(a, b):
+            return a + 2 * b
+
+        vals = MPI.Reduce(float(rank + 1), weighted, ROOT, comm)
+        if rank == ROOT:
+            expected = 1.0
+            for r in range(1, size):
+                expected = weighted(expected, float(r + 1))
+            assert vals == expected
+
+        # min/max builtin dispatch
+        out = MPI.Reduce(AT.array(np.full(3, rank, dtype=np.int64)), max, ROOT, comm)
+        if rank == ROOT:
+            assert aeq(out, np.full(3, size - 1))
+
+    run_spmd(body, nprocs)
+
+
+def test_reduce_nonprimitive(nprocs):
+    """Reduction over a compound element type — the Double64 analog
+    (test_reduce.jl:111-117): anything with +, here complex128 pairs."""
+    def body():
+        comm = MPI.COMM_WORLD
+        size = MPI.Comm_size(comm)
+        arr = np.array([1 + 2j, 3 - 1j], dtype=np.complex128)
+        out = MPI.Reduce(arr, MPI.SUM, ROOT, comm)
+        if MPI.Comm_rank(comm) == ROOT:
+            assert aeq(out, size * arr)
+
+    run_spmd(body, nprocs)

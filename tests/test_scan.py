@@ -1,0 +1,89 @@
+"""Scan/Exscan/Reduce_scatter tests (reference: test/test_scan.jl,
+test_exscan.jl; Reduce_scatter native per SURVEY.md §2.3 note)."""
+
+import numpy as np
+
+import tpu_mpi as MPI
+from tpu_mpi.testing import aeq, run_spmd
+
+
+def test_scan(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        base = np.full(4, rank + 1, dtype=np.int64)
+
+        # Inclusive prefix sum over ranks (test_scan.jl)
+        out = MPI.Scan(AT.array(base), MPI.SUM, comm)
+        prefix = sum(r + 1 for r in range(rank + 1))
+        assert aeq(out, np.full(4, prefix))
+
+        # Scalar
+        val = MPI.Scan(rank + 1, MPI.PROD, comm)
+        expected = 1
+        for r in range(rank + 1):
+            expected *= r + 1
+        assert val == expected
+
+        # Mutating
+        recv = AT.zeros((4,), dtype=np.int64)
+        MPI.Scan(AT.array(base), recv, MPI.SUM, comm)
+        assert aeq(recv, np.full(4, prefix))
+
+        # IN_PLACE
+        buf = AT.array(base)
+        MPI.Scan(MPI.IN_PLACE, buf, MPI.SUM, comm)
+        assert aeq(buf, np.full(4, prefix))
+
+    run_spmd(body, nprocs)
+
+
+def test_exscan(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        base = np.full(3, rank + 1, dtype=np.int64)
+
+        out = MPI.Exscan(AT.array(base), MPI.SUM, comm)
+        if rank > 0:
+            prefix = sum(r + 1 for r in range(rank))
+            assert aeq(out, np.full(3, prefix))
+        # rank 0's output is undefined (src/collective.jl:834-855) — no assert.
+
+        recv = AT.zeros((3,), dtype=np.int64)
+        MPI.Exscan(AT.array(base), recv, MPI.SUM, comm)
+        if rank > 0:
+            assert aeq(recv, np.full(3, sum(r + 1 for r in range(rank))))
+
+    run_spmd(body, nprocs)
+
+
+def test_reduce_scatter(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        counts = [r + 1 for r in range(size)]
+        total = sum(counts)
+        send = np.arange(total, dtype=np.int64)
+        displ = sum(counts[:rank])
+        expected = size * send[displ:displ + counts[rank]]
+
+        out = MPI.Reduce_scatter(AT.array(send), None, counts, MPI.SUM, comm)
+        assert aeq(out, expected)
+
+        recv = AT.zeros((counts[rank],), dtype=np.int64)
+        MPI.Reduce_scatter(AT.array(send), recv, counts, MPI.SUM, comm)
+        assert aeq(recv, expected)
+
+    run_spmd(body, nprocs)
+
+
+def test_reduce_scatter_block(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        send = np.arange(2 * size, dtype=np.float64)
+        out = MPI.Reduce_scatter_block(AT.array(send), None, MPI.SUM, comm)
+        assert aeq(out, size * send[2 * rank:2 * rank + 2])
+
+    run_spmd(body, nprocs)

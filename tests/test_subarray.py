@@ -1,0 +1,76 @@
+"""Strided/dense view transport (reference: test/test_subarray.jl:21-88).
+
+numpy strided views play the role of the reference's auto-derived SubArray
+datatypes (src/buffers.jl:101-117): any view is a valid send/recv operand.
+"""
+
+import numpy as np
+
+import tpu_mpi as MPI
+from tpu_mpi.testing import aeq, run_spmd
+
+
+def test_contiguous_view(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        nxt, prv = (rank + 1) % size, (rank - 1) % size
+        big = np.arange(10, dtype=np.float64) + 100 * rank
+        recv_parent = np.zeros(10)
+        # Send a contiguous slice, receive into a contiguous slice.
+        MPI.Sendrecv(big[2:6], nxt, 0, recv_parent[4:8], prv, 0, comm)
+        assert aeq(recv_parent[4:8], np.arange(2, 6) + 100 * prv)
+        assert aeq(recv_parent[:4], np.zeros(4))
+
+    run_spmd(body, nprocs)
+
+
+def test_strided_view(nprocs):
+    """1-d strided views → auto create_vector in the reference
+    (src/buffers.jl:104-110)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        nxt, prv = (rank + 1) % size, (rank - 1) % size
+        src = np.arange(12, dtype=np.int64) + 100 * rank
+        dest = np.zeros(12, dtype=np.int64)
+        # every-other-element views on both sides
+        MPI.Sendrecv(src[::2], nxt, 1, dest[1::2], prv, 1, comm)
+        assert aeq(dest[1::2], np.arange(0, 12, 2) + 100 * prv)
+        assert aeq(dest[::2], np.zeros(6))
+
+    run_spmd(body, nprocs)
+
+
+def test_2d_block_view(nprocs):
+    """N-d sliced views → auto create_subarray (src/buffers.jl:111-117)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        nxt, prv = (rank + 1) % size, (rank - 1) % size
+        src = (np.arange(16, dtype=np.float64) + 100 * rank).reshape(4, 4)
+        dest = np.zeros((4, 4))
+        MPI.Sendrecv(src[1:3, 1:3], nxt, 2, dest[0:2, 2:4], prv, 2, comm)
+        expected = (np.arange(16, dtype=np.float64) + 100 * prv).reshape(4, 4)[1:3, 1:3]
+        assert aeq(dest[0:2, 2:4], expected)
+        assert aeq(dest[2:4, :], np.zeros((2, 4)))
+
+    run_spmd(body, nprocs)
+
+
+def test_transposed_reversed_views(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        nxt, prv = (rank + 1) % size, (rank - 1) % size
+        src = (np.arange(9, dtype=np.int64) + 10 * rank).reshape(3, 3)
+        dest = np.zeros((3, 3), dtype=np.int64)
+        MPI.Sendrecv(src.T, nxt, 3, dest, prv, 3, comm)
+        assert aeq(dest, (np.arange(9, dtype=np.int64) + 10 * prv).reshape(3, 3).T)
+
+        rev_src = np.arange(5, dtype=np.float64) + rank
+        rev_dest = np.zeros(5)
+        MPI.Sendrecv(rev_src[::-1], nxt, 4, rev_dest[::-1], prv, 4, comm)
+        assert aeq(rev_dest, np.arange(5, dtype=np.float64) + prv)
+
+    run_spmd(body, nprocs)

@@ -1,0 +1,134 @@
+"""Wait/Test completion-family tests (reference: test/test_wait.jl,
+test_test.jl) plus Cancel (src/pointtopoint.jl:677-681)."""
+
+import numpy as np
+
+import tpu_mpi as MPI
+from tpu_mpi.testing import aeq, run_spmd
+
+
+def _ring(rank, size):
+    return (rank + 1) % size, (rank - 1) % size
+
+
+def test_waitall(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        nxt, prv = _ring(rank, size)
+        recvs = [AT.zeros((4,)) for _ in range(3)]
+        reqs = []
+        for i in range(3):
+            reqs.append(MPI.Irecv(recvs[i], prv, 10 + i, comm))
+        for i in range(3):
+            reqs.append(MPI.Isend(AT.full((4,), rank + i, dtype=np.float64), nxt, 10 + i, comm))
+        stats = MPI.Waitall(reqs)
+        assert len(stats) == 6
+        for i in range(3):
+            assert aeq(recvs[i], np.full(4, prv + i))
+            assert stats[i].source == prv and stats[i].tag == 10 + i
+        # After Waitall every request is inactive (deallocated analog,
+        # test_wait.jl:22-41).
+        assert all(not r.active for r in reqs)
+
+    run_spmd(body, nprocs)
+
+
+def test_waitany_waitsome(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        nxt, prv = _ring(rank, size)
+        recvs = [AT.zeros((2,)) for _ in range(4)]
+        rreqs = [MPI.Irecv(recvs[i], prv, i, comm) for i in range(4)]
+        for i in range(4):
+            MPI.Send(AT.full((2,), i, dtype=np.float64), nxt, i, comm)
+        seen = set()
+        while len(seen) < 4:
+            idx, st = MPI.Waitany(rreqs)
+            assert idx is not None and idx not in seen
+            seen.add(idx)
+            assert st.source == prv
+        assert seen == {0, 1, 2, 3}
+        # All consumed: Waitany on inactive requests returns (None, empty).
+        idx, st = MPI.Waitany(rreqs)
+        assert idx is None
+
+        # Waitsome drains in batches.
+        recvs2 = [AT.zeros((2,)) for _ in range(3)]
+        rreqs2 = [MPI.Irecv(recvs2[i], prv, 100 + i, comm) for i in range(3)]
+        for i in range(3):
+            MPI.Send(AT.full((2,), i, dtype=np.float64), nxt, 100 + i, comm)
+        done = []
+        while len(done) < 3:
+            idxs, stats = MPI.Waitsome(rreqs2)
+            assert idxs
+            done.extend(idxs)
+        assert sorted(done) == [0, 1, 2]
+        idxs, stats = MPI.Waitsome(rreqs2)
+        assert idxs == []
+
+    run_spmd(body, nprocs)
+
+
+def test_testall_testany_testsome(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        nxt, prv = _ring(rank, size)
+        recv = AT.zeros((2,))
+        rreq = MPI.Irecv(recv, prv, 7, comm)
+
+        # Not yet satisfied (nothing sent): Test returns (False, None) —
+        # test_test.jl:30-53.
+        done, st = MPI.Test(rreq)
+        if not done:
+            assert st is None
+        MPI.Send(AT.full((2,), rank, dtype=np.float64), nxt, 7, comm)
+        while True:
+            done, st = MPI.Test(rreq)
+            if done:
+                break
+        assert aeq(recv, np.full(2, prv))
+        # A consumed request tests as done with empty status.
+        done, st = MPI.Test(rreq)
+        assert done
+
+        # Testall over a mixed batch
+        recvs = [AT.zeros((1,)) for _ in range(2)]
+        reqs = [MPI.Irecv(recvs[i], prv, 20 + i, comm) for i in range(2)]
+        for i in range(2):
+            MPI.Send(AT.full((1,), i, dtype=np.float64), nxt, 20 + i, comm)
+        while True:
+            alldone, stats = MPI.Testall(reqs)
+            if alldone:
+                break
+        assert len(stats) == 2
+
+        # Testany / Testsome on fresh requests
+        recvs = [AT.zeros((1,)) for _ in range(2)]
+        reqs = [MPI.Irecv(recvs[i], prv, 30 + i, comm) for i in range(2)]
+        for i in range(2):
+            MPI.Send(AT.full((1,), i, dtype=np.float64), nxt, 30 + i, comm)
+        got = set()
+        while len(got) < 2:
+            idxs, stats = MPI.Testsome(reqs)
+            got.update(idxs)
+        assert got == {0, 1}
+
+    run_spmd(body, nprocs)
+
+
+def test_cancel(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        # Post a receive that will never be satisfied, then cancel it.
+        recv = AT.zeros((2,))
+        req = MPI.Irecv(recv, rank, 999, comm)  # nothing self-sent on tag 999
+        MPI.Cancel(req)
+        st = MPI.Wait(req)  # completes as cancelled
+        assert not req.active
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs)

@@ -142,6 +142,8 @@ def Scatter(*args) -> Any:
         if _is_none(sendbuf):
             raise MPIError("root must supply a send buffer to Scatter")
         assert_minlength(sendbuf, count * size)
+    if not alloc and not (isroot and _is_none(recvbuf)):
+        assert_minlength(recvbuf, count)   # before the rendezvous (see Gather)
     payload = to_wire(sendbuf, count * size) if isroot else None
 
     def combine(cs):
@@ -154,7 +156,6 @@ def Scatter(*args) -> Any:
         return clone_like(template, chunk) if template is not None else np.array(chunk)
     if isroot and _is_none(recvbuf):
         return sendbuf          # IN_PLACE at root: data already in place
-    assert_minlength(recvbuf, count)
     write_flat(recvbuf, chunk, count)
     return recvbuf
 
@@ -261,6 +262,11 @@ def _gather_impl(sendbuf, recvbuf, count, root, comm, alloc, all_ranks):
             count = element_count(sendbuf)
         assert_minlength(sendbuf, count)
         payload = to_wire(sendbuf, count)
+    # Bounds-check the significant recv buffer *before* the rendezvous, like
+    # the reference checks before the ccall (src/collective.jl:230-275) — a
+    # failing rank must not have half-entered the collective.
+    if not alloc and isroot and not _is_none(recvbuf):
+        assert_minlength(recvbuf, count * size)
 
     def combine(cs):
         xp = np
@@ -278,7 +284,6 @@ def _gather_impl(sendbuf, recvbuf, count, root, comm, alloc, all_ranks):
     if alloc:
         template = sendbuf if not inplace else recvbuf
         return clone_like(template, full)
-    assert_minlength(recvbuf, count * size)
     write_flat(recvbuf, full, count * size)
     return recvbuf
 
@@ -326,6 +331,8 @@ def _gatherv_impl(sendbuf, recvbuf, counts, root, comm, alloc, all_ranks):
     else:
         assert_minlength(sendbuf, counts[rank])
         payload = to_wire(sendbuf, counts[rank])
+    if not alloc and isroot and not _is_none(recvbuf):
+        assert_minlength(recvbuf, sum(counts))   # before the rendezvous
 
     def combine(cs):
         xp = np
@@ -340,7 +347,6 @@ def _gatherv_impl(sendbuf, recvbuf, counts, root, comm, alloc, all_ranks):
     if alloc:
         template = sendbuf if not inplace else recvbuf
         return clone_like(template, full)
-    assert_minlength(recvbuf, sum(counts))
     write_flat(recvbuf, full, sum(counts))
     return recvbuf
 
@@ -366,6 +372,8 @@ def Alltoall(*args) -> Any:
     inplace = isinstance(sendbuf, _InPlace) or sendbuf is None
     src = recvbuf if inplace else sendbuf
     assert_minlength(src, count * size)
+    if not alloc and not inplace:
+        assert_minlength(recvbuf, count * size)   # before the rendezvous
     payload = to_wire(src, count * size)
 
     def combine(cs):
@@ -398,6 +406,8 @@ def Alltoallv(*args) -> Any:
     scounts = [int(c) for c in scounts]
     rcounts = [int(c) for c in rcounts]
     assert_minlength(sendbuf, sum(scounts))
+    if not alloc:
+        assert_minlength(recvbuf, sum(rcounts))   # before the rendezvous
     payload = (to_wire(sendbuf, sum(scounts)), scounts)
 
     def combine(cs):
