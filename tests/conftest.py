@@ -23,6 +23,10 @@ if "TPU_MPI_TEST_REAL_TPU" not in os.environ:
     import jax._src.xla_bridge as _xb
     jax.config.update("jax_platforms", "cpu")
     _xb._backend_factories.pop("axon", None)
+    # Device arrays must hold 64-bit dtypes faithfully (the reference tests
+    # CuArray{Int64}); without this jax silently downcasts to int32, which
+    # byte-level paths (File I/O, RMA) would corrupt.
+    jax.config.update("jax_enable_x64", True)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,150 @@
+"""Parallel I/O tests (reference: test/test_io.jl:21-45 collective/
+noncollective interleavings, plus view patterns)."""
+
+import os
+import tempfile
+
+import numpy as np
+
+import tpu_mpi as MPI
+from tpu_mpi.datatypes import Types
+from tpu_mpi.testing import aeq, run_spmd
+
+
+def _tmpname(comm):
+    rank = MPI.Comm_rank(comm)
+    name = tempfile.mktemp(prefix="tpu_mpi_io_") if rank == 0 else None
+    return MPI.bcast(name, 0, comm)
+
+
+def test_io_interleaved(AT, nprocs):
+    """The reference's exact scenario (test_io.jl:21-45)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, sz = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        filename = _tmpname(comm)
+        MPI.Barrier(comm)
+
+        fh = MPI.File.open(comm, filename, read=True, write=True, create=True)
+        try:
+            MPI.File.set_view(fh, 0, MPI.INT64, MPI.INT64)
+            # Collective write: rank writes [rank+1, rank+1] at element 2*rank.
+            MPI.File.write_at_all(fh, rank * 2, AT.full((2,), rank + 1, dtype=np.int64))
+            MPI.File.sync(fh)
+
+            # Noncollective read on rank 0 sees every rank's data.
+            if rank == 0:
+                data = np.zeros(2 * sz, dtype=np.int64)
+                MPI.File.read_at(fh, 0, data)
+                expected = np.repeat(np.arange(1, sz + 1), 2)
+                assert aeq(data, expected)
+            MPI.File.sync(fh)
+            MPI.Barrier(comm)
+
+            if rank == sz - 1:
+                MPI.File.write_at(fh, 0, AT.full((2,), -1, dtype=np.int64))
+            MPI.File.sync(fh)
+
+            # Collective read
+            data = np.zeros(1, dtype=np.int64)
+            MPI.File.read_at_all(fh, rank * 2, data)
+            assert data[0] == (-1 if rank == 0 else rank + 1)
+        finally:
+            fh.close()
+            MPI.Barrier(comm)
+            if rank == 0:
+                os.unlink(filename)
+
+    run_spmd(body, nprocs)
+
+
+def test_io_byte_default_view(nprocs):
+    """Without set_view, offsets are byte offsets (etype = BYTE)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, sz = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        filename = _tmpname(comm)
+        fh = MPI.File.open(comm, filename, read=True, write=True, create=True)
+        try:
+            payload = np.full(4, rank, dtype=np.uint8)
+            MPI.File.write_at_all(fh, rank * 4, payload)
+            MPI.File.sync(fh)
+            everything = np.zeros(4 * sz, dtype=np.uint8)
+            MPI.File.read_at_all(fh, 0, everything)
+            assert aeq(everything, np.repeat(np.arange(sz, dtype=np.uint8), 4))
+            assert MPI.File.get_size(fh) == 4 * sz
+        finally:
+            fh.close()
+            MPI.Barrier(comm)
+            if rank == 0:
+                os.unlink(filename)
+
+    run_spmd(body, nprocs)
+
+
+def test_io_strided_filetype(nprocs):
+    """A vector filetype interleaves ranks' elements — the datatype-view
+    offset arithmetic (SURVEY.md §2.3 'file views = offset arithmetic')."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, sz = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        filename = _tmpname(comm)
+        fh = MPI.File.open(comm, filename, read=True, write=True, create=True)
+        try:
+            # Each rank's view: 1 int64 every sz int64s, starting at its slot.
+            ft = Types.create_vector(1, 1, sz, MPI.INT64)
+            ft = Types.create_resized(ft, 0, sz * 8)
+            MPI.File.set_view(fh, rank * 8, MPI.INT64, ft)
+            mine = np.full(3, rank, dtype=np.int64)   # 3 tiles
+            MPI.File.write_at_all(fh, 0, mine)
+            MPI.File.sync(fh)
+
+            # Raw byte check: round-robin pattern [0,1,..,sz-1] x 3.
+            MPI.Barrier(comm)
+            if rank == 0:
+                raw = np.fromfile(filename, dtype=np.int64)
+                assert aeq(raw, np.tile(np.arange(sz), 3))
+
+            # Read back through the same view.
+            back = np.zeros(3, dtype=np.int64)
+            MPI.File.read_at_all(fh, 0, back)
+            assert aeq(back, mine)
+        finally:
+            fh.close()
+            MPI.Barrier(comm)
+            if rank == 0:
+                os.unlink(filename)
+
+    run_spmd(body, nprocs)
+
+
+def test_io_checkpoint_roundtrip(nprocs):
+    """Checkpoint/restore a sharded model state through the File layer
+    (SURVEY.md §5: checkpoint parity = the File layer)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, sz = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        filename = _tmpname(comm)
+        shard = np.arange(16, dtype=np.float32) + 100 * rank
+        fh = MPI.File.open(comm, filename, write=True, create=True)
+        try:
+            MPI.File.set_view(fh, 0, MPI.FLOAT32, MPI.FLOAT32)
+            MPI.File.write_at_all(fh, rank * 16, shard)
+            MPI.File.sync(fh)
+        finally:
+            fh.close()
+        MPI.Barrier(comm)
+
+        fh = MPI.File.open(comm, filename, read=True)
+        try:
+            MPI.File.set_view(fh, 0, MPI.FLOAT32, MPI.FLOAT32)
+            restored = np.zeros(16, dtype=np.float32)
+            MPI.File.read_at_all(fh, rank * 16, restored)
+            assert aeq(restored, shard)
+        finally:
+            fh.close()
+            MPI.Barrier(comm)
+            if rank == 0:
+                os.unlink(filename)
+
+    run_spmd(body, nprocs)

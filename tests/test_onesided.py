@@ -1,0 +1,180 @@
+"""One-sided RMA tests (reference: test/test_onesided.jl:17-130)."""
+
+import numpy as np
+
+import tpu_mpi as MPI
+from tpu_mpi.testing import aeq, run_spmd
+
+
+def test_get_fence(AT, nprocs):
+    """Fence-epoch Get from the right neighbor (test_onesided.jl:17-23)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, N = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        buf = AT.full((N,), rank, dtype=np.int64)
+        received = AT.full((N,), -1, dtype=np.int64)
+        win = MPI.Win_create(buf, comm)
+        MPI.Win_fence(0, win)
+        MPI.Get(received, (rank + 1) % N, win)
+        MPI.Win_fence(0, win)
+        assert aeq(received, np.full(N, (rank + 1) % N))
+        MPI.Barrier(comm)
+        win.free()
+
+    run_spmd(body, nprocs)
+
+
+def test_put_locked(AT, nprocs):
+    """Locked-window Put into rank 0 (test_onesided.jl:25-39)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, N = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        buf = AT.full((N,), rank, dtype=np.int64)
+        win = MPI.Win_create(buf, comm)
+        if rank != 0:
+            MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 0, 0, win)
+            mine = AT.full((1,), rank, dtype=np.int64)
+            MPI.Put(mine, 1, 0, rank, win)
+            MPI.Win_unlock(0, win)
+        else:
+            # Lock our own window too: DeviceBuffer targets rebind the whole
+            # array per write, so unserialized concurrent writers could lose
+            # updates (host byte-writes to distinct slots would not).
+            MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 0, 0, win)
+            buf[0] = 0
+            MPI.Win_unlock(0, win)
+        MPI.Win_fence(0, win)
+        if rank == 0:
+            assert aeq(buf, np.arange(N))
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs)
+
+
+def test_accumulate_get_accumulate(AT, nprocs):
+    """Accumulate / Get_accumulate atomicity (test_onesided.jl:43-83)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, N = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        buf = AT.zeros((4,), dtype=np.int64)
+        win = MPI.Win_create(buf, comm)
+        MPI.Win_fence(0, win)
+        # Every rank accumulates its rank+1 into rank 0's window.
+        contrib = AT.full((4,), rank + 1, dtype=np.int64)
+        MPI.Accumulate(contrib, 4, 0, 0, MPI.SUM, win)
+        MPI.Win_fence(0, win)
+        if rank == 0:
+            assert aeq(buf, np.full(4, N * (N + 1) // 2))
+        MPI.Barrier(comm)
+
+        # Get_accumulate: fetch old values then add (rank 0 only, onto rank 1).
+        if rank == 1:
+            buf.fill(3)
+        MPI.Barrier(comm)
+        if rank == 0:
+            origin = AT.full((4,), 2, dtype=np.int64)
+            result = AT.zeros((4,), dtype=np.int64)
+            MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 1, 0, win)
+            MPI.Get_accumulate(origin, result, 4, 1, 0, MPI.SUM, win)
+            MPI.Win_unlock(1, win)
+            assert aeq(result, np.full(4, 3))
+        MPI.Barrier(comm)
+        if rank == 1:
+            assert aeq(buf, np.full(4, 5))
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs)
+
+
+def test_fetch_and_op_dynamic(AT, nprocs):
+    """Dynamic window + byte addressing + Fetch_and_op REPLACE
+    (test_onesided.jl:89-124)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, N = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        buf = np.full(N, rank, dtype=np.int64)    # host array: addressable
+        win = MPI.Win_create_dynamic(comm)
+        MPI.Win_attach(win, buf)
+
+        # Share each rank's base address through a second (static) window.
+        address_buf = np.zeros(1, dtype=np.int64)
+        address_win = MPI.Win_create(address_buf, comm)
+        MPI.Win_lock(MPI.LOCK_EXCLUSIVE, rank, 0, address_win)
+        address_buf[0] = MPI.Get_address(buf)
+        MPI.Win_unlock(rank, address_win)
+        MPI.Barrier(comm)
+
+        if rank == 0:
+            received = np.zeros(1, dtype=np.int64)
+            to_send = np.zeros(1, dtype=np.int64)
+            for r in range(N):
+                address = np.zeros(1, dtype=np.int64)
+                MPI.Win_lock(MPI.LOCK_EXCLUSIVE, r, 0, address_win)
+                MPI.Get(address, r, address_win)
+                MPI.Win_flush(r, address_win)
+                to_send[0] = r + 5
+                MPI.Win_lock(MPI.LOCK_EXCLUSIVE, r, 0, win)
+                MPI.Fetch_and_op(to_send, received, r, int(address[0]) + r * 8,
+                                 MPI.REPLACE, win)
+                MPI.Win_flush(r, win)
+                assert received[0] == r
+                MPI.Win_unlock(r, win)
+                MPI.Win_unlock(r, address_win)
+        MPI.Barrier(comm)
+        assert buf[rank] == rank + 5
+        MPI.Barrier(comm)
+        MPI.Win_detach(win, buf)
+        win.free()
+        address_win.free()
+
+    run_spmd(body, nprocs)
+
+
+def test_shared_window(nprocs):
+    """Node-shared allocation + query (reference: test/test_shared_win.jl)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, N = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        owner = min(1, N - 1)
+        length = 100 * 2 if rank == owner else 0
+        win, local = MPI.Win_allocate_shared(np.float32, length, comm)
+        size_bytes, disp_unit, shared = MPI.Win_shared_query(win, owner)
+        assert disp_unit == 4
+        assert size_bytes == 200 * 4
+        arr = np.asarray(shared).reshape(100, 2)
+        if rank == 0:
+            arr[:, 0] = np.arange(1, 101)
+        elif rank == 1:
+            arr[:, 1] = np.arange(901, 1001)
+        MPI.Barrier(comm)
+        assert aeq(arr[:, 0], np.arange(1, 101))
+        if N > 1:
+            assert aeq(arr[:, 1], np.arange(901, 1001))
+        MPI.Barrier(comm)
+        win.free()
+
+    run_spmd(body, nprocs)
+
+
+def test_lock_mutual_exclusion(nprocs):
+    """Exclusive locks serialize concurrent read-modify-write (the passive-
+    target guarantee SURVEY.md §2.3 asks the emulation to provide)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, N = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        buf = np.zeros(1, dtype=np.int64)
+        win = MPI.Win_create(buf, comm)
+        MPI.Win_fence(0, win)
+        for _ in range(25):
+            MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 0, 0, win)
+            tmp = np.zeros(1, dtype=np.int64)
+            MPI.Get(tmp, 1, 0, 0, win)
+            tmp[0] += 1
+            MPI.Put(tmp, 1, 0, 0, win)
+            MPI.Win_unlock(0, win)
+        MPI.Barrier(comm)
+        if rank == 0:
+            assert buf[0] == 25 * N
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs)

@@ -55,6 +55,17 @@ from .pointtopoint import (Cancel, Get_count, Get_error, Get_source, Get_tag,
                            Test, Testall, Testany, Testsome, Wait, Waitall,
                            Waitany, Waitsome, irecv, isend, recv, send)
 
+# Parallel I/O (src/io.jl) — usage: MPI.File.open / read_at / write_at_all …
+from . import io as File
+from .io import FileHandle
+
+# One-sided RMA (src/onesided.jl)
+from .onesided import (Accumulate, Fetch_and_op, Get, Get_accumulate,
+                       LOCK_EXCLUSIVE, LOCK_SHARED, LockType, Put, Win,
+                       Win_allocate_shared, Win_attach, Win_create,
+                       Win_create_dynamic, Win_detach, Win_fence, Win_flush,
+                       Win_lock, Win_shared_query, Win_sync, Win_unlock)
+
 # Topology (src/topology.jl)
 from .topology import (Cart_coords, Cart_create, Cart_get, Cart_rank,
                        Cart_shift, Cart_sub, CartComm, Cartdim_get,

@@ -386,7 +386,6 @@ def Alltoall(*args) -> Any:
     mine = _run(comm, payload, combine, f"Alltoall@{comm.cid}")
     if alloc:
         return clone_like(src, mine)
-    assert_minlength(recvbuf, count * size)
     write_flat(recvbuf, mine, count * size)
     return recvbuf
 
@@ -427,7 +426,6 @@ def Alltoallv(*args) -> Any:
     mine = _run(comm, payload, combine, f"Alltoallv@{comm.cid}")
     if alloc:
         return clone_like(sendbuf, mine)
-    assert_minlength(recvbuf, sum(rcounts))
     write_flat(recvbuf, mine, sum(rcounts))
     return recvbuf
 

@@ -1,0 +1,262 @@
+"""Parallel file I/O: shared-file explicit-offset reads/writes with views.
+
+Reference: /root/reference/src/io.jl — FileHandle (:1-3), File.open with
+Julia-style kwargs→amode flags (:12-62), close (:64-72), set_view!
+(disp+etype+filetype+datarep, :87-98), sync (:111-115), read_at! (:131-140),
+read_at_all! collective (:155-165), write_at (:179-188), write_at_all
+collective (:203-212).
+
+TPU mapping (SURVEY.md §2.3): POSIX pread/pwrite at rank-computed offsets into
+one shared file, with rendezvous barriers bracketing the ``_all`` collective
+variants; datatype file views become offset arithmetic — an element index maps
+through the filetype's block pattern tiled from ``disp``. This is also the
+checkpoint substrate (SURVEY.md §5: "checkpoint/resume parity = the File
+layer"); a tensorstore/Zarr backend can slot behind the same API later.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+from .buffers import Buffer, extract_array, to_wire, write_flat
+from .comm import Comm
+from .datatypes import BYTE, Datatype, to_datatype
+from .error import MPIError
+from .pointtopoint import Status
+
+
+class FileHandle:
+    """An open shared file plus this rank's view (src/io.jl:1-3).
+
+    Each rank holds its own OS file descriptor on the shared path; the view
+    (disp, etype, filetype) is per-rank state exactly as in MPI.
+    """
+
+    def __init__(self, comm: Comm, path: str, fd: int, deleteonclose: bool):
+        self.comm = comm
+        self.path = path
+        self.fd: Optional[int] = fd
+        self.deleteonclose = deleteonclose
+        # Default view: displacement 0, etype = filetype = BYTE (byte offsets).
+        self.disp = 0
+        self.etype: Datatype = BYTE
+        self.filetype: Datatype = BYTE
+        self.datarep = "native"
+
+    def _check(self) -> None:
+        if self.fd is None:
+            raise MPIError("file has been closed")
+
+    def close(self) -> None:
+        if self.fd is not None:
+            os.close(self.fd)
+            self.fd = None
+            if self.deleteonclose and self.comm.rank() == 0:
+                try:
+                    os.unlink(self.path)
+                except FileNotFoundError:
+                    pass
+
+    def __enter__(self) -> "FileHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __repr__(self) -> str:
+        state = "closed" if self.fd is None else "open"
+        return f"<FileHandle {self.path!r} ({state})>"
+
+
+def open(comm: Comm, filename: str, *, read: Optional[bool] = None,
+         write: Optional[bool] = None, create: Optional[bool] = None,
+         append: Optional[bool] = None, sequential: bool = False,
+         uniqueopen: bool = False, deleteonclose: bool = False,
+         **infokws) -> FileHandle:
+    """Collectively open ``filename`` (src/io.jl:40-62). Keywords mirror the
+    reference's Base.open-style flags; extra kwargs are Info hints."""
+    do_read = bool(read) if read is not None else not bool(write)
+    do_write = bool(write) if write is not None else False
+    do_create = bool(create) if create is not None else do_write
+    do_append = bool(append) if append is not None else False
+
+    flags = 0
+    if do_read and do_write:
+        flags |= os.O_RDWR
+    elif do_write:
+        flags |= os.O_WRONLY
+    else:
+        flags |= os.O_RDONLY
+    if do_write and do_create:
+        flags |= os.O_CREAT
+    if do_append:
+        flags |= os.O_APPEND
+
+    # Collective: rank 0 creates first so O_CREAT races cannot produce
+    # different inodes on network filesystems; then everyone opens.
+    rank = comm.rank()
+    if rank == 0:
+        fd = os.open(filename, flags, 0o644)
+        comm.channel().run(rank, None, lambda cs: [None] * len(cs),
+                           f"File.open@{comm.cid}")
+    else:
+        comm.channel().run(rank, None, lambda cs: [None] * len(cs),
+                           f"File.open@{comm.cid}")
+        fd = os.open(filename, flags, 0o644)
+    return FileHandle(comm, filename, fd, deleteonclose)
+
+
+def close(file: FileHandle) -> None:
+    """Close the handle (src/io.jl:64-72)."""
+    file.close()
+
+
+def set_view(file: FileHandle, disp: int, etype: Any, filetype: Any,
+             datarep: str = "native", **infokws) -> FileHandle:
+    """Set this rank's file view (src/io.jl:87-98): data starts at byte
+    ``disp``; offsets in read/write calls count ``etype`` elements; the
+    ``filetype`` pattern tiles the file from disp."""
+    file._check()
+    file.disp = int(disp)
+    file.etype = to_datatype(etype)
+    file.filetype = to_datatype(filetype) if filetype is not None else file.etype
+    file.datarep = datarep
+    return file
+
+
+# Julia-parity alias (set_view! in the reference).
+set_view_ = set_view
+
+
+def sync(file: FileHandle) -> None:
+    """Flush writes to storage, collectively (src/io.jl:111-115)."""
+    file._check()
+    os.fsync(file.fd)
+    file.comm.channel().run(file.comm.rank(), None, lambda cs: [None] * len(cs),
+                            f"File.sync@{file.comm.cid}")
+
+
+def _view_byte_ranges(file: FileHandle, offset_etype: int, nbytes: int):
+    """Map a span of ``nbytes`` payload bytes starting at element offset
+    ``offset_etype`` (in etype units) through the view to (file_byte, length)
+    runs. Contiguous filetype ⇒ one run; holes in the filetype tile the
+    pattern across extents."""
+    et = file.etype
+    ft = file.filetype
+    esz = et.extent_bytes
+    # Payload byte ranges inside one filetype extent, in pattern order.
+    runs = [(off, dt.itemsize * c) for (off, dt, c) in ft.blocks]
+    bytes_per_tile = sum(n for _, n in runs)
+    if bytes_per_tile == ft.extent_bytes and len(runs) <= 1:
+        # Dense view: plain offset arithmetic.
+        start = file.disp + offset_etype * esz
+        return [(start, nbytes)]
+    out = []
+    want_start = offset_etype * esz          # payload byte position
+    want_end = want_start + nbytes
+    tile = want_start // bytes_per_tile
+    payload_pos = tile * bytes_per_tile
+    while payload_pos < want_end:
+        for (off, length) in runs:
+            seg_start = payload_pos
+            seg_end = payload_pos + length
+            lo = max(seg_start, want_start)
+            hi = min(seg_end, want_end)
+            if lo < hi:
+                file_byte = file.disp + tile * ft.extent_bytes + off + (lo - seg_start)
+                out.append((file_byte, hi - lo))
+            payload_pos = seg_end
+        tile += 1
+    return out
+
+
+def _read_into(file: FileHandle, offset: int, data: Any) -> Status:
+    file._check()
+    buf = data if isinstance(data, Buffer) else Buffer(data)
+    count = buf.count
+    arr = extract_array(buf.data)
+    # Payload length matches what _write_from emits: the raw array bytes
+    # (itemsize includes struct padding; Datatype.size_bytes does not).
+    nbytes = count * arr.dtype.itemsize
+    chunks = []
+    for (pos, length) in _view_byte_ranges(file, int(offset), nbytes):
+        chunk = os.pread(file.fd, length, pos)
+        if len(chunk) < length:
+            chunk = chunk + b"\x00" * (length - len(chunk))   # short read past EOF
+        chunks.append(chunk)
+    raw = b"".join(chunks)
+    vals = np.frombuffer(raw[:nbytes], dtype=arr.dtype, count=count)
+    write_flat(buf.data, vals, count)
+    return Status(source=0, tag=0, count=count)
+
+
+def _write_from(file: FileHandle, offset: int, data: Any) -> Status:
+    file._check()
+    buf = data if isinstance(data, Buffer) else Buffer(data)
+    count = buf.count
+    wire = np.asarray(to_wire(buf.data, count))
+    raw = wire.tobytes()
+    pos_in = 0
+    for (pos, length) in _view_byte_ranges(file, int(offset), len(raw)):
+        os.pwrite(file.fd, raw[pos_in:pos_in + length], pos)
+        pos_in += length
+    return Status(source=0, tag=0, count=count)
+
+
+def read_at(file: FileHandle, offset: int, data: Any) -> Status:
+    """Noncollective read at explicit offset (src/io.jl:131-140).
+    ``offset`` is in etype units of the current view."""
+    return _read_into(file, offset, data)
+
+
+def read_at_all(file: FileHandle, offset: int, data: Any) -> Status:
+    """Collective read_at (src/io.jl:155-165): all ranks must call; barriers
+    bracket the read so it observes every write issued before the collective."""
+    comm = file.comm
+    comm.channel().run(comm.rank(), None, lambda cs: [None] * len(cs),
+                       f"File.read_at_all:pre@{comm.cid}")
+    st = _read_into(file, offset, data)
+    comm.channel().run(comm.rank(), None, lambda cs: [None] * len(cs),
+                       f"File.read_at_all:post@{comm.cid}")
+    return st
+
+
+def write_at(file: FileHandle, offset: int, data: Any) -> Status:
+    """Noncollective write at explicit offset (src/io.jl:179-188)."""
+    return _write_from(file, offset, data)
+
+
+def write_at_all(file: FileHandle, offset: int, data: Any) -> Status:
+    """Collective write_at (src/io.jl:203-212)."""
+    comm = file.comm
+    comm.channel().run(comm.rank(), None, lambda cs: [None] * len(cs),
+                       f"File.write_at_all:pre@{comm.cid}")
+    st = _write_from(file, offset, data)
+    comm.channel().run(comm.rank(), None, lambda cs: [None] * len(cs),
+                       f"File.write_at_all:post@{comm.cid}")
+    return st
+
+
+def get_size(file: FileHandle) -> int:
+    """File size in bytes (MPI_File_get_size)."""
+    file._check()
+    return os.fstat(file.fd).st_size
+
+
+def set_size(file: FileHandle, size: int) -> None:
+    """Collectively truncate/extend (MPI_File_set_size)."""
+    file._check()
+    os.ftruncate(file.fd, int(size))
+    file.comm.channel().run(file.comm.rank(), None, lambda cs: [None] * len(cs),
+                            f"File.set_size@{file.comm.cid}")
+
+
+def delete(filename: str) -> None:
+    """Delete a file (MPI_File_delete)."""
+    try:
+        os.unlink(filename)
+    except FileNotFoundError:
+        pass

@@ -1,0 +1,378 @@
+"""One-sided RMA: windows, epochs, Put/Get/Accumulate atomics.
+
+Reference: /root/reference/src/onesided.jl — Win handle (:1), LockType
+EXCLUSIVE/SHARED (:6-10), Win_create (:24-34), Win_create_dynamic (:47-56),
+Win_allocate_shared + Win_shared_query (:72-107), Win_attach/Win_detach
+(:109-121), Win_fence (:123-126), Win_flush (:128-131), Win_sync (:133-136),
+Win_lock/Win_unlock (:138-148), Get (:150-166), Put (:168-184), Fetch_and_op
+(:186-195), Accumulate (:197-206), Get_accumulate (:208-219).
+
+TPU mapping (SURVEY.md §2.3): a Win exposes a device/host buffer for remote
+access. On the semantic path (this module) ranks share one address space, so
+Put/Get are direct strided copies into the target's buffer — the same
+zero-copy position Pallas remote DMA (`pltpu.make_async_remote_copy`) holds on
+the compiled path (`tpu_mpi.xla.pallas_kernels`). Epoch calls map to the
+rendezvous barrier (fence) and to real reader/writer locks (passive target);
+Accumulate/Fetch_and_op take a per-target mutex, giving the element-wise
+atomicity MPI guarantees for accumulates.
+
+Target displacements follow MPI's disp_unit scaling: windows created over an
+array use its element size as disp_unit (displacements are element offsets,
+src/onesided.jl:30); dynamic windows use byte addresses obtained from
+:func:`~tpu_mpi.datatypes.Get_address` (test_onesided.jl:96-99).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ._runtime import require_env, _DEADLOCK_TIMEOUT, _POLL
+from .buffers import DeviceBuffer, extract_array, element_count, write_flat
+from .comm import Comm
+from .datatypes import Get_address
+from .error import DeadlockError, MPIError
+from .operators import Op, REPLACE, NO_OP, as_op
+
+
+class LockType:
+    """Win_lock mode (src/onesided.jl:6-10)."""
+
+    def __init__(self, val: int, name: str):
+        self.val = val
+        self.name = name
+
+    def __repr__(self) -> str:
+        return f"LOCK_{self.name}"
+
+
+LOCK_EXCLUSIVE = LockType(1, "EXCLUSIVE")
+LOCK_SHARED = LockType(2, "SHARED")
+
+
+class _RWLock:
+    """Reader/writer lock with failure-aware waits — the passive-target
+    emulation SURVEY.md §2.3 calls for (no ICI lock primitive exists)."""
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.readers = 0
+        self.writer = False
+
+    def acquire(self, ctx, exclusive: bool) -> None:
+        deadline = time.monotonic() + _DEADLOCK_TIMEOUT
+        with self.cond:
+            while self.writer or (exclusive and self.readers > 0):
+                ctx.check_failure()
+                if time.monotonic() > deadline:
+                    raise DeadlockError("deadlock suspected: Win_lock blocked "
+                                        f">{_DEADLOCK_TIMEOUT}s")
+                self.cond.wait(_POLL)
+            if exclusive:
+                self.writer = True
+            else:
+                self.readers += 1
+
+    def release(self, exclusive: bool) -> None:
+        with self.cond:
+            if exclusive:
+                self.writer = False
+            else:
+                self.readers -= 1
+            self.cond.notify_all()
+
+
+class _WinState:
+    """State shared by every rank's Win handle (created once per collective
+    Win_create by the rendezvous combiner)."""
+
+    def __init__(self, size: int, dynamic: bool = False):
+        self.size = size
+        self.dynamic = dynamic
+        # rank -> (buffer, disp_unit); dynamic windows use attach lists.
+        self.buffers: dict[int, tuple[Any, int]] = {}
+        self.attached: dict[int, list[tuple[int, int, Any]]] = {r: [] for r in range(size)}
+        self.user_locks = [_RWLock() for _ in range(size)]     # Win_lock/unlock
+        self.atomic_locks = [threading.Lock() for _ in range(size)]  # accumulates
+        self.freed = False
+        self._free_count = 0
+        self._free_lock = threading.Lock()
+
+
+class Win:
+    """RMA window handle (src/onesided.jl:1-4)."""
+
+    def __init__(self, state: _WinState, comm: Comm):
+        self._state = state
+        self.comm = comm
+        self._held: list[tuple[int, bool]] = []   # (target, exclusive) lock stack
+
+    def _check(self) -> None:
+        if self._state.freed:
+            raise MPIError("window has been freed")
+
+    def free(self) -> None:
+        """Release the window. MPI_Win_free is collective (src/onesided.jl:
+        85-92): the shared state is only invalidated once every rank of the
+        communicator has called free, so stragglers can still detach."""
+        st = self._state
+        with st._free_lock:
+            st._free_count += 1
+            if st._free_count >= st.size:
+                st.freed = True
+
+    def __repr__(self) -> str:
+        kind = "dynamic" if self._state.dynamic else "static"
+        return f"<Win {kind} over comm of size {self._state.size}>"
+
+
+def _collective_state(comm: Comm, contrib, opname: str) -> Any:
+    """One rendezvous that makes the last arriver build shared state."""
+    def combine(cs):
+        st = _WinState(len(cs), dynamic=all(c is None for c in cs))
+        for r, c in enumerate(cs):
+            if c is not None:
+                st.buffers[r] = c
+        return [st] * len(cs)
+
+    return comm.channel().run(comm.rank(), contrib, combine, opname)
+
+
+def Win_create(base: Any, comm: Comm, **infokws) -> Win:
+    """Collectively create a window over each rank's ``base`` array
+    (src/onesided.jl:24-34). disp_unit = element size, so displacements in
+    Put/Get/accumulates are element offsets into the target's array."""
+    arr = extract_array(base)
+    if arr is None:
+        raise MPIError(f"not a window buffer: {type(base).__name__}")
+    disp_unit = arr.dtype.itemsize
+    st = _collective_state(comm, (base, disp_unit), f"Win_create@{comm.cid}")
+    return Win(st, comm)
+
+
+def Win_create_dynamic(comm: Comm, **infokws) -> Win:
+    """Collectively create a window with no initial memory
+    (src/onesided.jl:47-56); use :func:`Win_attach` to expose buffers."""
+    st = _collective_state(comm, None, f"Win_create_dynamic@{comm.cid}")
+    st.dynamic = True
+    return Win(st, comm)
+
+
+def Win_allocate_shared(T: Any, length: int, comm: Comm, **infokws):
+    """Allocate ``length`` elements of node-shared memory per rank
+    (src/onesided.jl:72-83). Returns ``(win, array)``; peers reach another
+    rank's slab via :func:`Win_shared_query`. Ranks share one address space
+    here, so the owner's numpy array *is* the shared block."""
+    dtype = np.dtype(T) if not hasattr(T, "np_dtype") else T.np_dtype
+    local = np.zeros(int(length), dtype=dtype)
+    st = _collective_state(comm, (local, dtype.itemsize),
+                           f"Win_allocate_shared@{comm.cid}")
+    return Win(st, comm), local
+
+
+def Win_shared_query(win: Win, owner_rank: int):
+    """(size_bytes, disp_unit, buffer) of a peer's shared slab
+    (src/onesided.jl:97-107). The buffer is the live shared array — the
+    pointer-free analog of the reference's baseptr."""
+    win._check()
+    entry = win._state.buffers.get(int(owner_rank))
+    if entry is None:
+        raise MPIError(f"rank {owner_rank} exposes no memory in this window")
+    buf, disp_unit = entry
+    arr = extract_array(buf)
+    return arr.size * arr.dtype.itemsize, disp_unit, buf
+
+
+def Win_attach(win: Win, base: Any) -> None:
+    """Expose a buffer through a dynamic window (src/onesided.jl:109-114).
+    Targets address it by its :func:`Get_address` byte address."""
+    win._check()
+    if not win._state.dynamic:
+        raise MPIError("Win_attach requires a dynamic window")
+    arr = extract_array(base)
+    addr = Get_address(arr)
+    rank = win.comm.rank()
+    win._state.attached[rank].append((addr, arr.size * arr.dtype.itemsize, base))
+
+
+def Win_detach(win: Win, base: Any) -> None:
+    """Remove an attached buffer (src/onesided.jl:116-121)."""
+    win._check()
+    rank = win.comm.rank()
+    lst = win._state.attached[rank]
+    for i, (_, _, b) in enumerate(lst):
+        if b is base:
+            del lst[i]
+            return
+    raise MPIError("buffer was not attached to this window")
+
+
+# ---------------------------------------------------------------------------
+# Epochs
+# ---------------------------------------------------------------------------
+
+def Win_fence(assert_: int, win: Win) -> None:
+    """Collective epoch separator (src/onesided.jl:123-126): all RMA issued
+    before the fence completes at every rank — a rendezvous barrier here,
+    since Put/Get complete synchronously in shared memory."""
+    win._check()
+    win.comm.channel().run(win.comm.rank(), None, lambda cs: [None] * len(cs),
+                           f"Win_fence@{win.comm.cid}")
+
+
+def Win_flush(rank: int, win: Win) -> None:
+    """Complete outstanding RMA to ``rank`` (src/onesided.jl:128-131).
+    Synchronous ops ⇒ ordering is already guaranteed; kept for API parity."""
+    win._check()
+
+
+def Win_sync(win: Win) -> None:
+    """Memory barrier on the window (src/onesided.jl:133-136)."""
+    win._check()
+
+
+def Win_lock(lock_type: LockType, rank: int, assert_: int, win: Win) -> None:
+    """Begin a passive-target epoch on ``rank``'s window copy
+    (src/onesided.jl:138-143): EXCLUSIVE excludes all, SHARED excludes
+    writers — a real reader/writer lock (SURVEY.md §2.3 lock emulation)."""
+    win._check()
+    ctx, _ = require_env()
+    excl = lock_type is LOCK_EXCLUSIVE or lock_type.val == LOCK_EXCLUSIVE.val
+    win._state.user_locks[int(rank)].acquire(ctx, excl)
+    win._held.append((int(rank), excl))
+
+
+def Win_unlock(rank: int, win: Win) -> None:
+    """End the passive-target epoch (src/onesided.jl:145-148)."""
+    win._check()
+    rank = int(rank)
+    for i in range(len(win._held) - 1, -1, -1):
+        if win._held[i][0] == rank:
+            _, excl = win._held.pop(i)
+            win._state.user_locks[rank].release(excl)
+            return
+    raise MPIError(f"Win_unlock: no lock held on rank {rank}")
+
+
+# ---------------------------------------------------------------------------
+# Data movement
+# ---------------------------------------------------------------------------
+
+def _target_view(win: Win, target_rank: int, target_disp: int, count: int):
+    """The flat element range [disp, disp+count) of the target's exposed
+    memory. Static windows: disp in elements of the target buffer. Dynamic
+    windows: disp is a global byte address into an attached buffer."""
+    st = win._state
+    target_rank = int(target_rank)
+    if st.dynamic:
+        addr = int(target_disp)
+        for (base_addr, nbytes, buf) in st.attached[target_rank]:
+            if base_addr <= addr < base_addr + nbytes:
+                arr = extract_array(buf)
+                off = (addr - base_addr) // arr.dtype.itemsize
+                return buf, arr, int(off)
+        raise MPIError(f"address {addr:#x} not attached on rank {target_rank}")
+    if target_rank not in st.buffers:
+        raise MPIError(f"rank {target_rank} exposes no memory in this window")
+    buf, _ = st.buffers[target_rank]
+    return buf, extract_array(buf), int(target_disp)
+
+
+def _origin_array(origin: Any) -> np.ndarray:
+    arr = extract_array(origin)
+    if arr is None:
+        raise MPIError(f"not an RMA origin buffer: {type(origin).__name__}")
+    return arr
+
+
+def Get(origin: Any, *args) -> None:
+    """``Get(origin, [count, target_rank, target_disp | target_rank], win)`` —
+    read from the target's window into origin (src/onesided.jl:150-166)."""
+    if len(args) == 2:
+        target_rank, win = args
+        count, target_disp = element_count(origin), 0
+    elif len(args) == 4:
+        count, target_rank, target_disp, win = args
+    else:
+        raise TypeError("Get(origin, [count, rank, disp,] win)")
+    win._check()
+    buf, tarr, off = _target_view(win, target_rank, target_disp, count)
+    data = np.asarray(tarr).reshape(-1)[off:off + count]
+    write_flat(origin, data, int(count))
+
+
+def Put(origin: Any, *args) -> None:
+    """``Put(origin, [count, target_rank, target_disp | target_rank], win)`` —
+    write origin into the target's window (src/onesided.jl:168-184)."""
+    if len(args) == 2:
+        target_rank, win = args
+        count, target_disp = element_count(origin), 0
+    elif len(args) == 4:
+        count, target_rank, target_disp, win = args
+    else:
+        raise TypeError("Put(origin, [count, rank, disp,] win)")
+    win._check()
+    count = int(count)
+    buf, tarr, off = _target_view(win, target_rank, target_disp, count)
+    src = _origin_array(origin).reshape(-1)[:count]
+    if isinstance(buf, DeviceBuffer):
+        flat = buf.value.reshape(-1).at[off:off + count].set(
+            np.asarray(src, dtype=buf.value.dtype))
+        buf.value = flat.reshape(buf.value.shape)
+    else:
+        np.asarray(tarr).reshape(-1)[off:off + count] = np.asarray(src)
+
+
+def _apply_op(win: Win, target_rank: int, target_disp: int, origin_flat, op: Op,
+              fetch_into: Optional[Any] = None) -> None:
+    """op-combine origin into the target range under the per-target atomic
+    mutex; optionally snapshot the old values first (Get_accumulate)."""
+    st = win._state
+    count = int(np.asarray(origin_flat).size)
+    with st.atomic_locks[int(target_rank)]:
+        buf, tarr, off = _target_view(win, target_rank, target_disp, count)
+        flat = np.asarray(tarr).reshape(-1)
+        old = flat[off:off + count].copy()
+        if fetch_into is not None:
+            write_flat(fetch_into, old, count)
+        if op is REPLACE:
+            new = np.asarray(origin_flat, dtype=old.dtype)
+        elif op is NO_OP:
+            new = None
+        else:
+            new = np.asarray(op(old, np.asarray(origin_flat, dtype=old.dtype)))
+        if new is not None:
+            if isinstance(buf, DeviceBuffer):
+                fb = buf.value.reshape(-1).at[off:off + count].set(new)
+                buf.value = fb.reshape(buf.value.shape)
+            else:
+                flat[off:off + count] = new
+
+
+def Accumulate(origin: Any, count: int, target_rank: int, target_disp: int,
+               op: Any, win: Win) -> None:
+    """Atomically combine origin into the target range with op
+    (src/onesided.jl:197-206)."""
+    win._check()
+    src = _origin_array(origin).reshape(-1)[:int(count)]
+    _apply_op(win, target_rank, target_disp, src, as_op(op))
+
+
+def Get_accumulate(origin: Any, result: Any, count: int, target_rank: int,
+                   target_disp: int, op: Any, win: Win) -> None:
+    """Fetch the old target values into result, then combine origin with op
+    (src/onesided.jl:208-219)."""
+    win._check()
+    src = _origin_array(origin).reshape(-1)[:int(count)]
+    _apply_op(win, target_rank, target_disp, src, as_op(op), fetch_into=result)
+
+
+def Fetch_and_op(sourceval: Any, returnval: Any, target_rank: int,
+                 target_disp: int, op: Any, win: Win) -> None:
+    """Single-element atomic fetch-and-combine (src/onesided.jl:186-195)."""
+    win._check()
+    src = _origin_array(sourceval).reshape(-1)[:1]
+    _apply_op(win, target_rank, target_disp, src, as_op(op), fetch_into=returnval)
