@@ -1,0 +1,25 @@
+"""Spawned worker (reference: test/spawned_worker.jl:6-8): merge with the
+parent job and take part in a Reduce over the merged world."""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import tpu_mpi as MPI
+
+MPI.Init()
+
+parent_comm = MPI.Comm_get_parent()
+assert parent_comm is not MPI.COMM_NULL
+world_comm = MPI.Intercomm_merge(parent_comm, True)
+
+rank = MPI.Comm_rank(world_comm)
+assert rank != 0    # parents are ordered first (high=False)
+
+size = MPI.Comm_size(world_comm)
+val = MPI.Reduce(1, MPI.SUM, 0, world_comm)
+assert val is None  # result lands on root 0, a parent
+
+MPI.free(world_comm)
+MPI.Finalize()

@@ -1,0 +1,23 @@
+"""Platform introspection tests (reference: test/test_basic.jl version
+queries; src/implementations.jl)."""
+
+import tpu_mpi as MPI
+from tpu_mpi import implementations as impl
+
+
+def test_backend_detection():
+    # Under the CPU-sim test substrate the backend must identify as CPU_SIM.
+    assert impl.get_backend() in (impl.Backend.CPU_SIM, impl.Backend.TPU)
+    if impl.get_backend() is impl.Backend.CPU_SIM:
+        assert impl.tpu_generation() is None
+
+
+def test_library_version():
+    v = impl.Get_library_version()
+    assert "jax" in v
+    major, minor = impl.Get_version()
+    assert major >= 3
+
+
+def test_device_count():
+    assert impl.device_count() >= 1

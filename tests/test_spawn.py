@@ -1,0 +1,75 @@
+"""Dynamic process management tests (reference: test/test_spawn.jl,
+test/spawned_worker.jl, test/test_universe_size.jl)."""
+
+import os
+
+import numpy as np
+
+import tpu_mpi as MPI
+from tpu_mpi.testing import run_spmd
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def test_spawn_script(nprocs):
+    """The reference scenario: 1 parent spawns N-1 script workers, merges,
+    reduces over the merged world (test_spawn.jl:11-20)."""
+    nworkers = max(nprocs - 1, 1)
+
+    def body():
+        comm = MPI.COMM_WORLD
+        errors = []
+        intercomm = MPI.Comm_spawn(os.path.join(HERE, "spawned_worker.py"),
+                                   [], nworkers, comm, errors)
+        assert errors == [0] * nworkers
+        assert intercomm.remote_size() == nworkers
+        world_comm = MPI.Intercomm_merge(intercomm, False)
+
+        size = MPI.Comm_size(world_comm)
+        rank = MPI.Comm_rank(world_comm)
+        assert size == 1 + nworkers
+        assert rank == 0   # low-group parent sits first
+
+        val = MPI.Reduce(1, MPI.SUM, 0, world_comm)
+        assert val == size
+        MPI.free(world_comm)
+        MPI.free(intercomm)
+
+    run_spmd(body, 1)
+
+
+def test_spawn_callable(nprocs):
+    """Multi-parent spawn of callable workers; both sides merge and allreduce."""
+    def worker():
+        MPI.Init()
+        parent = MPI.Comm_get_parent()
+        assert parent is not MPI.COMM_NULL
+        # Child job has its own COMM_WORLD of exactly the spawned ranks.
+        assert MPI.Comm_size(MPI.COMM_WORLD) == 2
+        merged = MPI.Intercomm_merge(parent, True)
+        total = MPI.Allreduce(1, MPI.SUM, merged)
+        assert total == MPI.Comm_size(merged)
+        MPI.Finalize()
+
+    def body():
+        comm = MPI.COMM_WORLD
+        size = MPI.Comm_size(comm)
+        intercomm = MPI.Comm_spawn(worker, None, 2, comm)
+        merged = MPI.Intercomm_merge(intercomm, False)
+        assert MPI.Comm_size(merged) == size + 2
+        assert MPI.Comm_rank(merged) == MPI.Comm_rank(comm)
+        total = MPI.Allreduce(1, MPI.SUM, merged)
+        assert total == size + 2
+        # Parent COMM_WORLD is untouched by the spawn.
+        assert MPI.Comm_size(comm) == size
+
+    run_spmd(body, nprocs)
+
+
+def test_universe_size(nprocs):
+    """universe_size() query (test_universe_size.jl)."""
+    def body():
+        usize = MPI.universe_size()
+        assert usize is None or usize >= 1
+
+    run_spmd(body, nprocs)

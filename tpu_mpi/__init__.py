@@ -12,6 +12,9 @@ inside jit/shard_map over a jax.sharding.Mesh.
 
 from .version import __version__
 
+from . import implementations
+from .implementations import Get_library_version, Get_version
+
 # Wildcards / sentinels
 from ._runtime import (ANY_SOURCE, ANY_TAG, PROC_NULL, UNDEFINED,
                        SpmdContext, spmd_run)
@@ -27,9 +30,10 @@ from .environment import (Abort, Finalize, Finalized, Init, Init_thread,
 
 # Communicators (src/comm.jl)
 from .comm import (COMM_NULL, COMM_SELF, COMM_TYPE_SHARED, COMM_WORLD,
-                   CONGRUENT, Comm, Comm_compare, Comm_dup, Comm_rank,
-                   Comm_size, Comm_split, Comm_split_type, Comparison, IDENT,
-                   SIMILAR, UNEQUAL, free)
+                   CONGRUENT, Comm, Comm_compare, Comm_dup, Comm_get_parent,
+                   Comm_rank, Comm_size, Comm_spawn, Comm_split,
+                   Comm_split_type, Comparison, IDENT, Intercomm,
+                   Intercomm_merge, SIMILAR, UNEQUAL, free, spawn_argv)
 
 # Object model
 from .info import INFO_NULL, Info, infoval

@@ -303,6 +303,15 @@ class SpmdContext:
         # Attribute store for windows/files keyed by (kind, id).
         self.objects: dict[Any, Any] = {}
         self.objects_lock = threading.Lock()
+        # Dynamic process management (src/comm.jl:123-162): each world rank
+        # belongs to a "job world" — its own COMM_WORLD group + context id.
+        # Spawned groups get a fresh world (MPI gives spawned jobs their own
+        # MPI_COMM_WORLD); the parent side sees them only via the intercomm.
+        self.worlds: dict[int, tuple[tuple[int, ...], Any]] = {
+            r: (tuple(range(size)), 0) for r in range(size)}
+        self.parent_comm: dict[int, Any] = {}     # spawned rank -> intercomm
+        self.spawned_threads: list[threading.Thread] = []
+        self._spawn_lock = threading.Lock()
 
     # -- failure fate-sharing ------------------------------------------------
     def fail(self, exc: BaseException, rank: Optional[int] = None) -> None:
@@ -337,6 +346,42 @@ class SpmdContext:
                 ch = CollectiveChannel(self, size)
                 self._channels[cid] = ch
             return ch
+
+    # -- dynamic process management -----------------------------------------
+    def world_of(self, rank: int) -> tuple[tuple[int, ...], Any]:
+        """(group, cid) of the COMM_WORLD the given world rank belongs to."""
+        return self.worlds[rank]
+
+    def add_ranks(self, n: int, world_cid: Any) -> tuple[int, ...]:
+        """Extend the job with ``n`` new ranks forming their own world.
+        Called from a spawn rendezvous combiner (single thread)."""
+        with self._spawn_lock:
+            start = len(self.mailboxes)
+            new = tuple(range(start, start + n))
+            for r in new:
+                self.mailboxes.append(Mailbox(self))
+                self.initialized.append(False)
+                self.finalized.append(False)
+                self.thread_level.append(None)
+                self.main_threads.append(None)
+                self.worlds[r] = (new, world_cid)
+            return new
+
+    def start_rank_thread(self, rank: int, body: Callable[[], Any]) -> None:
+        """Run ``body`` as a new rank thread with fate-sharing."""
+        def runner() -> None:
+            set_env((self, rank))
+            try:
+                body()
+            except BaseException as e:
+                self.fail(e, rank)
+            finally:
+                set_env(None)
+
+        t = threading.Thread(target=runner, name=f"tpu-mpi-spawned-{rank}",
+                             daemon=True)
+        self.spawned_threads.append(t)
+        t.start()
 
     # -- device binding ------------------------------------------------------
     def device_for(self, rank: int):
@@ -409,6 +454,19 @@ def spmd_run(fn: Callable[[], Any], size: int, *, args: tuple = (),
             ctx.fail(DeadlockError("spmd_run timeout"), None)
     for t in threads:
         t.join(5.0)
+    # Ranks added by Comm_spawn must finish before the job is done. Spawned
+    # ranks may spawn further ranks, so re-snapshot until the list drains.
+    joined: set = set()
+    while True:
+        pending = [t for t in list(ctx.spawned_threads) if t not in joined]
+        if not pending:
+            break
+        for t in pending:
+            t.join(None if deadline is None else max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                ctx.fail(DeadlockError("spawned rank did not finish"), None)
+                t.join(5.0)
+            joined.add(t)
     err = first_error[0]
     if err is None and ctx.failure is not None:
         # e.g. a rank stuck in pure compute past the timeout: the failure was

@@ -110,31 +110,40 @@ class Comm:
 
 
 class _WorldComm(Comm):
-    """COMM_WORLD: all ranks of the ambient context, resolved dynamically so
-    the module-level constant works on every rank-thread (src/comm.jl:13-17)."""
+    """COMM_WORLD: the calling rank's *job world*, resolved dynamically so the
+    module-level constant works on every rank-thread (src/comm.jl:13-17).
+    Ranks created by Comm_spawn form their own world, exactly as spawned MPI
+    jobs get their own MPI_COMM_WORLD."""
 
     def __init__(self):
         super().__init__((), 0, name="COMM_WORLD")
 
     @property
     def group(self) -> tuple[int, ...]:
-        ctx, _ = require_env()
-        return tuple(range(ctx.size))
+        ctx, world_rank = require_env()
+        return ctx.world_of(world_rank)[0]
+
+    @property
+    def cid(self):
+        ctx, world_rank = require_env()
+        return ctx.world_of(world_rank)[1]
 
     def rank(self) -> int:
-        _, world_rank = require_env()
-        return world_rank
+        ctx, world_rank = require_env()
+        return ctx.world_of(world_rank)[0].index(world_rank)
 
     def size(self) -> int:
-        ctx, _ = require_env()
-        return ctx.size
+        ctx, world_rank = require_env()
+        return len(ctx.world_of(world_rank)[0])
 
     def world_rank_of(self, comm_rank: int) -> int:
-        return comm_rank
+        ctx, world_rank = require_env()
+        return ctx.world_of(world_rank)[0][comm_rank]
 
     def channel(self) -> CollectiveChannel:
-        ctx, _ = require_env()
-        return ctx.channel(0, ctx.size)
+        ctx, world_rank = require_env()
+        group, cid = ctx.world_of(world_rank)
+        return ctx.channel(cid, len(group))
 
 
 class _SelfComm(Comm):
@@ -249,6 +258,135 @@ def Comm_split_type(comm: Comm, split_type: int, key: int) -> Comm:
         return Comm_split(comm, None, key)
     host_id = getattr(comm.ctx, "host_id", 0)
     return Comm_split(comm, host_id, key)
+
+
+class Intercomm(Comm):
+    """An inter-communicator: a local group plus a remote group sharing one
+    context (src/comm.jl:135-162). Point-to-point ranks address the *remote*
+    group, per MPI intercomm semantics; Comm_rank/Comm_size are local."""
+
+    def __init__(self, local_group: Sequence[int], remote_group: Sequence[int],
+                 cid: int, name: str = "intercomm"):
+        super().__init__(local_group, cid, name=name)
+        self.remote_group = tuple(remote_group)
+
+    def remote_size(self) -> int:
+        return len(self.remote_group)
+
+    def world_rank_of(self, comm_rank: int) -> int:
+        # dest/src in P2P over an intercomm are remote-group ranks.
+        return self.remote_group[comm_rank]
+
+    def channel(self) -> CollectiveChannel:
+        # Intercomm collectives have two-group semantics the intracomm
+        # rendezvous cannot express (both sides would deposit into overlapping
+        # local-rank slots of one cid-keyed channel). P2P and Intercomm_merge
+        # work; use the merged intracomm for collectives.
+        raise MPIError("collectives on an intercommunicator are not supported; "
+                       "Intercomm_merge it into an intracommunicator first")
+
+    def __repr__(self) -> str:
+        return (f"<Intercomm {self.name} cid={self.cid} local={len(self.group)} "
+                f"remote={len(self.remote_group)}>")
+
+
+def spawn_argv() -> list:
+    """The argv a spawned worker was launched with (empty outside a spawned
+    rank). Spawned scripts read this instead of sys.argv — workers are threads
+    of one process, so mutating the global sys.argv would race."""
+    ctx, world_rank = require_env()
+    return list(getattr(ctx, "spawn_argv", {}).get(world_rank, []))
+
+
+def _run_spawned(command, argv):
+    """Execute a spawned worker: a Python callable, or a .py script path
+    (the analog of `mpiexec`-ing `julia spawned_worker.jl`,
+    test/spawned_worker.jl:6-8). Script workers get their args via
+    :func:`spawn_argv`, never via the (process-global) sys.argv."""
+    if callable(command):
+        command(*(argv or ()))
+        return
+    import runpy
+    if isinstance(command, str) and command.endswith(".py"):
+        script = command
+    elif argv:
+        scripts = [a for a in argv if str(a).endswith(".py")]
+        if not scripts:
+            raise MPIError(f"cannot spawn {command!r}: no python script in argv")
+        script = scripts[0]
+    else:
+        raise MPIError(f"cannot spawn {command!r}: pass a callable or a .py path")
+    runpy.run_path(script, run_name="__main__")
+
+
+def Comm_spawn(command, argv=None, maxprocs: int = 1, comm: Comm = COMM_WORLD,
+               errors=None, **info) -> Intercomm:
+    """Collectively spawn ``maxprocs`` new ranks running ``command`` (a Python
+    callable or script path), returning the parent side of an intercomm
+    (src/comm.jl:135-147).
+
+    OS-process spawn has no ICI analog (SURVEY.md §2.2): new ranks join the
+    same controller process as fresh rank-threads with their own COMM_WORLD,
+    the host-level emulation the survey prescribes."""
+    my_rank = comm.rank()
+    parent_group = comm.group
+    ctx = comm.ctx
+
+    def combine(cs):
+        world_cid = ctx.alloc_cid()
+        inter_cid = ctx.alloc_cid()
+        child_group = ctx.add_ranks(int(maxprocs), world_cid)
+        if not hasattr(ctx, "spawn_argv"):
+            ctx.spawn_argv = {}
+        for r in child_group:
+            # Each child gets its own handle: freeing one must not invalidate
+            # a sibling's (MPI handles are per-process).
+            ctx.parent_comm[r] = Intercomm(child_group, parent_group, inter_cid,
+                                           name="parent_intercomm")
+            ctx.spawn_argv[r] = [str(a) for a in (argv or [])
+                                 if not str(a).endswith(".py")]
+            ctx.start_rank_thread(r, lambda: _run_spawned(command, argv))
+        return [(child_group, inter_cid)] * len(cs)
+
+    child_group, inter_cid = comm.channel().run(
+        my_rank, None, combine, f"Comm_spawn@{comm.cid}")
+    if errors is not None:
+        errors[:] = [0] * int(maxprocs)
+    return Intercomm(parent_group, child_group, inter_cid, name="spawn_intercomm")
+
+
+def Comm_get_parent() -> Comm:
+    """The intercomm to the spawning job, or COMM_NULL (src/comm.jl:123-127)."""
+    ctx, world_rank = require_env()
+    return ctx.parent_comm.get(world_rank, COMM_NULL)
+
+
+def Intercomm_merge(intercomm: Intercomm, high: bool) -> Comm:
+    """Collectively merge an intercomm's two groups into one intracomm
+    (src/comm.jl:155-162). Groups whose members pass ``high=False`` are
+    ordered first."""
+    if not isinstance(intercomm, Intercomm):
+        raise MPIError("Intercomm_merge requires an intercommunicator")
+    ctx = intercomm.ctx
+    local, remote = intercomm.group, intercomm.remote_group
+    # Canonical rendezvous slots across both groups: the group containing the
+    # smaller world rank is "A" and occupies slots [0, len(A)).
+    a, b = (local, remote) if min(local) < min(remote) else (remote, local)
+    _, world_rank = require_env()
+    slot = a.index(world_rank) if world_rank in a else len(a) + b.index(world_rank)
+    total = len(a) + len(b)
+    chan = ctx.channel(("merge", intercomm.cid), total)
+
+    def combine(cs):
+        cid = ctx.alloc_cid()
+        lows = [(s, wr) for s, (wr, hi) in enumerate(cs) if not hi]
+        highs = [(s, wr) for s, (wr, hi) in enumerate(cs) if hi]
+        merged = tuple(wr for _, wr in lows) + tuple(wr for _, wr in highs)
+        return [(merged, cid)] * total
+
+    merged, cid = chan.run(slot, (world_rank, bool(high)),
+                           combine, f"Intercomm_merge@{intercomm.cid}")
+    return Comm(merged, cid, name="merged")
 
 
 def Comm_compare(comm1: Comm, comm2: Comm) -> Comparison:

@@ -1,0 +1,109 @@
+"""Backend/platform introspection.
+
+Reference: /root/reference/src/implementations.jl — queries
+MPI_Get_library_version (:15-27), regex-parses vendor+version into an MPIImpl
+enum (:57-66,80-132), and exposes MPI_VERSION (:154-170). The TPU analog
+(SURVEY.md §2.1): identify the accelerator platform (TPU generation / CPU sim),
+the runtime library (jax/jaxlib/libtpu versions), and the interconnect
+topology, so programs can adapt like MPI programs adapt to MPICH vs OpenMPI.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+import re
+from typing import Optional
+
+
+class Backend(enum.Enum):
+    """The transport 'implementation' (analog of MPIImpl, implementations.jl:57-66)."""
+    UNKNOWN = 0
+    CPU_SIM = 1        # fake XLA CPU devices (test substrate, SURVEY.md §3.5)
+    TPU = 2            # real TPU chips over ICI
+    GPU = 3            # jax on GPU (works, but not the design target)
+
+
+# Pattern table: device-kind string -> TPU generation (the analog of the
+# vendor version-string regexes in implementations.jl:80-132).
+_TPU_KINDS = [
+    (re.compile(r"v6|trillium", re.I), "v6"),
+    (re.compile(r"v5p", re.I), "v5p"),
+    (re.compile(r"v5e|v5 ?lite", re.I), "v5e"),
+    (re.compile(r"v4", re.I), "v4"),
+    (re.compile(r"v3", re.I), "v3"),
+    (re.compile(r"v2", re.I), "v2"),
+]
+
+
+@functools.lru_cache(maxsize=1)
+def _devices():
+    import jax
+    return jax.devices()
+
+
+def get_backend() -> Backend:
+    """Which transport backs the job (implementations.jl MPI_LIBRARY analog)."""
+    try:
+        platform = _devices()[0].platform
+    except Exception:
+        return Backend.UNKNOWN
+    if platform == "tpu":
+        return Backend.TPU
+    if platform == "cpu":
+        return Backend.CPU_SIM
+    if platform in ("gpu", "cuda", "rocm"):
+        return Backend.GPU
+    return Backend.UNKNOWN
+
+
+def tpu_generation() -> Optional[str]:
+    """'v5e' / 'v5p' / … or None off-TPU (the per-generation capability key
+    SURVEY.md §2.4 asks for)."""
+    if get_backend() is not Backend.TPU:
+        return None
+    kind = _devices()[0].device_kind
+    for pat, gen in _TPU_KINDS:
+        if pat.search(kind):
+            return gen
+    return None
+
+
+def Get_library_version() -> str:
+    """Version string of the runtime stack (implementations.jl:15-27)."""
+    import jax
+    import jaxlib
+    parts = [f"jax {jax.__version__}", f"jaxlib {jaxlib.__version__}"]
+    try:
+        d = _devices()[0]
+        parts.append(f"platform {d.platform} ({d.device_kind})")
+    except Exception:
+        pass
+    return ", ".join(parts)
+
+
+def Get_version() -> tuple[int, int]:
+    """API version of this framework (implementations.jl:154-170 reports the
+    MPI standard version; we report the capability surface we mirror)."""
+    return (3, 1)
+
+
+def device_count() -> int:
+    return len(_devices())
+
+
+def ici_topology() -> Optional[tuple[int, ...]]:
+    """Physical torus coordinates bounds of the local slice, when the runtime
+    exposes them (None on CPU sim). Used for torus-aware Dims_create."""
+    try:
+        devs = _devices()
+        coords = [getattr(d, "coords", None) for d in devs]
+        if any(c is None for c in coords):
+            return None
+        dims = tuple(max(c[i] for c in coords) + 1 for i in range(len(coords[0])))
+        return dims
+    except Exception:
+        return None
+
+
+MPI_LIBRARY = "tpu_mpi"
