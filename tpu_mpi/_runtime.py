@@ -310,6 +310,7 @@ class SpmdContext:
         self.worlds: dict[int, tuple[tuple[int, ...], Any]] = {
             r: (tuple(range(size)), 0) for r in range(size)}
         self.parent_comm: dict[int, Any] = {}     # spawned rank -> intercomm
+        self.spawn_argv: dict[int, list] = {}     # spawned rank -> its argv
         self.spawned_threads: list[threading.Thread] = []
         self._spawn_lock = threading.Lock()
 

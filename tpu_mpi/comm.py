@@ -295,7 +295,21 @@ def spawn_argv() -> list:
     rank). Spawned scripts read this instead of sys.argv — workers are threads
     of one process, so mutating the global sys.argv would race."""
     ctx, world_rank = require_env()
-    return list(getattr(ctx, "spawn_argv", {}).get(world_rank, []))
+    return list(ctx.spawn_argv.get(world_rank, []))
+
+
+def _worker_argv(command, argv) -> list:
+    """The argv the spawned worker should see: the full list, minus the script
+    entry when (and only when) the script was resolved *from* argv because
+    ``command`` itself wasn't runnable (mirrors _run_spawned's resolution)."""
+    argv = [str(a) for a in (argv or [])]
+    if callable(command) or (isinstance(command, str) and command.endswith(".py")):
+        return argv
+    scripts = [a for a in argv if a.endswith(".py")]
+    if scripts:
+        argv = list(argv)
+        argv.remove(scripts[0])
+    return argv
 
 
 def _run_spawned(command, argv):
@@ -331,25 +345,31 @@ def Comm_spawn(command, argv=None, maxprocs: int = 1, comm: Comm = COMM_WORLD,
     my_rank = comm.rank()
     parent_group = comm.group
     ctx = comm.ctx
+    worker_argv = _worker_argv(command, argv)
 
     def combine(cs):
+        # Spawn is collective: every parent rank must agree on what to spawn
+        # (libmpi validates root-side args; here all ranks contribute, so
+        # disagreement must fail loudly, not be resolved by arrival order).
+        if len(set(cs)) > 1:
+            from .error import CollectiveMismatchError
+            raise CollectiveMismatchError(
+                f"Comm_spawn arguments disagree across ranks: {sorted(set(cs))}")
         world_cid = ctx.alloc_cid()
         inter_cid = ctx.alloc_cid()
         child_group = ctx.add_ranks(int(maxprocs), world_cid)
-        if not hasattr(ctx, "spawn_argv"):
-            ctx.spawn_argv = {}
         for r in child_group:
             # Each child gets its own handle: freeing one must not invalidate
             # a sibling's (MPI handles are per-process).
             ctx.parent_comm[r] = Intercomm(child_group, parent_group, inter_cid,
                                            name="parent_intercomm")
-            ctx.spawn_argv[r] = [str(a) for a in (argv or [])
-                                 if not str(a).endswith(".py")]
+            ctx.spawn_argv[r] = list(worker_argv)
             ctx.start_rank_thread(r, lambda: _run_spawned(command, argv))
         return [(child_group, inter_cid)] * len(cs)
 
+    contrib = (int(maxprocs), tuple(worker_argv))
     child_group, inter_cid = comm.channel().run(
-        my_rank, None, combine, f"Comm_spawn@{comm.cid}")
+        my_rank, contrib, combine, f"Comm_spawn@{comm.cid}")
     if errors is not None:
         errors[:] = [0] * int(maxprocs)
     return Intercomm(parent_group, child_group, inter_cid, name="spawn_intercomm")
