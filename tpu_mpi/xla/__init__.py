@@ -16,3 +16,4 @@ from .mesh import (comm_mesh, local_device_count, make_mesh, world_mesh)
 from .collectives import (allgather, allgatherv, allreduce, alltoall, barrier,
                           bcast, exscan, gather, rank, reduce, reduce_scatter,
                           ring_shift, scan, scatter, sendrecv, size)
+from . import pallas_kernels
