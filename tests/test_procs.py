@@ -1,0 +1,141 @@
+"""Multi-process backend: ranks as OS processes over the native transport.
+
+The deployment-shape test the reference runs constantly (every test file
+executes under `mpiexec -n N julia …`, test/runtests.jl:28-45): here a
+handful of SPMD scripts run under `tpurun --procs`, exercising the C++
+framed-transport progress engine, the cross-process collective rendezvous,
+P2P matching, and mpiexec-style fate-sharing.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_procs(body: str, nprocs: int = 4, timeout: float = 180.0):
+    """Run an SPMD script body under tpurun --procs; return CompletedProcess."""
+    script = textwrap.dedent(body)
+    path = os.path.join("/tmp", f"tpu_mpi_proc_{abs(hash(body)) % 10**8}.py")
+    with open(path, "w") as f:
+        f.write(f"import sys; sys.path.insert(0, {REPO!r})\n" + script)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("TPU_MPI_PROC_RANK", None)
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_mpi.launcher", "-n", str(nprocs),
+         "--procs", "--sim", "1", "--timeout", str(timeout - 20), path],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+def test_collectives_and_p2p_across_processes():
+    res = _run_procs("""
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+
+        out = MPI.Allreduce(np.full(8, rank + 1.0), MPI.SUM, comm)
+        assert np.all(out == sum(range(1, size + 1))), out
+
+        obj = MPI.bcast({"x": 42} if rank == 0 else None, 0, comm)
+        assert obj["x"] == 42
+
+        dst, src = (rank + 1) % size, (rank - 1) % size
+        MPI.Send(np.full(4, rank, np.int64), dst, 7, comm)
+        buf = np.zeros(4, np.int64)
+        st = MPI.Recv(buf, src, 7, comm)
+        assert np.all(buf == src)
+
+        counts = [r + 1 for r in range(size)]
+        g = MPI.Allgatherv(np.full(rank + 1, rank, np.float64), counts, comm)
+        expect = np.concatenate([np.full(r + 1, float(r)) for r in range(size)])
+        assert np.all(np.asarray(g) == expect)
+
+        sub = MPI.Comm_split(comm, rank % 2, rank)
+        s = MPI.Allreduce(np.array([float(rank)]), MPI.SUM, sub)
+        assert s[0] == sum(r for r in range(size) if r % 2 == rank % 2)
+
+        print(f"OK-{rank}")
+        MPI.Finalize()
+    """)
+    assert res.returncode == 0, res.stderr
+    for r in range(4):
+        assert f"OK-{r}" in res.stdout
+
+
+def test_split_of_split_gets_distinct_cids():
+    # Context ids are minted per-root-process in --procs mode; a split whose
+    # root differs from the world root must not reuse an existing cid
+    # (regression: reused cid -> wrong channel -> deadlock).
+    res = _run_procs("""
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        # b reverses rank order: world rank 1 becomes b's root
+        b = MPI.Comm_split(comm, 0, -rank)
+        # split b into singletons: combine runs at b's root (world rank 1)
+        solo = MPI.Comm_split(b, MPI.Comm_rank(b), 0)
+        MPI.Barrier(solo)
+        s = MPI.Allreduce(np.array([1.0]), MPI.SUM, solo)
+        assert s[0] == 1.0, s
+        print(f"SPLIT-OK-{rank}")
+        MPI.Finalize()
+    """, nprocs=2)
+    assert res.returncode == 0, res.stderr
+    assert "SPLIT-OK-0" in res.stdout and "SPLIT-OK-1" in res.stdout
+
+
+def test_rank_failure_fails_the_job():
+    res = _run_procs("""
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        if MPI.Comm_rank(comm) == 1:
+            raise RuntimeError("rank 1 dies")
+        MPI.Barrier(comm)
+        MPI.Finalize()
+    """)
+    assert res.returncode != 0
+
+
+def test_collective_mismatch_detected_across_processes():
+    res = _run_procs("""
+        import numpy as np
+        import tpu_mpi as MPI
+        from tpu_mpi import CollectiveMismatchError, AbortError
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        try:
+            if rank == 0:
+                MPI.Allreduce(np.ones(4), MPI.SUM, comm)
+            else:
+                MPI.Barrier(comm)
+        except (CollectiveMismatchError, AbortError):
+            raise SystemExit(3)
+        raise SystemExit(0)
+    """, timeout=240.0)
+    assert res.returncode == 3, (res.returncode, res.stderr)
+
+
+def test_onesided_rejected_in_proc_mode():
+    res = _run_procs("""
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        try:
+            MPI.Win_create(np.zeros(4), MPI.COMM_WORLD)
+        except MPI.MPIError as e:
+            assert "multi-process" in str(e)
+            raise SystemExit(5)
+        raise SystemExit(0)
+    """, nprocs=2)
+    assert res.returncode == 5, (res.returncode, res.stderr)

@@ -1,0 +1,160 @@
+"""ctypes binding to the native host transport (transport.cc).
+
+Build model mirrors the reference's deps/ stage (deps/build.jl compiles
+gen_consts.c with the system compiler at install time): the shared library is
+compiled from the vendored C++ source with the system g++ on first use and
+cached next to the source; a stale cache (source newer than .so) rebuilds.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "transport.cc")
+_LIB = os.path.join(_HERE, "libtpumpi_transport.so")
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _stale() -> bool:
+    return (not os.path.exists(_LIB)
+            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+
+
+def _build() -> None:
+    """Compile under an inter-process lock: N launched rank processes may hit
+    first-use simultaneously (tpurun --procs); each builds to its own temp
+    file and the winner publishes atomically."""
+    import fcntl
+    import tempfile
+
+    with open(_LIB + ".lock", "w") as lf:
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        try:
+            if not _stale():     # a sibling built it while we waited
+                return
+            fd, tmp = tempfile.mkstemp(dir=_HERE, suffix=".so")
+            os.close(fd)
+            cxx = os.environ.get("TPU_MPI_CXX", "g++")
+            cmd = [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                   _SRC, "-o", tmp]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                os.unlink(tmp)
+                raise NativeBuildError(
+                    f"native transport build failed ({' '.join(cmd)}):\n"
+                    f"{proc.stderr}")
+            os.replace(tmp, _LIB)
+        finally:
+            fcntl.flock(lf, fcntl.LOCK_UN)
+
+
+def load() -> ctypes.CDLL:
+    """Load (building if needed) the native transport library."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _stale():
+            _build()
+        lib = ctypes.CDLL(_LIB)
+        lib.tm_create.restype = ctypes.c_void_p
+        lib.tm_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.tm_port.restype = ctypes.c_int
+        lib.tm_port.argtypes = [ctypes.c_void_p]
+        lib.tm_set_peers.restype = ctypes.c_int
+        lib.tm_set_peers.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.tm_send.restype = ctypes.c_int
+        lib.tm_send.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                ctypes.c_void_p, ctypes.c_longlong]
+        lib.tm_peek.restype = ctypes.c_longlong
+        lib.tm_peek.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.tm_recv.restype = ctypes.c_int
+        lib.tm_recv.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                ctypes.c_longlong,
+                                ctypes.POINTER(ctypes.c_int),
+                                ctypes.POINTER(ctypes.c_longlong),
+                                ctypes.c_int]
+        lib.tm_stop.restype = None
+        lib.tm_stop.argtypes = [ctypes.c_void_p]
+        lib.tm_destroy.restype = None
+        lib.tm_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class NativeTransport:
+    """Python handle over one rank's native transport endpoint."""
+
+    def __init__(self, rank: int, size: int):
+        self._lib = load()
+        self._h = self._lib.tm_create(rank, size)
+        if not self._h:
+            raise NativeBuildError("tm_create failed (socket/bind error)")
+        self.rank = rank
+        self.size = size
+
+    @property
+    def port(self) -> int:
+        return self._lib.tm_port(self._h)
+
+    def set_peers(self, addrs: list[str]) -> None:
+        csv = ",".join(addrs).encode()
+        if self._lib.tm_set_peers(self._h, csv) != 0:
+            raise NativeBuildError(f"tm_set_peers rejected {addrs!r}")
+
+    def send(self, dst: int, payload: bytes) -> None:
+        rc = self._lib.tm_send(self._h, dst, payload, len(payload))
+        if rc != 0:
+            raise ConnectionError(f"native send to rank {dst} failed")
+
+    def recv(self, timeout_ms: int) -> Optional[tuple[int, bytes]]:
+        """(src, payload) or None on timeout. Raises on shutdown."""
+        n = self._lib.tm_peek(self._h, timeout_ms)
+        if n == -1:
+            return None
+        if n == -2:
+            raise ConnectionResetError("transport stopped")
+        buf = ctypes.create_string_buffer(int(n))
+        src = ctypes.c_int()
+        length = ctypes.c_longlong()
+        rc = self._lib.tm_recv(self._h, buf, n, ctypes.byref(src),
+                               ctypes.byref(length), timeout_ms)
+        if rc == 1:
+            return None
+        if rc == -3:
+            # a larger frame arrived between peek and recv; retry with its size
+            buf = ctypes.create_string_buffer(int(length.value))
+            rc = self._lib.tm_recv(self._h, buf, length.value,
+                                   ctypes.byref(src), ctypes.byref(length),
+                                   timeout_ms)
+        if rc == -2:
+            raise ConnectionResetError("transport stopped")
+        if rc != 0:
+            return None
+        return src.value, buf.raw[: length.value]
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.tm_stop(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.tm_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

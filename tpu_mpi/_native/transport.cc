@@ -1,0 +1,366 @@
+// tpu_mpi native host transport: framed TCP messaging with a poll()-based
+// progress engine.
+//
+// This is the DCN-tier native component (SURVEY.md §2.4): the reference links
+// an external C libmpi whose progress engine moves bytes between OS
+// processes; here the equivalent engine is built in, reached from Python via
+// ctypes. Scope is deliberately the *transport*: reliable framed delivery
+// between ranks with a background progress thread and a blocking inbox.
+// Message semantics (tags, wildcards, probe, collective rendezvous) live in
+// the Python object model above, exactly as the reference keeps its object
+// model in Julia above libmpi's byte engine.
+//
+// Wire format per frame: [u32 magic][i32 src][i64 len][payload bytes].
+// TCP gives per-peer FIFO; the single progress thread preserves arrival
+// order into one inbox, so MPI's non-overtaking guarantee holds per (src,dst).
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x7D5A11E7u;
+
+struct FrameHeader {
+  uint32_t magic;
+  int32_t src;
+  int64_t len;
+} __attribute__((packed));
+
+struct Frame {
+  int32_t src = -1;
+  std::vector<uint8_t> data;
+};
+
+// Per-connection incremental read state.
+struct Conn {
+  int fd = -1;
+  std::vector<uint8_t> buf;  // unparsed bytes
+};
+
+bool write_all(int fd, const void* p, size_t n) {
+  const uint8_t* b = static_cast<const uint8_t*>(p);
+  while (n > 0) {
+    ssize_t w = ::send(fd, b, n, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    b += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+class Transport {
+ public:
+  Transport(int rank, int size) : rank_(rank), size_(size) {
+    peer_fds_.assign(size, -1);
+    peer_locks_ = std::vector<std::mutex>(size);
+  }
+
+  ~Transport() { stop(); }
+
+  bool listen_any() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = 0;
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+      return false;
+    if (::listen(listen_fd_, size_ + 8) < 0) return false;
+    socklen_t alen = sizeof(addr);
+    if (::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen) < 0)
+      return false;
+    port_ = ntohs(addr.sin_port);
+    if (::pipe(wake_pipe_) != 0) return false;
+    ::fcntl(wake_pipe_[0], F_SETFL, O_NONBLOCK);
+    progress_ = std::thread([this] { progress_loop(); });
+    return true;
+  }
+
+  int port() const { return port_; }
+
+  // csv: "host:port,host:port,..." indexed by rank.
+  bool set_peers(const std::string& csv) {
+    std::lock_guard<std::mutex> g(peers_mtx_);
+    peer_addrs_.clear();
+    size_t pos = 0;
+    while (pos <= csv.size()) {
+      size_t comma = csv.find(',', pos);
+      if (comma == std::string::npos) comma = csv.size();
+      peer_addrs_.push_back(csv.substr(pos, comma - pos));
+      pos = comma + 1;
+    }
+    return static_cast<int>(peer_addrs_.size()) == size_;
+  }
+
+  // Blocking framed send. Thread-safe per destination.
+  bool send(int dst, const void* buf, int64_t len) {
+    if (dst < 0 || dst >= size_ || stopped_.load()) return false;
+    if (dst == rank_) {  // self-send: straight to the inbox
+      Frame f;
+      f.src = rank_;
+      f.data.assign(static_cast<const uint8_t*>(buf),
+                    static_cast<const uint8_t*>(buf) + len);
+      push_frame(std::move(f));
+      return true;
+    }
+    std::lock_guard<std::mutex> g(peer_locks_[dst]);
+    int fd = peer_fds_[dst];
+    if (fd < 0) {
+      fd = connect_peer(dst);
+      if (fd < 0) return false;
+      peer_fds_[dst] = fd;
+    }
+    FrameHeader h{kMagic, rank_, len};
+    if (!write_all(fd, &h, sizeof(h)) || !write_all(fd, buf, len)) {
+      ::close(fd);
+      peer_fds_[dst] = -1;
+      return false;
+    }
+    return true;
+  }
+
+  // Next frame's length without popping: >=0 len, -1 timeout, -2 stopped.
+  int64_t peek(int timeout_ms) {
+    std::unique_lock<std::mutex> lk(q_mtx_);
+    if (!q_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                        [this] { return !inbox_.empty() || stopped_.load(); }))
+      return -1;
+    if (!inbox_.empty()) return static_cast<int64_t>(inbox_.front().data.size());
+    return -2;
+  }
+
+  // Pop into buf. 0 ok, 1 timeout, -2 stopped, -3 cap too small (frame kept).
+  int recv(void* buf, int64_t cap, int32_t* src_out, int64_t* len_out,
+           int timeout_ms) {
+    std::unique_lock<std::mutex> lk(q_mtx_);
+    if (!q_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                        [this] { return !inbox_.empty() || stopped_.load(); }))
+      return 1;
+    if (inbox_.empty()) return -2;
+    Frame& f = inbox_.front();
+    *len_out = static_cast<int64_t>(f.data.size());
+    *src_out = f.src;
+    if (cap < *len_out) return -3;
+    memcpy(buf, f.data.data(), f.data.size());
+    inbox_.pop_front();
+    return 0;
+  }
+
+  void stop() {
+    bool was = stopped_.exchange(true);
+    if (was) return;
+    q_cv_.notify_all();
+    if (wake_pipe_[1] >= 0) {
+      char c = 'x';
+      (void)!::write(wake_pipe_[1], &c, 1);
+    }
+    if (progress_.joinable()) progress_.join();
+    for (int& fd : peer_fds_)
+      if (fd >= 0) {
+        ::close(fd);
+        fd = -1;
+      }
+    for (Conn& c : conns_)
+      if (c.fd >= 0) ::close(c.fd);
+    conns_.clear();
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+    listen_fd_ = -1;
+    for (int i = 0; i < 2; ++i)
+      if (wake_pipe_[i] >= 0) {
+        ::close(wake_pipe_[i]);
+        wake_pipe_[i] = -1;
+      }
+  }
+
+ private:
+  int connect_peer(int dst) {
+    std::string addr;
+    {
+      std::lock_guard<std::mutex> g(peers_mtx_);
+      if (dst >= static_cast<int>(peer_addrs_.size())) return -1;
+      addr = peer_addrs_[dst];
+    }
+    size_t colon = addr.rfind(':');
+    if (colon == std::string::npos) return -1;
+    std::string host = addr.substr(0, colon);
+    std::string port = addr.substr(colon + 1);
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (::getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0 || !res)
+      return -1;
+    int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+      ::close(fd);
+      fd = -1;
+    }
+    ::freeaddrinfo(res);
+    if (fd >= 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    }
+    return fd;
+  }
+
+  void push_frame(Frame&& f) {
+    {
+      std::lock_guard<std::mutex> g(q_mtx_);
+      inbox_.push_back(std::move(f));
+    }
+    q_cv_.notify_all();
+  }
+
+  void progress_loop() {
+    while (!stopped_.load()) {
+      std::vector<pollfd> pfds;
+      pfds.push_back({listen_fd_, POLLIN, 0});
+      pfds.push_back({wake_pipe_[0], POLLIN, 0});
+      for (Conn& c : conns_) pfds.push_back({c.fd, POLLIN, 0});
+      int rc = ::poll(pfds.data(), pfds.size(), 200);
+      if (stopped_.load()) return;
+      if (rc <= 0) continue;
+      if (pfds[0].revents & POLLIN) {
+        int fd = ::accept(listen_fd_, nullptr, nullptr);
+        if (fd >= 0) {
+          int one = 1;
+          ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          conns_.push_back(Conn{fd, {}});
+        }
+      }
+      if (pfds[1].revents & POLLIN) {
+        char tmp[64];
+        while (::read(wake_pipe_[0], tmp, sizeof(tmp)) > 0) {
+        }
+      }
+      for (size_t i = 2; i < pfds.size(); ++i) {
+        if (!(pfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+        Conn& c = conns_[i - 2];
+        uint8_t chunk[1 << 16];
+        ssize_t r = ::read(c.fd, chunk, sizeof(chunk));
+        if (r <= 0) {
+          ::close(c.fd);
+          c.fd = -1;
+          continue;
+        }
+        c.buf.insert(c.buf.end(), chunk, chunk + r);
+        parse_frames(c);
+      }
+      conns_.erase(std::remove_if(conns_.begin(), conns_.end(),
+                                  [](const Conn& c) { return c.fd < 0; }),
+                   conns_.end());
+    }
+  }
+
+  void parse_frames(Conn& c) {
+    size_t off = 0;
+    while (c.buf.size() - off >= sizeof(FrameHeader)) {
+      FrameHeader h;
+      memcpy(&h, c.buf.data() + off, sizeof(h));
+      if (h.magic != kMagic || h.len < 0) {  // corrupt stream: drop the conn
+        ::close(c.fd);
+        c.fd = -1;
+        c.buf.clear();
+        return;
+      }
+      size_t need = sizeof(FrameHeader) + static_cast<size_t>(h.len);
+      if (c.buf.size() - off < need) break;
+      Frame f;
+      f.src = h.src;
+      f.data.assign(c.buf.begin() + off + sizeof(FrameHeader),
+                    c.buf.begin() + off + need);
+      push_frame(std::move(f));
+      off += need;
+    }
+    if (off > 0) c.buf.erase(c.buf.begin(), c.buf.begin() + off);
+  }
+
+  int rank_, size_;
+  int listen_fd_ = -1;
+  int port_ = -1;
+  int wake_pipe_[2] = {-1, -1};
+  std::mutex peers_mtx_;
+  std::vector<std::string> peer_addrs_;
+  std::vector<int> peer_fds_;
+  std::vector<std::mutex> peer_locks_;
+  std::mutex q_mtx_;
+  std::condition_variable q_cv_;
+  std::deque<Frame> inbox_;
+  std::thread progress_;
+  std::atomic<bool> stopped_{false};
+  std::vector<Conn> conns_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tm_create(int rank, int size) {
+  auto* t = new Transport(rank, size);
+  if (!t->listen_any()) {
+    delete t;
+    return nullptr;
+  }
+  return t;
+}
+
+int tm_port(void* h) { return static_cast<Transport*>(h)->port(); }
+
+int tm_set_peers(void* h, const char* csv) {
+  return static_cast<Transport*>(h)->set_peers(csv) ? 0 : -1;
+}
+
+int tm_send(void* h, int dst, const void* buf, long long len) {
+  return static_cast<Transport*>(h)->send(dst, buf, len) ? 0 : -1;
+}
+
+long long tm_peek(void* h, int timeout_ms) {
+  return static_cast<Transport*>(h)->peek(timeout_ms);
+}
+
+int tm_recv(void* h, void* buf, long long cap, int* src_out,
+            long long* len_out, int timeout_ms) {
+  int64_t len64 = 0;
+  int rc = static_cast<Transport*>(h)->recv(buf, cap, src_out, &len64,
+                                            timeout_ms);
+  *len_out = len64;
+  return rc;
+}
+
+void tm_stop(void* h) { static_cast<Transport*>(h)->stop(); }
+
+void tm_destroy(void* h) {
+  auto* t = static_cast<Transport*>(h);
+  t->stop();
+  delete t;
+}
+
+}  // extern "C"

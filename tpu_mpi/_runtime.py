@@ -340,7 +340,10 @@ class SpmdContext:
         so all members of the parent communicator agree on the value)."""
         return next(self._next_cid)
 
-    def channel(self, cid: int, size: int) -> CollectiveChannel:
+    def channel(self, cid: int, size: int,
+                group: Optional[tuple[int, ...]] = None) -> CollectiveChannel:
+        # `group` (world ranks, comm order) is unused here — threads share an
+        # address space — but the multi-process backend needs it for routing.
         with self._channels_lock:
             ch = self._channels.get(cid)
             if ch is None:

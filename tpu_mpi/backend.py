@@ -1,0 +1,413 @@
+"""Multi-process backend: one OS process per rank over the native transport.
+
+The scale-out tier (SURVEY.md §2.5 "distributed communication backend"):
+where the default runtime executes ranks as threads of one controller
+process, this backend runs each rank in its own process — the deployment
+shape of one process per TPU host over DCN — wired through the C++ framed
+transport in ``tpu_mpi._native`` (the libmpi-analog progress engine,
+/root/reference deps model: external native transport + in-language object
+model).
+
+Reused unchanged from the threaded runtime: the Mailbox matching engine
+(tags/wildcards/probe), all of pointtopoint/collective/topology/io, and the
+per-communicator collective protocol. What changes is the rendezvous: the
+:class:`ProcChannel` gathers pickled contributions to the communicator's
+rank-0 process, runs ``combine`` there, and scatters per-rank results —
+the same "last arriver combines" contract, executed at a distinguished
+process. Shared-object features (one-sided windows, Comm_spawn) require a
+shared address space and raise in this mode.
+
+Launch: ``tpurun -n N --procs script.py``. The launcher is the rendezvous
+server: children report their transport ports, receive the full address map,
+then run the script.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import pickle
+import socket
+import threading
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from ._runtime import (ANY_SOURCE, Mailbox, Message, SpmdContext, _Waitable,
+                       set_env)
+from .error import AbortError, CollectiveMismatchError, MPIError
+
+_POLL_MS = 50
+
+
+def _is_jax(x: Any) -> bool:
+    return type(x).__module__.startswith("jax") or type(x).__name__ == "ArrayImpl"
+
+
+class _JaxLeaf:
+    """Pickle surrogate for a jax.Array (device placement is per-process)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, arr):
+        self.value = np.asarray(arr)
+
+
+def _pack(obj: Any) -> Any:
+    """Recursively replace jax arrays with host surrogates for the wire."""
+    if _is_jax(obj):
+        return _JaxLeaf(obj)
+    if isinstance(obj, tuple):
+        return tuple(_pack(o) for o in obj)
+    if isinstance(obj, list):
+        return [_pack(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    return obj
+
+
+def _unpack(obj: Any) -> Any:
+    if isinstance(obj, _JaxLeaf):
+        import jax.numpy as jnp
+        return jnp.asarray(obj.value)
+    if isinstance(obj, tuple):
+        return tuple(_unpack(o) for o in obj)
+    if isinstance(obj, list):
+        return [_unpack(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _unpack(v) for k, v in obj.items()}
+    return obj
+
+
+class _RemoteMailbox:
+    """Sender-side proxy: post() ships the Message to the owning process."""
+
+    def __init__(self, ctx: "ProcContext", world_rank: int):
+        self.ctx = ctx
+        self.world_rank = world_rank
+
+    def post(self, msg: Message) -> None:
+        if msg.kind == "objref":
+            raise MPIError(
+                "cannot send an unpicklable object to another process; "
+                "multi-process ranks do not share an address space")
+        frame = pickle.dumps(
+            ("p2p", msg.src, msg.tag, msg.cid, _pack(msg.payload),
+             msg.count, msg.dtype, msg.kind))
+        self.ctx.transport.send(self.world_rank, frame)
+
+    def notify(self) -> None:  # failure broadcast reaches processes via abort
+        pass
+
+
+class ProcChannel(_Waitable):
+    """Cross-process collective rendezvous for one communicator.
+
+    Protocol per round (rounds serialize per communicator because every rank
+    blocks in run()): non-root ranks send (opname, contrib) to the comm's
+    rank 0 process; rank 0 verifies opnames match, executes combine, and
+    sends each rank its result slot. Equivalent observable behavior to the
+    threaded CollectiveChannel, including mismatch fail-fast.
+    """
+
+    def __init__(self, ctx: "ProcContext", cid: Any, group: tuple[int, ...]):
+        self.ctx = ctx
+        self.cid = cid
+        self.group = group
+        self.lock = threading.RLock()
+        self.cond = threading.Condition(self.lock)
+        self.round = 0
+        # (round, comm_rank) -> (opname, contrib) at root;
+        # (round,) -> result at non-root. Fed by the drainer thread.
+        self.inbox: dict[Any, Any] = {}
+
+    # -- drainer entry points -------------------------------------------------
+    def deliver_contrib(self, rnd: int, src: int, opname: str, contrib: Any) -> None:
+        with self.cond:
+            self.inbox[(rnd, src)] = (opname, contrib)
+            self.cond.notify_all()
+
+    def deliver_result(self, rnd: int, result: Any) -> None:
+        with self.cond:
+            self.inbox[(rnd,)] = result
+            self.cond.notify_all()
+
+    # -- the collective contract ---------------------------------------------
+    def run(self, rank: int, contrib: Any,
+            combine: Callable[[list[Any]], Sequence[Any]], opname: str) -> Any:
+        ctx = self.ctx
+        n = len(self.group)
+        with self.cond:
+            rnd = self.round
+            self.round += 1
+        root_world = self.group[0]
+        if ctx.local_rank != root_world:
+            frame = pickle.dumps(("coll", self.cid, rnd, rank, opname,
+                                  _pack(contrib)))
+            ctx.transport.send(root_world, frame)
+            with self.cond:
+                self._wait_for(lambda: (rnd,) in self.inbox,
+                               f"collective {opname}")
+                res = self.inbox.pop((rnd,))
+            return _unpack(res)
+
+        # root: gather, verify, combine, scatter
+        with self.cond:
+            self._wait_for(
+                lambda: all((rnd, r) in self.inbox for r in range(n) if r != rank),
+                f"collective {opname} (gather)")
+            gathered: list[Any] = [None] * n
+            for r in range(n):
+                if r == rank:
+                    gathered[r] = (opname, contrib)
+                else:
+                    gathered[r] = self.inbox.pop((rnd, r))
+        names = {op for op, _ in gathered}
+        if len(names) > 1:
+            err = CollectiveMismatchError(
+                f"ranks disagree on the collective for cid {self.cid}: "
+                f"{sorted(names)}")
+            self.ctx.fail(err)
+            raise err
+        try:
+            results = list(combine([_unpack(c) for _, c in gathered]))
+        except BaseException as e:
+            self.ctx.fail(e)
+            raise
+        if len(results) != n:
+            err = MPIError(f"combine for {opname} returned {len(results)} "
+                           f"results for {n} ranks")
+            self.ctx.fail(err)
+            raise err
+        for r in range(n):
+            if r == rank:
+                continue
+            frame = pickle.dumps(("collres", self.cid, rnd, _pack(results[r])))
+            ctx.transport.send(self.group[r], frame)
+        return results[rank]
+
+
+class ProcContext(SpmdContext):
+    """A world whose ranks are OS processes; this instance represents one.
+
+    `size` is the world size but only ``local_rank`` runs here. Mailbox
+    index ``local_rank`` is the real matching engine; all other slots are
+    wire proxies. Failure fate-sharing crosses processes via abort frames
+    (and the launcher kills the job on any nonzero exit, mpiexec-style).
+    """
+
+    def __init__(self, local_rank: int, size: int, transport,
+                 universe_size: Optional[int] = None):
+        super().__init__(size, universe_size=universe_size)
+        self.local_rank = local_rank
+        self.transport = transport
+        self._cid_counter = itertools.count(0)
+        self.mailboxes = [
+            Mailbox(self) if r == local_rank else _RemoteMailbox(self, r)
+            for r in range(size)
+        ]
+        self._drainer = threading.Thread(target=self._drain, daemon=True,
+                                         name="tpu-mpi-drainer")
+        self._drainer_stop = threading.Event()
+        self._drainer.start()
+
+    # -- frame pump -----------------------------------------------------------
+    def _drain(self) -> None:
+        while not self._drainer_stop.is_set():
+            try:
+                got = self.transport.recv(_POLL_MS)
+            except ConnectionResetError:
+                return
+            if got is None:
+                continue
+            src_world, frame = got
+            try:
+                item = pickle.loads(frame)
+            except Exception as e:              # corrupted frame: fate-share
+                self.fail(MPIError(f"undecodable frame from {src_world}: {e}"))
+                continue
+            kind = item[0]
+            if kind == "p2p":
+                _, src, tag, cid, payload, count, dtype, mkind = item
+                msg = Message(src, tag, cid, _unpack(payload), count, dtype,
+                              mkind)
+                self.mailboxes[self.local_rank].post(msg)
+            elif kind == "coll":
+                _, cid, rnd, src, opname, contrib = item
+                self._proc_channel(cid).deliver_contrib(rnd, src, opname,
+                                                        contrib)
+            elif kind == "collres":
+                _, cid, rnd, result = item
+                self._proc_channel(cid).deliver_result(rnd, result)
+            elif kind == "abort":
+                _, text = item
+                with self._failure_lock:
+                    if self.failure is None:
+                        self.failure = AbortError(text)
+                self.mailboxes[self.local_rank].notify()
+                for ch in list(self._channels.values()):
+                    with ch.cond:
+                        ch.cond.notify_all()
+
+    # -- channel management ---------------------------------------------------
+    def _proc_channel(self, cid: Any) -> ProcChannel:
+        with self._channels_lock:
+            ch = self._channels.get(cid)
+            if ch is None:
+                # Drainer can see a contribution before the local rank enters
+                # the collective; group is filled in on first local entry but
+                # rank-0 routing only needs the cid until then.
+                ch = ProcChannel(self, cid, ())
+                self._channels[cid] = ch
+            return ch
+
+    def channel(self, cid: Any, size: int, group: Optional[tuple[int, ...]] = None):
+        if group is None:
+            raise MPIError("this communicator type is not supported in "
+                           "multi-process mode")
+        ch = self._proc_channel(cid)
+        if not ch.group:
+            ch.group = tuple(group)
+        return ch
+
+    def alloc_cid(self) -> int:
+        """Process-namespaced context ids. alloc_cid runs inside combine(),
+        which executes only at the allocating comm's ROOT process — each
+        process has its own counter, so two different roots would mint the
+        same id (observed: a split-of-a-split deadlocks on the reused
+        channel). Stride by world size, offset by this process's rank:
+        disjoint id spaces, still plain ints."""
+        return 2 + self.local_rank + self.size * next(self._cid_counter)
+
+    # -- overrides: shared-address-space features -----------------------------
+    def add_ranks(self, n: int, world_cid: Any):
+        raise MPIError("Comm_spawn is not supported in multi-process mode; "
+                       "launch the full world up front (tpurun -n N --procs)")
+
+    @property
+    def supports_shared_objects(self) -> bool:
+        return False
+
+    def device_for(self, rank: int):
+        import jax
+        devs = jax.devices()
+        return devs[rank % len(devs)]
+
+    # -- failure fate-sharing -------------------------------------------------
+    def fail(self, exc: BaseException, rank: Optional[int] = None) -> None:
+        super().fail(exc, rank)
+        text = f"{type(exc).__name__}: {exc}" + (
+            f" originating on rank {rank}" if rank is not None else
+            f" originating on rank {self.local_rank}")
+        frame = pickle.dumps(("abort", text))
+        for r in range(self.size):
+            if r != self.local_rank:
+                try:
+                    self.transport.send(r, frame)
+                except Exception:
+                    pass
+
+    def shutdown(self) -> None:
+        self._drainer_stop.set()
+        self.transport.stop()
+
+
+# ---------------------------------------------------------------------------
+# rendezvous: child side
+# ---------------------------------------------------------------------------
+
+def proc_attach() -> tuple[ProcContext, int]:
+    """Join the multi-process world described by the TPU_MPI_PROC_* env
+    (set by the launcher): start the native transport, rendezvous with the
+    coordinator for the address map, and bind this process as its rank."""
+    from ._native import NativeTransport
+
+    rank = int(os.environ["TPU_MPI_PROC_RANK"])
+    size = int(os.environ["TPU_MPI_PROC_SIZE"])
+    coord = os.environ["TPU_MPI_PROC_COORD"]
+    host, port = coord.rsplit(":", 1)
+
+    transport = NativeTransport(rank, size)
+    with socket.create_connection((host, int(port)), timeout=60) as s:
+        # The address map only arrives once ALL siblings have joined; sibling
+        # startup skew (native build, cold jax import) routinely exceeds the
+        # connect timeout, so wait much longer for the map itself.
+        s.settimeout(float(os.environ.get("TPU_MPI_RENDEZVOUS_TIMEOUT", "600")))
+        s.sendall(json.dumps({"rank": rank, "port": transport.port}).encode()
+                  + b"\n")
+        buf = b""
+        while not buf.endswith(b"\n"):
+            try:
+                chunk = s.recv(65536)
+            except socket.timeout:
+                raise MPIError(
+                    f"rendezvous timed out waiting for the world address map "
+                    f"(rank {rank}; are all {size} ranks up?)") from None
+            if not chunk:
+                raise MPIError("coordinator closed during rendezvous")
+            buf += chunk
+    addrs = json.loads(buf.decode())
+    transport.set_peers(addrs)
+    ctx = ProcContext(rank, size, transport)
+    set_env((ctx, rank))
+    return ctx, rank
+
+
+# ---------------------------------------------------------------------------
+# rendezvous: coordinator (launcher) side
+# ---------------------------------------------------------------------------
+
+class Coordinator:
+    """Address-map rendezvous server run by the launcher process."""
+
+    def __init__(self, nprocs: int, host: str = "127.0.0.1"):
+        self.nprocs = nprocs
+        self.host = host
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, 0))
+        self.sock.listen(nprocs + 4)
+        self.port = self.sock.getsockname()[1]
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _serve(self) -> None:
+        conns: list[tuple[socket.socket, int]] = []
+        ports: dict[int, int] = {}
+        try:
+            while len(conns) < self.nprocs:
+                c, _ = self.sock.accept()
+                buf = b""
+                while not buf.endswith(b"\n"):
+                    chunk = c.recv(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                try:
+                    info = json.loads(buf.decode())
+                except Exception:
+                    c.close()
+                    continue
+                ports[info["rank"]] = info["port"]
+                conns.append((c, info["rank"]))
+            addrs = [f"{self.host}:{ports[r]}" for r in range(self.nprocs)]
+            payload = (json.dumps(addrs) + "\n").encode()
+            for c, _ in conns:
+                try:
+                    c.sendall(payload)
+                finally:
+                    c.close()
+        except Exception:
+            for c, _ in conns:
+                c.close()
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except Exception:
+            pass

@@ -93,7 +93,7 @@ class Comm:
     def channel(self) -> CollectiveChannel:
         """The collective rendezvous channel for this communicator."""
         self._check()
-        return self.ctx.channel(self._cid, len(self._group))
+        return self.ctx.channel(self._cid, len(self._group), group=self._group)
 
     @property
     def device(self):
@@ -143,7 +143,7 @@ class _WorldComm(Comm):
     def channel(self) -> CollectiveChannel:
         ctx, world_rank = require_env()
         group, cid = ctx.world_of(world_rank)
-        return ctx.channel(cid, len(group))
+        return ctx.channel(cid, len(group), group=group)
 
 
 class _SelfComm(Comm):
@@ -170,7 +170,7 @@ class _SelfComm(Comm):
     def channel(self) -> CollectiveChannel:
         ctx, world_rank = require_env()
         # Per-rank channel: cid 1 is logically distinct per rank; key it so.
-        return ctx.channel((1, world_rank), 1)
+        return ctx.channel((1, world_rank), 1, group=(world_rank,))
 
 
 class _NullComm(Comm):

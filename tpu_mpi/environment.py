@@ -59,9 +59,15 @@ def Init_thread(required: ThreadLevel) -> ThreadLevel:
     """
     env = current_env()
     if env is None:
-        ctx = SpmdContext(1)
-        set_env((ctx, 0))
-        env = (ctx, 0)
+        if os.environ.get("TPU_MPI_PROC_RANK") is not None:
+            # Launched as one process of a multi-process world
+            # (tpurun --procs): rendezvous over the native transport.
+            from .backend import proc_attach
+            env = proc_attach()
+        else:
+            ctx = SpmdContext(1)
+            set_env((ctx, 0))
+            env = (ctx, 0)
     ctx, rank = env
     if ctx.initialized[rank]:
         raise MPIError("MPI.Init() was already called on this rank")

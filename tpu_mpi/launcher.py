@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import time
 import runpy
 import sys
 from typing import Optional
@@ -62,6 +63,75 @@ def launch_script(path: str, nprocs: int, script_args: Optional[list[str]] = Non
         sys.argv = old_argv
 
 
+def launch_processes(path: str, nprocs: int,
+                     script_args: Optional[list[str]] = None,
+                     timeout: Optional[float] = None,
+                     sim: Optional[int] = None) -> int:
+    """Run a script as N OS processes over the native transport (the
+    reference's actual launch model, bin/mpiexecjl:55-64: mpiexec forks N
+    processes; ranks bind at Init). Returns the job exit code; any rank
+    failing nonzero fails the job, mpiexec-style."""
+    import signal
+    import subprocess
+
+    from .backend import Coordinator
+
+    coord = Coordinator(nprocs)
+    procs: list[subprocess.Popen] = []
+    try:
+        for rank in range(nprocs):
+            env = dict(os.environ)
+            env["TPU_MPI_PROC_RANK"] = str(rank)
+            env["TPU_MPI_PROC_SIZE"] = str(nprocs)
+            env["TPU_MPI_PROC_COORD"] = coord.address
+            if sim is not None:
+                env["JAX_PLATFORMS"] = "cpu"
+                flags = env.get("XLA_FLAGS", "")
+                if "xla_force_host_platform_device_count" not in flags:
+                    env["XLA_FLAGS"] = (
+                        flags
+                        + f" --xla_force_host_platform_device_count={sim}"
+                    ).strip()
+                env.pop("PALLAS_AXON_POOL_IPS", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, path] + list(script_args or []), env=env))
+        code = 0
+        deadline = None if timeout is None else (time.monotonic() + timeout)
+        pending = list(procs)
+        while pending:
+            for p in list(pending):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                pending.remove(p)
+                if rc != 0 and code == 0:
+                    code = rc
+                    # fate-sharing: one rank failed, kill the rest
+                    for q in pending:
+                        q.terminate()
+            if pending:
+                if deadline is not None and time.monotonic() > deadline:
+                    for q in pending:
+                        q.terminate()
+                    code = code or 124
+                    break
+                try:
+                    pending[0].wait(timeout=0.2)
+                except subprocess.TimeoutExpired:
+                    pass
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        return code
+    finally:
+        coord.close()
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="tpurun",
@@ -71,6 +141,9 @@ def main(argv: Optional[list[str]] = None) -> int:
                    help="number of ranks (default: number of local devices)")
     p.add_argument("--sim", type=int, default=None, metavar="N",
                    help="simulate N XLA CPU devices (test mode)")
+    p.add_argument("--procs", action="store_true",
+                   help="one OS process per rank over the native transport "
+                        "(multi-host deployment shape) instead of rank threads")
     p.add_argument("--timeout", type=float, default=None,
                    help="abort the job after SECONDS")
     p.add_argument("script", help="Python script to run on every rank")
@@ -89,6 +162,9 @@ def main(argv: Optional[list[str]] = None) -> int:
         except Exception:
             args.np = 1
     try:
+        if args.procs:
+            return launch_processes(args.script, args.np, args.script_args,
+                                    timeout=args.timeout, sim=args.sim)
         launch_script(args.script, args.np, args.script_args, timeout=args.timeout)
     except SystemExit as e:
         if e.code is None:

@@ -131,6 +131,10 @@ class Win:
 
 def _collective_state(comm: Comm, contrib, opname: str) -> Any:
     """One rendezvous that makes the last arriver build shared state."""
+    if not getattr(comm.ctx, "supports_shared_objects", True):
+        raise MPIError("one-sided RMA windows require a shared address space; "
+                       "not supported in multi-process mode (yet)")
+
     def combine(cs):
         st = _WinState(len(cs), dynamic=all(c is None for c in cs))
         for r, c in enumerate(cs):

@@ -228,10 +228,13 @@ def send(obj: Any, dest: int, tag: int, comm: Comm) -> None:
         return
     try:
         data = pickle.dumps(obj)
-        _post(comm, dest, tag, data, len(data), None, "object")
     except Exception:
-        # In-process transport: unpicklable objects travel by reference.
+        # In-process transport: unpicklable objects travel by reference
+        # (the multi-process mailbox proxy rejects this kind with a clear
+        # error — no shared address space there).
         _post(comm, dest, tag, obj, 0, None, "objref")
+        return
+    _post(comm, dest, tag, data, len(data), None, "object")
 
 
 def isend(obj: Any, dest: int, tag: int, comm: Comm) -> Request:
