@@ -1,0 +1,77 @@
+"""Threaded messaging under THREAD_MULTIPLE (test/test_threads.jl).
+
+The reference storms Isend/Irecv from Threads.@threads on every rank
+(test/test_threads.jl:27-40) after Init_thread(THREAD_MULTIPLE); here each
+rank-thread spawns worker threads doing per-tag nonblocking exchanges with
+its ring neighbors.
+"""
+
+import threading
+
+import numpy as np
+
+import tpu_mpi as MPI
+from tpu_mpi import spmd_run
+
+
+N = 10
+
+
+def test_thread_level_contract():
+    def program():
+        provided = MPI.Init_thread(MPI.THREAD_MULTIPLE)
+        assert MPI.THREAD_SINGLE <= provided <= MPI.THREAD_MULTIPLE
+        assert MPI.Query_thread() == provided
+        assert MPI.Is_thread_main()
+        MPI.Finalize()
+        return int(provided)
+
+    results = spmd_run(program, 4)
+    assert all(r == int(MPI.THREAD_MULTIPLE) for r in results)
+
+
+def test_threaded_isend_irecv_storm():
+    def program():
+        provided = MPI.Init_thread(MPI.THREAD_MULTIPLE)
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        dst, src = (rank + 1) % size, (rank - 1) % size
+
+        send_arr = np.arange(1.0, N + 1.0)
+        recv_arr = np.zeros(N)
+        reqs: list = [None] * (2 * N)
+        # Worker threads are NOT the thread that called Init: they must still
+        # be able to post sends/recvs (THREAD_MULTIPLE) while not being
+        # "thread main".
+        not_main = []
+
+        def worker(i: int) -> None:
+            not_main.append(MPI.Is_thread_main())
+            reqs[N + i] = MPI.Irecv(recv_arr[i:i + 1], src, i, comm)
+            reqs[i] = MPI.Isend(send_arr[i:i + 1], dst, i, comm)
+
+        # attach worker threads to this rank's environment
+        from tpu_mpi._runtime import current_env, set_env
+        env = current_env()
+
+        def attached(i):
+            set_env(env)
+            try:
+                worker(i)
+            finally:
+                set_env(None)
+
+        threads = [threading.Thread(target=attached, args=(i,))
+                   for i in range(N)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        MPI.Waitall(reqs)
+        assert np.array_equal(recv_arr, send_arr), (rank, recv_arr)
+        assert not any(not_main)
+        MPI.Finalize()
+        return True
+
+    assert all(spmd_run(program, 4))

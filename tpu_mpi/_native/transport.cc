@@ -183,11 +183,15 @@ class Transport {
       (void)!::write(wake_pipe_[1], &c, 1);
     }
     if (progress_.joinable()) progress_.join();
-    for (int& fd : peer_fds_)
-      if (fd >= 0) {
-        ::close(fd);
-        fd = -1;
+    for (int i = 0; i < static_cast<int>(peer_fds_.size()); ++i) {
+      // Lock out concurrent send(): closing under a live write_all would
+      // hand the fd number back to the OS for reuse mid-write.
+      std::lock_guard<std::mutex> g(peer_locks_[i]);
+      if (peer_fds_[i] >= 0) {
+        ::close(peer_fds_[i]);
+        peer_fds_[i] = -1;
       }
+    }
     for (Conn& c : conns_)
       if (c.fd >= 0) ::close(c.fd);
     conns_.clear();

@@ -143,8 +143,8 @@ class ProcChannel(_Waitable):
             self.round += 1
         root_world = self.group[0]
         if ctx.local_rank != root_world:
-            frame = pickle.dumps(("coll", self.cid, rnd, rank, opname,
-                                  _pack(contrib)))
+            frame = self._encode(("coll", self.cid, rnd, rank, opname,
+                                  _pack(contrib)), opname)
             ctx.transport.send(root_world, frame)
             with self.cond:
                 self._wait_for(lambda: (rnd,) in self.inbox,
@@ -183,9 +183,23 @@ class ProcChannel(_Waitable):
         for r in range(n):
             if r == rank:
                 continue
-            frame = pickle.dumps(("collres", self.cid, rnd, _pack(results[r])))
+            frame = self._encode(("collres", self.cid, rnd, _pack(results[r])),
+                                 opname)
             ctx.transport.send(self.group[r], frame)
         return results[rank]
+
+    def _encode(self, item: Any, opname: str) -> bytes:
+        """Pickle a protocol frame; an unpicklable payload fate-shares with a
+        clear error instead of a raw PicklingError mid-protocol (the p2p
+        proxy already guards its equivalent case)."""
+        try:
+            return pickle.dumps(item)
+        except Exception as e:
+            err = MPIError(
+                f"collective {opname} payload is not picklable and "
+                f"multi-process ranks do not share an address space: {e}")
+            self.ctx.fail(err)
+            raise err from None
 
 
 class ProcContext(SpmdContext):
@@ -351,6 +365,10 @@ def proc_attach() -> tuple[ProcContext, int]:
     transport.set_peers(addrs)
     ctx = ProcContext(rank, size, transport)
     set_env((ctx, rank))
+    # Deterministic teardown: stop the drainer + native progress thread at
+    # interpreter exit rather than relying on GC-order __del__.
+    import atexit
+    atexit.register(ctx.shutdown)
     return ctx, rank
 
 

@@ -132,6 +132,33 @@ def launch_processes(path: str, nprocs: int,
                 p.send_signal(signal.SIGTERM)
 
 
+def install_tpurun(command: str = "tpurun",
+                   destdir: Optional[str] = None,
+                   force: bool = False, verbose: bool = True) -> str:
+    """Install a ``tpurun`` wrapper executable (the install_mpiexecjl analog,
+    src/mpiexec_wrapper.jl:12-26): a small script that launches this
+    interpreter's ``tpu_mpi.launcher`` with the caller's arguments. Returns
+    the installed path."""
+    if destdir is None:
+        destdir = os.path.join(os.path.expanduser("~"), ".local", "bin")
+    destdir = os.path.abspath(os.path.expanduser(destdir))
+    exec_path = os.path.join(destdir, command)
+    if os.path.exists(exec_path) and not force:
+        raise MPIError(f"file {exec_path!r} already exists; "
+                       f"use install_tpurun(force=True) to overwrite")
+    os.makedirs(destdir, exist_ok=True)
+    if verbose:
+        print(f"Installing {command!r} to {destdir!r}...")
+    script = ("#!/bin/sh\n"
+              f"exec \"{sys.executable}\" -m tpu_mpi.launcher \"$@\"\n")
+    with open(exec_path, "w") as f:
+        f.write(script)
+    os.chmod(exec_path, 0o755)
+    if verbose:
+        print("Done!")
+    return exec_path
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="tpurun",
