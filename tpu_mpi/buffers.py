@@ -91,14 +91,23 @@ class DeviceBuffer:
 
     def setflat(self, src: Any, count: Optional[int] = None) -> None:
         """Assign the first ``count`` flat elements from src."""
+        v = self.value
+        # Fast path: full replacement by an identically-shaped jax array is a
+        # pure rebind — no device dispatch at all. This is the hot lane of the
+        # host-path collectives (the combined result is handed straight back).
+        if (is_jax_array(src) and src.dtype == v.dtype and src.shape == v.shape
+                and (count is None or count == v.size)):
+            self.value = src
+            return
         import jax.numpy as jnp
-        flat = jnp.ravel(jnp.asarray(src, dtype=self.value.dtype))
-        n = flat.size if count is None else count
-        if n == self.value.size and self.value.shape == tuple(np.shape(src)):
-            self.value = jnp.asarray(src, dtype=self.value.dtype)
+        n = (count if count is not None
+             else int(np.prod(np.shape(src), dtype=np.int64)))
+        if n == v.size and v.shape == tuple(np.shape(src)):
+            self.value = jnp.asarray(src, dtype=v.dtype)
         else:
-            out = jnp.ravel(self.value).at[:n].set(flat[:n])
-            self.value = out.reshape(self.value.shape)
+            flat = jnp.ravel(jnp.asarray(src, dtype=v.dtype))
+            out = jnp.ravel(v).at[:n].set(flat[:n])
+            self.value = out.reshape(v.shape)
 
     def copy(self) -> "DeviceBuffer":
         return DeviceBuffer(self.value)
@@ -230,8 +239,12 @@ def to_wire(x: Any, count: Optional[int] = None) -> Any:
         arr = np.ascontiguousarray(np.asarray(x))
         arr = arr.copy() if arr is x else arr
     if count is not None:
-        # Always hand out a flat view: collectives slice wire buffers by
-        # flat element offset regardless of the operand's rank.
+        # Hand out a flat view: collectives slice wire buffers by flat
+        # element offset regardless of the operand's rank. A 1-d exact-size
+        # array IS its own flat view — skip the reshape dispatch (hot lane).
+        shape = arr.shape
+        if len(shape) == 1 and shape[0] == count:
+            return arr
         flat = arr.reshape(-1)
         return flat if flat.size == count else flat[:count]
     return arr

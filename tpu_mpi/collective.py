@@ -27,13 +27,15 @@ from __future__ import annotations
 
 import functools
 import pickle
+import threading
+from collections import OrderedDict
 from typing import Any, Optional, Sequence
 
 import numpy as np
 
 from .buffers import (IN_PLACE, DeviceBuffer, _InPlace, assert_minlength,
-                      clone_like, element_count, extract_array, to_wire,
-                      write_flat)
+                      clone_like, element_count, extract_array, is_jax_array,
+                      to_wire, write_flat)
 from .comm import Comm
 from .error import MPIError
 from .operators import Op, as_op
@@ -43,9 +45,96 @@ def _run(comm: Comm, contrib: Any, combine, opname: str) -> Any:
     return comm.channel().run(comm.rank(), contrib, combine, opname)
 
 
+_NOT_JITTABLE = object()
+
+# Compiled-fold caches, keyed by the *underlying fn* so that as_op() wrapping
+# the same user function in a fresh Op each call still hits. Bounded LRU:
+# compiled executables are retained for at most _FOLD_CAP distinct
+# (fn, mode, nranks, dtype, shapes) signatures. A signature is only compiled
+# on its SECOND encounter (_fold_seen), so a one-shot lambda never pays the
+# trace+compile cost — it runs the eager fold like before.
+_FOLD_CAP = 64
+_fold_compiled: "OrderedDict[Any, Any]" = OrderedDict()
+_fold_seen: "OrderedDict[Any, None]" = OrderedDict()
+_fold_lock = threading.Lock()
+
+
+def _jitted_fold(arrs: Sequence[Any], op: Op, mode: str):
+    """One-dispatch combine for device arrays: the whole rank-ordered fold is
+    compiled into a single XLA computation (fused: one pass over the operands
+    instead of n-1 round trips through HBM — the hot loop the reference gets
+    from libmpi's tuned ring, src/collective.jl:691-738). Sequential left
+    fold, so results are bit-identical to the eager rank-order reduction.
+
+    Returns the combined array ("reduce"), the tuple of inclusive prefixes
+    ("scan"), or _NOT_JITTABLE when the op can't trace (host-only custom fn)
+    or the signature isn't worth compiling yet."""
+    n = len(arrs)
+    if n <= 1 or not all(is_jax_array(a) for a in arrs):
+        return _NOT_JITTABLE
+    try:
+        key = (op.fn, mode, n, str(arrs[0].dtype), tuple(a.shape for a in arrs))
+        hash(key)
+    except TypeError:
+        return _NOT_JITTABLE
+    with _fold_lock:
+        hit = _fold_compiled.get(key)
+        if hit is None:
+            if key not in _fold_seen:
+                _fold_seen[key] = None
+                while len(_fold_seen) > 4 * _FOLD_CAP:
+                    _fold_seen.popitem(last=False)
+                return _NOT_JITTABLE
+    if hit is _NOT_JITTABLE:
+        return _NOT_JITTABLE
+    if hit is not None:
+        return hit(*arrs)
+
+    import jax
+
+    if mode == "reduce":
+        def fold(*xs):
+            acc = xs[0]
+            for x in xs[1:]:
+                acc = op.fn(acc, x)
+            return acc
+    else:  # scan: all inclusive prefixes
+        def fold(*xs):
+            outs = [xs[0]]
+            for x in xs[1:]:
+                outs.append(op.fn(outs[-1], x))
+            return tuple(outs)
+    try:
+        jitted = jax.jit(fold)
+        out = jitted(*arrs)  # traces now; host-only ops raise here
+    except Exception:
+        jitted, out = _NOT_JITTABLE, _NOT_JITTABLE
+    with _fold_lock:
+        _fold_compiled[key] = jitted
+        while len(_fold_compiled) > _FOLD_CAP:
+            _fold_compiled.popitem(last=False)
+    return out
+
+
 def _reduce_arrays(arrs: Sequence[Any], op: Op) -> Any:
     """Rank-ordered elementwise reduction (deterministic; MPI rank order)."""
+    out = _jitted_fold(arrs, op, "reduce")
+    if out is not _NOT_JITTABLE:
+        return out
     return functools.reduce(op, arrs)
+
+
+def _scan_arrays(cs: Sequence[Any], op: Op) -> list:
+    """Inclusive prefixes in rank order (same fold, all partials kept)."""
+    pre = _jitted_fold(cs, op, "scan")
+    if pre is not _NOT_JITTABLE:
+        return list(pre)
+    outs: list = []
+    acc = None
+    for c in cs:
+        acc = c if acc is None else op(acc, c)
+        outs.append(acc)
+    return outs
 
 
 def _is_none(x: Any) -> bool:
@@ -480,17 +569,10 @@ def _reduce_family(args, has_root: bool, mode: str, name: str) -> Any:
             total = _reduce_arrays(cs, op)
             return [total] * n
         if mode == "scan":
-            outs, acc = [], None
-            for c in cs:
-                acc = c if acc is None else op(acc, c)
-                outs.append(acc)
-            return outs
+            return _scan_arrays(cs, op)
         if mode == "exscan":
-            outs, acc = [None], None
-            for c in cs[:-1]:
-                acc = c if acc is None else op(acc, c)
-                outs.append(acc)
-            return outs
+            # exscan[i] = scan over ranks 0..i-1; rank 0's slot is undefined.
+            return [None, *_scan_arrays(cs[:-1], op)]
         raise AssertionError(mode)
 
     result = _run(comm, payload, combine, f"{name}@{comm.cid}")
@@ -516,7 +598,9 @@ def _reduce_family(args, has_root: bool, mode: str, name: str) -> Any:
 
 def _shape_result(result: Any, like: Any, count: int) -> Any:
     arr = extract_array(like)
-    if arr is not None and arr.size == count and np.asarray(result).size == count:
+    if arr is None or getattr(result, "shape", None) == arr.shape:
+        return result   # metadata-only check; no dispatch on the hot lane
+    if arr.size == count and np.asarray(result).size == count:
         return np.asarray(result).reshape(arr.shape) if not type(result).__module__.startswith("jax") \
             else result.reshape(arr.shape)
     return result
