@@ -26,15 +26,23 @@ N_ELEMS = 1 << 26            # Float32[2^26] = 256 MiB
 WARMUP = 2
 ITERS = 5
 
-# Public per-generation numbers used only to contextualize vs_baseline:
-# aggregate one-way ICI GB/s per chip, HBM GB/s per chip.
-ICI_GBPS = {"v5e": 180.0, "v5litepod": 180.0, "v5p": 540.0, "v4": 270.0}
-HBM_GBPS = {"v5e": 819.0, "v5litepod": 819.0, "v5p": 2765.0, "v4": 1228.0}
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+if _REPO_DIR not in sys.path:
+    sys.path.insert(0, _REPO_DIR)
+
+
+def _caps():
+    """Per-generation capability tables live in the library
+    (tpu_mpi.implementations.CAPABILITIES, VERDICT r1 item 9)."""
+    from tpu_mpi.implementations import CAPABILITIES
+    return CAPABILITIES
 
 
 def _gen_of(device) -> str:
     kind = getattr(device, "device_kind", "").lower().replace(" ", "")
-    for key in ICI_GBPS:
+    if "v5lite" in kind:
+        return "v5e"
+    for key in sorted(_caps(), key=len, reverse=True):
         if key in kind:
             return key
     return "v5e"
@@ -62,7 +70,7 @@ def _bench_in_graph(jax, devices, n_elems: int = N_ELEMS) -> dict:
     nbytes = n_elems * 4
     busbw = 2 * (n - 1) / n * nbytes / dt / 1e9
     gen = _gen_of(devices[0])
-    target = 0.9 * ICI_GBPS.get(gen, 180.0)
+    target = 0.9 * _caps().get(gen, {}).get("ici_gbps", 180.0)
     log2 = n_elems.bit_length() - 1
     return {
         "metric": f"Allreduce Float32[2^{log2}] bus bandwidth, in-graph psum, "
@@ -107,8 +115,9 @@ def _bench_host_path(device_kind: str, use_device: bool,
     times = spmd_run(body, nranks)
     dt = max(times)
     algbw = nbytes / dt / 1e9
-    gen = device_kind if device_kind in HBM_GBPS else "v5e"
-    ref = HBM_GBPS.get(gen, 819.0)
+    caps = _caps()
+    gen = device_kind if device_kind in caps else "v5e"
+    ref = caps.get(gen, {}).get("hbm_gbps", 819.0)
     where = f"1x {gen} chip" if use_device else "cpu"
     log2 = n_elems.bit_length() - 1
     return {

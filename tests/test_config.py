@@ -1,0 +1,81 @@
+"""Config module: env + TOML precedence (reference: deps/build.jl:14-58
+persisting JULIA_MPI_* to ~/.julia/prefs/MPI.toml)."""
+
+import os
+
+import pytest
+
+import tpu_mpi
+from tpu_mpi import config
+from tpu_mpi.error import MPIError
+
+
+@pytest.fixture
+def clean_env(tmp_path, monkeypatch):
+    for var in list(os.environ):
+        if var.startswith("TPU_MPI_"):
+            monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("TPU_MPI_CONFIG", str(tmp_path / "config.toml"))
+    config.load(refresh=True)
+    yield tmp_path
+    config.load(refresh=True)
+
+
+def test_defaults(clean_env):
+    cfg = config.load(refresh=True)
+    assert cfg.backend == "auto"
+    assert cfg.deadlock_timeout == 60.0
+    assert cfg.sim_devices == 8
+    assert cfg.coordinator == ""
+
+
+def test_env_overrides(clean_env, monkeypatch):
+    monkeypatch.setenv("TPU_MPI_DEADLOCK_TIMEOUT", "12.5")
+    monkeypatch.setenv("TPU_MPI_BACKEND", "cpu-sim")
+    cfg = config.load(refresh=True)
+    assert cfg.deadlock_timeout == 12.5
+    assert cfg.backend == "cpu-sim"
+
+
+def test_toml_then_env_precedence(clean_env, monkeypatch):
+    path = clean_env / "config.toml"
+    path.write_text('backend = "tpu"\nsim_devices = 4\nnprocs = 2\n')
+    cfg = config.load(refresh=True)
+    assert cfg.backend == "tpu" and cfg.sim_devices == 4 and cfg.nprocs == 2
+    monkeypatch.setenv("TPU_MPI_SIM_DEVICES", "16")   # env wins over TOML
+    cfg = config.load(refresh=True)
+    assert cfg.sim_devices == 16
+    assert cfg.backend == "tpu"
+
+
+def test_persist_roundtrip(clean_env):
+    out = config.persist(deadlock_timeout=30.0, coordinator="10.0.0.1:9999")
+    assert os.path.exists(out)
+    cfg = config.load(refresh=True)
+    assert cfg.deadlock_timeout == 30.0
+    assert cfg.coordinator == "10.0.0.1:9999"
+
+
+def test_bad_value_rejected(clean_env, monkeypatch):
+    monkeypatch.setenv("TPU_MPI_SIM_DEVICES", "not-a-number")
+    with pytest.raises(MPIError):
+        config.load(refresh=True)
+    monkeypatch.delenv("TPU_MPI_SIM_DEVICES")
+    config.load(refresh=True)
+
+
+def test_runtime_deadlock_timeout_uses_env(clean_env, monkeypatch):
+    from tpu_mpi._runtime import deadlock_timeout
+    monkeypatch.setenv("TPU_MPI_DEADLOCK_TIMEOUT", "7")
+    assert deadlock_timeout() == 7.0
+    monkeypatch.delenv("TPU_MPI_DEADLOCK_TIMEOUT")
+    config.load(refresh=True)
+    assert deadlock_timeout() == 60.0
+
+
+def test_capability_tables():
+    from tpu_mpi.implementations import CAPABILITIES, capabilities
+    for gen, row in CAPABILITIES.items():
+        assert {"ici_gbps", "hbm_gbps", "hbm_gib", "cores", "bf16_tflops"} <= set(row)
+    assert capabilities("v5e")["hbm_gbps"] == 819.0
+    assert capabilities("nonsense")["hbm_gbps"] == 819.0  # fallback row

@@ -1,0 +1,81 @@
+"""Divergent-root detection in rooted collectives.
+
+The reference inherits libmpi's behavior, where disagreeing roots silently
+corrupt data or deadlock; here every rooted collective ships the claimed root
+inside each contribution and fails loudly on all ranks (the Scatterv
+root-shipped-counts pattern, VERDICT r1 item 8)."""
+
+import numpy as np
+import pytest
+
+import tpu_mpi as MPI
+from tpu_mpi.testing import run_spmd
+
+
+def _divergent_root(rank):
+    # rank 0 claims root 0, everyone else claims root 1
+    return 0 if rank == 0 else 1
+
+
+@pytest.mark.parametrize("opname", ["Bcast", "bcast", "Scatter", "Scatterv",
+                                    "Gather", "Gatherv", "Reduce"])
+def test_divergent_root_fails_all_ranks(opname, nprocs):
+    if nprocs < 2:
+        pytest.skip("needs >= 2 ranks")
+
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        size = MPI.Comm_size(comm)
+        root = _divergent_root(rank)
+        buf = np.arange(size * 2, dtype=np.float64)
+        with pytest.raises((MPI.CollectiveMismatchError, MPI.AbortError)):
+            if opname == "Bcast":
+                MPI.Bcast(buf, root, comm)
+            elif opname == "bcast":
+                MPI.bcast({"x": 1} if rank == root else None, root, comm)
+            elif opname == "Scatter":
+                out = np.zeros(2)
+                MPI.Scatter(buf, out, root, comm)
+            elif opname == "Scatterv":
+                out = np.zeros(2)
+                MPI.Scatterv(buf, out, [2] * size, root, comm)
+            elif opname == "Gather":
+                MPI.Gather(np.ones(2), root, comm)
+            elif opname == "Gatherv":
+                MPI.Gatherv(np.ones(2), [2] * size, root, comm)
+            elif opname == "Reduce":
+                MPI.Reduce(buf, MPI.SUM, root, comm)
+
+    with pytest.raises((MPI.CollectiveMismatchError, MPI.AbortError)):
+        run_spmd(body, nprocs)
+
+
+def test_invalid_root_rejected(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        size = MPI.Comm_size(comm)
+        buf = np.zeros(4)
+        with pytest.raises(MPI.MPIError):
+            MPI.Bcast(buf, size + 3, comm)       # out of range
+        with pytest.raises(MPI.MPIError):
+            MPI.Bcast(buf, -1, comm)
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs)
+
+
+def test_agreeing_nonzero_root_still_works(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        size = MPI.Comm_size(comm)
+        root = size - 1
+        got = MPI.Gather(np.array([float(rank)]), root, comm)
+        if rank == root:
+            assert np.array_equal(got, np.arange(size, dtype=np.float64))
+        out = MPI.Reduce(np.array([1.0]), MPI.SUM, root, comm)
+        if rank == root:
+            assert out[0] == size
+
+    run_spmd(body, nprocs)

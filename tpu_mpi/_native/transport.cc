@@ -31,6 +31,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <deque>
 #include <memory>
 #include <mutex>
@@ -41,6 +42,22 @@
 namespace {
 
 constexpr uint32_t kMagic = 0x7D5A11E7u;
+
+// Corrupt-stream guard: a garbled-but-magic-valid header must not make the
+// connection buffer grow unboundedly waiting for bytes that never arrive.
+// Overridable via TPU_MPI_MAX_FRAME_BYTES (see tpu_mpi.config); default 2 GiB.
+int64_t max_frame_bytes() {
+  static int64_t cached = [] {
+    const char* s = ::getenv("TPU_MPI_MAX_FRAME_BYTES");
+    if (s != nullptr) {
+      char* end = nullptr;
+      long long v = strtoll(s, &end, 10);
+      if (end != s && v > 0) return static_cast<int64_t>(v);
+    }
+    return static_cast<int64_t>(1) << 31;
+  }();
+  return cached;
+}
 
 struct FrameHeader {
   uint32_t magic;
@@ -289,7 +306,8 @@ class Transport {
     while (c.buf.size() - off >= sizeof(FrameHeader)) {
       FrameHeader h;
       memcpy(&h, c.buf.data() + off, sizeof(h));
-      if (h.magic != kMagic || h.len < 0) {  // corrupt stream: drop the conn
+      // Corrupt stream (bad magic, negative or absurd length): drop the conn.
+      if (h.magic != kMagic || h.len < 0 || h.len > max_frame_bytes()) {
         ::close(c.fd);
         c.fd = -1;
         c.buf.clear();

@@ -36,7 +36,20 @@ ANY_TAG = -1
 PROC_NULL = -3
 UNDEFINED = -32766
 
-_DEADLOCK_TIMEOUT = float(os.environ.get("TPU_MPI_DEADLOCK_TIMEOUT", "60"))
+def deadlock_timeout() -> float:
+    """Seconds a blocking wait may stall before DeadlockError. Read per wait
+    (env var first for test-time overrides, then the config module) so a
+    runtime change takes effect without re-importing."""
+    raw = os.environ.get("TPU_MPI_DEADLOCK_TIMEOUT")
+    if raw is not None:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    from . import config
+    return config.load().deadlock_timeout
+
+
 _POLL = 0.02
 
 _tls = threading.local()
@@ -68,14 +81,15 @@ class _Waitable:
     def _wait_for(self, pred: Callable[[], bool], what: str,
                   timeout: Optional[float] = None) -> bool:
         """Wait (cond held) until pred() or failure/deadlock. Returns pred()."""
-        deadline = time.monotonic() + (_DEADLOCK_TIMEOUT if timeout is None else timeout)
+        limit = deadlock_timeout() if timeout is None else timeout
+        deadline = time.monotonic() + limit
         while not pred():
             self.ctx.check_failure()
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 if timeout is not None:
                     return False
-                raise DeadlockError(f"deadlock suspected: blocked >{_DEADLOCK_TIMEOUT}s in {what}")
+                raise DeadlockError(f"deadlock suspected: blocked >{limit}s in {what}")
             self.cond.wait(min(_POLL, remaining))
         return True
 

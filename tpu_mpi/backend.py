@@ -34,6 +34,7 @@ from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
+from . import config
 from ._runtime import (ANY_SOURCE, Mailbox, Message, SpmdContext, _Waitable,
                        set_env)
 from .error import AbortError, CollectiveMismatchError, MPIError
@@ -241,28 +242,39 @@ class ProcContext(SpmdContext):
             except Exception as e:              # corrupted frame: fate-share
                 self.fail(MPIError(f"undecodable frame from {src_world}: {e}"))
                 continue
-            kind = item[0]
-            if kind == "p2p":
-                _, src, tag, cid, payload, count, dtype, mkind = item
-                msg = Message(src, tag, cid, _unpack(payload), count, dtype,
-                              mkind)
-                self.mailboxes[self.local_rank].post(msg)
-            elif kind == "coll":
-                _, cid, rnd, src, opname, contrib = item
-                self._proc_channel(cid).deliver_contrib(rnd, src, opname,
-                                                        contrib)
-            elif kind == "collres":
-                _, cid, rnd, result = item
-                self._proc_channel(cid).deliver_result(rnd, result)
-            elif kind == "abort":
-                _, text = item
-                with self._failure_lock:
-                    if self.failure is None:
-                        self.failure = AbortError(text)
-                self.mailboxes[self.local_rank].notify()
-                for ch in list(self._channels.values()):
-                    with ch.cond:
-                        ch.cond.notify_all()
+            try:
+                self._dispatch(src_world, item)
+            except Exception as e:
+                # A failure while dispatching a decoded frame (malformed
+                # tuple, error inside deliver/post) must fate-share, not
+                # silently kill the drainer thread (ADVICE r1).
+                self.fail(MPIError(
+                    f"error dispatching frame from {src_world}: "
+                    f"{type(e).__name__}: {e}"))
+
+    def _dispatch(self, src_world: int, item: Any) -> None:
+        kind = item[0]
+        if kind == "p2p":
+            _, src, tag, cid, payload, count, dtype, mkind = item
+            msg = Message(src, tag, cid, _unpack(payload), count, dtype,
+                          mkind)
+            self.mailboxes[self.local_rank].post(msg)
+        elif kind == "coll":
+            _, cid, rnd, src, opname, contrib = item
+            self._proc_channel(cid).deliver_contrib(rnd, src, opname,
+                                                    contrib)
+        elif kind == "collres":
+            _, cid, rnd, result = item
+            self._proc_channel(cid).deliver_result(rnd, result)
+        elif kind == "abort":
+            _, text = item
+            with self._failure_lock:
+                if self.failure is None:
+                    self.failure = AbortError(text)
+            self.mailboxes[self.local_rank].notify()
+            for ch in list(self._channels.values()):
+                with ch.cond:
+                    ch.cond.notify_all()
 
     # -- channel management ---------------------------------------------------
     def _proc_channel(self, cid: Any) -> ProcChannel:
@@ -347,7 +359,7 @@ def proc_attach() -> tuple[ProcContext, int]:
         # The address map only arrives once ALL siblings have joined; sibling
         # startup skew (native build, cold jax import) routinely exceeds the
         # connect timeout, so wait much longer for the map itself.
-        s.settimeout(float(os.environ.get("TPU_MPI_RENDEZVOUS_TIMEOUT", "600")))
+        s.settimeout(config.load().rendezvous_timeout)
         s.sendall(json.dumps({"rank": rank, "port": transport.port}).encode()
                   + b"\n")
         buf = b""
@@ -362,6 +374,8 @@ def proc_attach() -> tuple[ProcContext, int]:
                 raise MPIError("coordinator closed during rendezvous")
             buf += chunk
     addrs = json.loads(buf.decode())
+    if isinstance(addrs, dict) and "error" in addrs:
+        raise MPIError(f"rendezvous failed: {addrs['error']}")
     transport.set_peers(addrs)
     ctx = ProcContext(rank, size, transport)
     set_env((ctx, rank))
@@ -395,11 +409,11 @@ class Coordinator:
         return f"{self.host}:{self.port}"
 
     def _serve(self) -> None:
-        conns: list[tuple[socket.socket, int]] = []
-        ports: dict[int, int] = {}
+        conns: dict[int, socket.socket] = {}     # rank -> connection
+        addrs: dict[int, str] = {}               # rank -> "host:port"
         try:
             while len(conns) < self.nprocs:
-                c, _ = self.sock.accept()
+                c, peer = self.sock.accept()
                 buf = b""
                 while not buf.endswith(b"\n"):
                     chunk = c.recv(65536)
@@ -408,20 +422,46 @@ class Coordinator:
                     buf += chunk
                 try:
                     info = json.loads(buf.decode())
+                    rank = int(info["rank"])
+                    port = int(info["port"])
                 except Exception:
+                    c.close()                    # garbled registration
+                    continue
+                if rank in conns or not (0 <= rank < self.nprocs):
+                    # Duplicate or out-of-range rank: reject THIS registrant
+                    # with a diagnostic instead of overwriting a sibling's
+                    # slot and later dying on a missing rank (ADVICE r1).
+                    try:
+                        c.sendall((json.dumps(
+                            {"error": f"rendezvous rejected rank {rank}: "
+                                      + ("already registered" if rank in conns
+                                         else "out of range")}) + "\n").encode())
+                    except Exception:
+                        pass
                     c.close()
                     continue
-                ports[info["rank"]] = info["port"]
-                conns.append((c, info["rank"]))
-            addrs = [f"{self.host}:{ports[r]}" for r in range(self.nprocs)]
-            payload = (json.dumps(addrs) + "\n").encode()
-            for c, _ in conns:
+                # A child on another host reports its transport port; pair it
+                # with the address it connected from (loopback children report
+                # the coordinator-visible host).
+                chost = peer[0] if peer[0] not in ("127.0.0.1", "::1") else self.host
+                addrs[rank] = f"{chost}:{port}"
+                conns[rank] = c
+            world = [addrs[r] for r in range(self.nprocs)]
+            payload = (json.dumps(world) + "\n").encode()
+            for c in conns.values():
                 try:
                     c.sendall(payload)
                 finally:
                     c.close()
-        except Exception:
-            for c, _ in conns:
+        except Exception as e:
+            # Serve-side failure: tell every connected child so it fails fast
+            # instead of blocking out the full rendezvous timeout.
+            err = (json.dumps({"error": f"coordinator failed: {e}"}) + "\n").encode()
+            for c in conns.values():
+                try:
+                    c.sendall(err)
+                except Exception:
+                    pass
                 c.close()
 
     def close(self) -> None:

@@ -37,12 +37,33 @@ from .buffers import (IN_PLACE, DeviceBuffer, _InPlace, assert_minlength,
                       clone_like, element_count, extract_array, is_jax_array,
                       to_wire, write_flat)
 from .comm import Comm
-from .error import MPIError
+from .error import CollectiveMismatchError, MPIError
 from .operators import Op, as_op
 
 
 def _run(comm: Comm, contrib: Any, combine, opname: str) -> Any:
     return comm.channel().run(comm.rank(), contrib, combine, opname)
+
+
+def _run_rooted(comm: Comm, root: int, contrib: Any, combine, opname: str) -> Any:
+    """Rendezvous for rooted collectives: every rank ships its claimed root
+    inside its contribution, and divergent roots raise CollectiveMismatchError
+    on all ranks instead of silently electing whoever arrives first (the
+    Scatterv root-shipped-counts pattern, applied to the whole rooted family).
+    ``combine(contribs, root)`` sees the validated root."""
+    size = comm.size()
+    if not isinstance(root, (int, np.integer)) or not (0 <= root < size):
+        raise MPIError(f"invalid root {root!r} for a size-{size} communicator")
+    root = int(root)
+
+    def outer(cs):
+        roots = sorted({r for r, _ in cs})
+        if len(roots) > 1:
+            raise CollectiveMismatchError(
+                f"ranks disagree on the root of {opname}: {roots}")
+        return combine([c for _, c in cs], roots[0])
+
+    return _run(comm, (root, contrib), outer, opname)
 
 
 _NOT_JITTABLE = object()
@@ -168,11 +189,11 @@ def Bcast(buf: Any, *args) -> Any:
     assert_minlength(buf, n)
     payload = to_wire(buf, n) if rank == root else None
 
-    def combine(cs):
-        val = next(c for c in cs if c is not None)
+    def combine(cs, rt):
+        val = cs[rt]
         return [val] * len(cs)
 
-    val = _run(comm, payload, combine, f"Bcast@{comm.cid}")
+    val = _run_rooted(comm, root, payload, combine, f"Bcast@{comm.cid}")
     if rank != root:
         write_flat(buf, val, n)
     return buf
@@ -193,11 +214,11 @@ def bcast(obj: Any, root: int, comm: Comm) -> Any:
     else:
         payload = None
 
-    def combine(cs):
-        val = next(c for c in cs if c is not None)
+    def combine(cs, rt):
+        val = cs[rt]
         return [val] * len(cs)
 
-    kind, data = _run(comm, payload, combine, f"bcast@{comm.cid}")
+    kind, data = _run_rooted(comm, root, payload, combine, f"bcast@{comm.cid}")
     if rank == root:
         return obj
     return pickle.loads(data) if kind == "pickle" else data
@@ -235,11 +256,11 @@ def Scatter(*args) -> Any:
         assert_minlength(recvbuf, count)   # before the rendezvous (see Gather)
     payload = to_wire(sendbuf, count * size) if isroot else None
 
-    def combine(cs):
-        data = next(c for c in cs if c is not None)
+    def combine(cs, rt):
+        data = cs[rt]
         return [data[r * count:(r + 1) * count] for r in range(len(cs))]
 
-    chunk = _run(comm, payload, combine, f"Scatter@{comm.cid}")
+    chunk = _run_rooted(comm, root, payload, combine, f"Scatter@{comm.cid}")
     if alloc:
         template = sendbuf if isroot else None
         return clone_like(template, chunk) if template is not None else np.array(chunk)
@@ -273,12 +294,12 @@ def Scatterv(*args) -> Any:
     # the slicing depending on rendezvous arrival order.
     payload = (to_wire(sendbuf, sum(counts)), counts) if isroot else None
 
-    def combine(cs):
-        data, root_counts = cs[root]
+    def combine(cs, rt):
+        data, root_counts = cs[rt]
         displs = np.concatenate([[0], np.cumsum(root_counts)])
         return [data[displs[r]:displs[r] + root_counts[r]] for r in range(len(cs))]
 
-    chunk = _run(comm, payload, combine, f"Scatterv@{comm.cid}")
+    chunk = _run_rooted(comm, root, payload, combine, f"Scatterv@{comm.cid}")
     if alloc:
         template = sendbuf if isroot else None
         return clone_like(template, chunk) if template is not None else np.array(chunk)
@@ -357,7 +378,7 @@ def _gather_impl(sendbuf, recvbuf, count, root, comm, alloc, all_ranks):
     if not alloc and isroot and not _is_none(recvbuf):
         assert_minlength(recvbuf, count * size)
 
-    def combine(cs):
+    def combine(cs, rt=None):
         xp = np
         try:
             if any(type(c).__module__.startswith("jax") for c in cs):
@@ -367,7 +388,10 @@ def _gather_impl(sendbuf, recvbuf, count, root, comm, alloc, all_ranks):
         full = xp.concatenate([xp.asarray(c).reshape(-1) for c in cs])
         return [full] * len(cs)
 
-    full = _run(comm, payload, combine, f"Gather@{comm.cid}")
+    if all_ranks:
+        full = _run(comm, payload, combine, f"Allgather@{comm.cid}")
+    else:
+        full = _run_rooted(comm, root, payload, combine, f"Gather@{comm.cid}")
     if not isroot:
         return None if alloc else recvbuf
     if alloc:
@@ -423,14 +447,17 @@ def _gatherv_impl(sendbuf, recvbuf, counts, root, comm, alloc, all_ranks):
     if not alloc and isroot and not _is_none(recvbuf):
         assert_minlength(recvbuf, sum(counts))   # before the rendezvous
 
-    def combine(cs):
+    def combine(cs, rt=None):
         xp = np
         if any(type(c).__module__.startswith("jax") for c in cs):
             import jax.numpy as xp  # type: ignore
         full = xp.concatenate([xp.asarray(c).reshape(-1) for c in cs])
         return [full] * len(cs)
 
-    full = _run(comm, payload, combine, f"Gatherv@{comm.cid}")
+    if all_ranks:
+        full = _run(comm, payload, combine, f"Allgatherv@{comm.cid}")
+    else:
+        full = _run_rooted(comm, root, payload, combine, f"Gatherv@{comm.cid}")
     if not isroot:
         return None if alloc else recvbuf
     if alloc:
@@ -563,7 +590,7 @@ def _reduce_family(args, has_root: bool, mode: str, name: str) -> Any:
         assert_minlength(recvbuf, count)
     payload = to_wire(sendbuf, count)
 
-    def combine(cs):
+    def combine(cs, rt=None):
         n = len(cs)
         if mode == "reduce":
             total = _reduce_arrays(cs, op)
@@ -575,7 +602,10 @@ def _reduce_family(args, has_root: bool, mode: str, name: str) -> Any:
             return [None, *_scan_arrays(cs[:-1], op)]
         raise AssertionError(mode)
 
-    result = _run(comm, payload, combine, f"{name}@{comm.cid}")
+    if has_root:
+        result = _run_rooted(comm, root, payload, combine, f"{name}@{comm.cid}")
+    else:
+        result = _run(comm, payload, combine, f"{name}@{comm.cid}")
     i_get_result = (not has_root) or rank == root
     if mode == "exscan" and result is None:
         # rank 0's Exscan output is undefined (src/collective.jl:834-855);

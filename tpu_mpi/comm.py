@@ -351,10 +351,12 @@ def Comm_spawn(command, argv=None, maxprocs: int = 1, comm: Comm = COMM_WORLD,
         # Spawn is collective: every parent rank must agree on what to spawn
         # (libmpi validates root-side args; here all ranks contribute, so
         # disagreement must fail loudly, not be resolved by arrival order).
-        if len(set(cs)) > 1:
+        if any(c != cs[0] for c in cs[1:]):
             from .error import CollectiveMismatchError
+            # no sorted(): contribs may be heterogeneous (str vs tuple
+            # command ids) and must still produce THIS error, not TypeError
             raise CollectiveMismatchError(
-                f"Comm_spawn arguments disagree across ranks: {sorted(set(cs))}")
+                f"Comm_spawn arguments disagree across ranks: {cs!r}")
         world_cid = ctx.alloc_cid()
         inter_cid = ctx.alloc_cid()
         child_group = ctx.add_ranks(int(maxprocs), world_cid)
@@ -367,7 +369,15 @@ def Comm_spawn(command, argv=None, maxprocs: int = 1, comm: Comm = COMM_WORLD,
             ctx.start_rank_thread(r, lambda: _run_spawned(command, argv))
         return [(child_group, inter_cid)] * len(cs)
 
-    contrib = (int(maxprocs), tuple(worker_argv))
+    # A comparable identity for `command` too (ADVICE r1): ranks disagreeing
+    # on WHAT to spawn must be detected, not resolved by whichever rank's
+    # closure runs the combine. Callables compare by qualified name + module.
+    if callable(command):
+        command_id = (getattr(command, "__module__", ""),
+                      getattr(command, "__qualname__", repr(command)))
+    else:
+        command_id = str(command)
+    contrib = (int(maxprocs), command_id, tuple(worker_argv))
     child_group, inter_cid = comm.channel().run(
         my_rank, contrib, combine, f"Comm_spawn@{comm.cid}")
     if errors is not None:

@@ -106,4 +106,35 @@ def ici_topology() -> Optional[tuple[int, ...]]:
         return None
 
 
+# ---------------------------------------------------------------------------
+# Per-generation capability tables — the analog of the pre-baked ABI constant
+# tables deps/consts_mpich.jl / consts_openmpi.jl / consts_microsoftmpi.jl
+# (SURVEY.md §2.4): public chip-level numbers programs and benchmarks consult
+# to contextualize measurements (aggregate one-way ICI GB/s per chip, HBM
+# GB/s and capacity per chip, TensorCores per chip, peak bf16 TFLOP/s).
+# ---------------------------------------------------------------------------
+
+CAPABILITIES: dict[str, dict[str, float]] = {
+    "v2":  {"ici_gbps": 62.5,  "hbm_gbps": 300.0,  "hbm_gib": 16.0,
+            "cores": 2, "bf16_tflops": 46.0},
+    "v3":  {"ici_gbps": 112.5, "hbm_gbps": 450.0,  "hbm_gib": 32.0,
+            "cores": 2, "bf16_tflops": 123.0},
+    "v4":  {"ici_gbps": 270.0, "hbm_gbps": 1228.0, "hbm_gib": 32.0,
+            "cores": 2, "bf16_tflops": 275.0},
+    "v5e": {"ici_gbps": 180.0, "hbm_gbps": 819.0,  "hbm_gib": 16.0,
+            "cores": 1, "bf16_tflops": 197.0},
+    "v5p": {"ici_gbps": 540.0, "hbm_gbps": 2765.0, "hbm_gib": 95.0,
+            "cores": 2, "bf16_tflops": 459.0},
+    "v6":  {"ici_gbps": 448.0, "hbm_gbps": 1638.0, "hbm_gib": 32.0,
+            "cores": 1, "bf16_tflops": 918.0},
+}
+
+
+def capabilities(generation: Optional[str] = None) -> dict[str, float]:
+    """Capability row for a generation (default: the local chip; a modest
+    v5e row when the generation is unknown so ratios stay computable)."""
+    gen = generation or tpu_generation()
+    return dict(CAPABILITIES.get(gen or "", CAPABILITIES["v5e"]))
+
+
 MPI_LIBRARY = "tpu_mpi"

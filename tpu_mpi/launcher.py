@@ -74,16 +74,27 @@ def launch_processes(path: str, nprocs: int,
     import signal
     import subprocess
 
+    from . import config
     from .backend import Coordinator
 
-    coord = Coordinator(nprocs)
+    cfg = config.load()
+    coord = Coordinator(nprocs, host=cfg.coordinator_bind)
     procs: list[subprocess.Popen] = []
     try:
+        # Children run `python script.py`, whose sys.path[0] is the script's
+        # directory — make sure they can import this tpu_mpi no matter where
+        # the script lives (the mpiexecjl --project flag analog).
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         for rank in range(nprocs):
             env = dict(os.environ)
+            old_pp = env.get("PYTHONPATH", "")
+            env["PYTHONPATH"] = (pkg_parent + (os.pathsep + old_pp if old_pp else ""))
             env["TPU_MPI_PROC_RANK"] = str(rank)
             env["TPU_MPI_PROC_SIZE"] = str(nprocs)
             env["TPU_MPI_PROC_COORD"] = coord.address
+            # The native transport reads knobs from the environment only;
+            # export the merged config so TOML-persisted values reach children.
+            env.setdefault("TPU_MPI_MAX_FRAME_BYTES", str(cfg.max_frame_bytes))
             if sim is not None:
                 env["JAX_PLATFORMS"] = "cpu"
                 flags = env.get("XLA_FLAGS", "")
@@ -163,11 +174,13 @@ def main(argv: Optional[list[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="tpurun",
         description="Run an SPMD tpu_mpi program on N ranks (mpiexec analog)")
-    p.add_argument("-n", "--np", type=int,
-                   default=int(os.environ.get("TPU_MPI_NPROCS", "0")) or None,
+    from . import config
+    cfg = config.load()
+    p.add_argument("-n", "--np", type=int, default=cfg.nprocs or None,
                    help="number of ranks (default: number of local devices)")
     p.add_argument("--sim", type=int, default=None, metavar="N",
-                   help="simulate N XLA CPU devices (test mode)")
+                   help="simulate N XLA CPU devices (test mode); backend="
+                        "cpu-sim in the config applies this by default")
     p.add_argument("--procs", action="store_true",
                    help="one OS process per rank over the native transport "
                         "(multi-host deployment shape) instead of rank threads")
@@ -178,6 +191,8 @@ def main(argv: Optional[list[str]] = None) -> int:
                    help="arguments passed to the script")
     args = p.parse_args(argv)
 
+    if args.sim is None and config.load().backend == "cpu-sim":
+        args.sim = config.load().sim_devices
     if args.sim is not None:
         _force_sim_devices(args.sim)
         if args.np is None:

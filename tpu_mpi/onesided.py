@@ -30,7 +30,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from ._runtime import require_env, _DEADLOCK_TIMEOUT, _POLL
+from ._runtime import require_env, deadlock_timeout, _POLL
 from .buffers import DeviceBuffer, extract_array, element_count, write_flat
 from .comm import Comm
 from .datatypes import Get_address
@@ -63,13 +63,14 @@ class _RWLock:
         self.writer = False
 
     def acquire(self, ctx, exclusive: bool) -> None:
-        deadline = time.monotonic() + _DEADLOCK_TIMEOUT
+        limit = deadlock_timeout()
+        deadline = time.monotonic() + limit
         with self.cond:
             while self.writer or (exclusive and self.readers > 0):
                 ctx.check_failure()
                 if time.monotonic() > deadline:
                     raise DeadlockError("deadlock suspected: Win_lock blocked "
-                                        f">{_DEADLOCK_TIMEOUT}s")
+                                        f">{limit}s")
                 self.cond.wait(_POLL)
             if exclusive:
                 self.writer = True

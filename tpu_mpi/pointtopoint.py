@@ -382,10 +382,11 @@ def _poll_ready(reqs: Sequence[Request]) -> list[int]:
     """Spin (with failure checks) until ≥1 *active* request completes.
     Returns [] when no request is active; raises DeadlockError after the
     runtime's deadlock timeout like every other blocking wait."""
-    from ._runtime import _DEADLOCK_TIMEOUT
+    from ._runtime import deadlock_timeout
     from .error import DeadlockError
     ctx, _ = require_env()
-    deadline = time.monotonic() + _DEADLOCK_TIMEOUT
+    limit = deadlock_timeout()
+    deadline = time.monotonic() + limit
     while True:
         if not any(r.active for r in reqs):
             return []
@@ -395,7 +396,7 @@ def _poll_ready(reqs: Sequence[Request]) -> list[int]:
         ctx.check_failure()
         if time.monotonic() > deadline:
             raise DeadlockError(
-                f"deadlock suspected: blocked >{_DEADLOCK_TIMEOUT}s in Waitany/Waitsome")
+                f"deadlock suspected: blocked >{limit}s in Waitany/Waitsome")
         time.sleep(_POLL)
 
 
