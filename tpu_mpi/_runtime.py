@@ -244,7 +244,10 @@ class CollectiveChannel(_Waitable):
         self.opname: Optional[str] = None
 
     def run(self, rank: int, contrib: Any, combine: Callable[[list[Any]], Sequence[Any]],
-            opname: str) -> Any:
+            opname: str, plan=None) -> Any:
+        # ``plan`` (an algorithm hint for the multi-process tier) is ignored
+        # here: threads share an address space, so the combine-in-place star
+        # IS the optimal algorithm — data placement is a pointer exchange.
         with self.cond:
             # Wait for the previous round to fully drain before joining a new one.
             self._wait_for(

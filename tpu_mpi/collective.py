@@ -41,11 +41,12 @@ from .error import CollectiveMismatchError, MPIError
 from .operators import Op, as_op
 
 
-def _run(comm: Comm, contrib: Any, combine, opname: str) -> Any:
-    return comm.channel().run(comm.rank(), contrib, combine, opname)
+def _run(comm: Comm, contrib: Any, combine, opname: str, plan=None) -> Any:
+    return comm.channel().run(comm.rank(), contrib, combine, opname, plan=plan)
 
 
-def _run_rooted(comm: Comm, root: int, contrib: Any, combine, opname: str) -> Any:
+def _run_rooted(comm: Comm, root: int, contrib: Any, combine, opname: str,
+                plan=None) -> Any:
     """Rendezvous for rooted collectives: every rank ships its claimed root
     inside its contribution, and divergent roots raise CollectiveMismatchError
     on all ranks instead of silently electing whoever arrives first (the
@@ -63,7 +64,7 @@ def _run_rooted(comm: Comm, root: int, contrib: Any, combine, opname: str) -> An
                 f"ranks disagree on the root of {opname}: {roots}")
         return combine([c for _, c in cs], roots[0])
 
-    return _run(comm, (root, contrib), outer, opname)
+    return _run(comm, (root, contrib), outer, opname, plan=plan)
 
 
 _NOT_JITTABLE = object()
@@ -168,7 +169,8 @@ def _is_none(x: Any) -> bool:
 
 def Barrier(comm: Comm) -> None:
     """Block until every rank of comm arrives (src/collective.jl:15-19)."""
-    _run(comm, None, lambda cs: [None] * len(cs), f"Barrier@{comm.cid}")
+    _run(comm, None, lambda cs: [None] * len(cs), f"Barrier@{comm.cid}",
+         plan=("barrier",))
 
 
 # ---------------------------------------------------------------------------
@@ -193,7 +195,8 @@ def Bcast(buf: Any, *args) -> Any:
         val = cs[rt]
         return [val] * len(cs)
 
-    val = _run_rooted(comm, root, payload, combine, f"Bcast@{comm.cid}")
+    val = _run_rooted(comm, root, payload, combine, f"Bcast@{comm.cid}",
+                      plan=("bcast", root))
     if rank != root:
         write_flat(buf, val, n)
     return buf
@@ -218,7 +221,8 @@ def bcast(obj: Any, root: int, comm: Comm) -> Any:
         val = cs[rt]
         return [val] * len(cs)
 
-    kind, data = _run_rooted(comm, root, payload, combine, f"bcast@{comm.cid}")
+    kind, data = _run_rooted(comm, root, payload, combine, f"bcast@{comm.cid}",
+                             plan=("bcast", root))
     if rank == root:
         return obj
     return pickle.loads(data) if kind == "pickle" else data
@@ -605,7 +609,10 @@ def _reduce_family(args, has_root: bool, mode: str, name: str) -> Any:
     if has_root:
         result = _run_rooted(comm, root, payload, combine, f"{name}@{comm.cid}")
     else:
-        result = _run(comm, payload, combine, f"{name}@{comm.cid}")
+        # The multi-process tier runs large commutative Allreduce as a ring
+        # reduce-scatter + allgather; order-sensitive modes stay on the star.
+        plan = ("allreduce", op) if mode == "reduce" else None
+        result = _run(comm, payload, combine, f"{name}@{comm.cid}", plan=plan)
     i_get_result = (not has_root) or rank == root
     if mode == "exscan" and result is None:
         # rank 0's Exscan output is undefined (src/collective.jl:834-855);

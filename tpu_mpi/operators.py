@@ -34,15 +34,18 @@ def _is_jax(a: Any) -> bool:
 class Op:
     """A reduction operator: an elementwise binary function.
 
-    ``commutative`` matters only for documentation/assertions — the host path
-    always reduces in rank order (deterministic, and what Scan/Exscan require).
+    ``commutative`` gates re-associating algorithms (the multi-process ring
+    allreduce); the in-process host path always reduces in rank order
+    (deterministic, and what Scan/Exscan require). ``ufunc``, when set, is a
+    numpy ufunc equivalent used for in-place reduction on hot paths.
     """
 
     def __init__(self, fn: Callable[[Any, Any], Any], commutative: bool = False,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None, ufunc: Any = None):
         self.fn = fn
         self.commutative = commutative
         self.name = name or getattr(fn, "__name__", "custom")
+        self.ufunc = ufunc
 
     def __call__(self, a: Any, b: Any) -> Any:
         try:
@@ -117,16 +120,18 @@ def _no_op(a, b):
     return a
 
 
-SUM = Op(_sum, commutative=True, name="SUM")
-PROD = Op(_prod, commutative=True, name="PROD")
-MIN = Op(_min, commutative=True, name="MIN")
-MAX = Op(_max, commutative=True, name="MAX")
+import numpy as _np
+
+SUM = Op(_sum, commutative=True, name="SUM", ufunc=_np.add)
+PROD = Op(_prod, commutative=True, name="PROD", ufunc=_np.multiply)
+MIN = Op(_min, commutative=True, name="MIN", ufunc=_np.minimum)
+MAX = Op(_max, commutative=True, name="MAX", ufunc=_np.maximum)
 LAND = Op(_land, commutative=True, name="LAND")
 LOR = Op(_lor, commutative=True, name="LOR")
 LXOR = Op(_lxor, commutative=True, name="LXOR")
-BAND = Op(_band, commutative=True, name="BAND")
-BOR = Op(_bor, commutative=True, name="BOR")
-BXOR = Op(_bxor, commutative=True, name="BXOR")
+BAND = Op(_band, commutative=True, name="BAND", ufunc=_np.bitwise_and)
+BOR = Op(_bor, commutative=True, name="BOR", ufunc=_np.bitwise_or)
+BXOR = Op(_bxor, commutative=True, name="BXOR", ufunc=_np.bitwise_xor)
 REPLACE = Op(_replace, commutative=False, name="REPLACE")
 NO_OP = Op(_no_op, commutative=False, name="NO_OP")
 
