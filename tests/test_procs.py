@@ -126,16 +126,103 @@ def test_collective_mismatch_detected_across_processes():
     assert res.returncode == 3, (res.returncode, res.stderr)
 
 
-def test_onesided_rejected_in_proc_mode():
+def test_rma_across_processes():
+    # The reference's windows span real OS processes (test/test_onesided.jl
+    # under mpiexec); here the same fence/Put/Get/Accumulate/Fetch_and_op
+    # sequences run over the RMA wire engine.
     res = _run_procs("""
         import numpy as np
         import tpu_mpi as MPI
         MPI.Init()
-        try:
-            MPI.Win_create(np.zeros(4), MPI.COMM_WORLD)
-        except MPI.MPIError as e:
-            assert "multi-process" in str(e)
-            raise SystemExit(5)
-        raise SystemExit(0)
-    """, nprocs=2)
-    assert res.returncode == 5, (res.returncode, res.stderr)
+        comm = MPI.COMM_WORLD
+        rank, N = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+
+        # fence epoch: Get from the right neighbor
+        buf = np.full(N, rank, dtype=np.int64)
+        received = np.full(N, -1, dtype=np.int64)
+        win = MPI.Win_create(buf, comm)
+        MPI.Win_fence(0, win)
+        MPI.Get(received, (rank + 1) % N, win)
+        MPI.Win_fence(0, win)
+        assert np.all(received == (rank + 1) % N), received
+
+        # fence epoch: everyone Puts its rank into slot `rank` of rank 0
+        MPI.Put(np.array([rank], np.int64), 1, 0, rank, win)
+        MPI.Win_fence(0, win)
+        if rank == 0:
+            assert np.all(buf == np.arange(N)), buf
+        MPI.Win_fence(0, win)
+
+        # atomic Accumulate into rank 0 slot 0, then Fetch_and_op readback
+        MPI.Accumulate(np.array([1], np.int64), 1, 0, 0, MPI.SUM, win)
+        MPI.Win_fence(0, win)
+        got = np.array([-1], np.int64)
+        MPI.Fetch_and_op(np.array([0], np.int64), got, 0, 0, MPI.NO_OP, win)
+        assert got[0] == N, got
+        MPI.Win_fence(0, win)
+        win.free()
+        print(f"RMA-OK-{rank}")
+        MPI.Finalize()
+    """)
+    assert res.returncode == 0, res.stderr
+    for r in range(4):
+        assert f"RMA-OK-{r}" in res.stdout
+
+
+def test_rma_locks_shared_and_dynamic():
+    res = _run_procs("""
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank, N = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+
+        # passive target: read-modify-write rank 0's counter under LOCK_EXCLUSIVE
+        buf = np.zeros(1, dtype=np.int64)
+        win = MPI.Win_create(buf, comm)
+        MPI.Barrier(comm)
+        for _ in range(5):
+            MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 0, 0, win)
+            cur = np.zeros(1, np.int64)
+            MPI.Get(cur, 1, 0, 0, win)
+            MPI.Put(cur + 1, 1, 0, 0, win)
+            MPI.Win_unlock(0, win)
+        MPI.Barrier(comm)
+        if rank == 0:
+            assert buf[0] == 5 * N, buf
+        win.free()
+
+        # shared window: peers store directly into rank 0's POSIX shm slab
+        swin, local = MPI.Win_allocate_shared(np.float64, N, comm)
+        MPI.Barrier(comm)
+        nbytes, disp_unit, slab = MPI.Win_shared_query(swin, 0)
+        assert nbytes == N * 8 and disp_unit == 8
+        slab[rank] = float(rank * 10)
+        MPI.Barrier(comm)
+        if rank == 0:
+            assert np.all(np.asarray(slab) == np.arange(N) * 10.0), slab
+        MPI.Barrier(comm)
+        swin.free()
+
+        # dynamic window: rank 1 attaches, sends its address; rank 0 Puts
+        dwin = MPI.Win_create_dynamic(comm)
+        if rank == 1:
+            arr = np.zeros(4, np.float64)
+            MPI.Win_attach(dwin, arr)
+            MPI.Send(np.array([MPI.Get_address(arr)], np.int64), 0, 9, comm)
+            MPI.Win_fence(0, dwin)
+            assert np.all(arr == 7.0), arr
+        elif rank == 0:
+            addr = np.zeros(1, np.int64)
+            MPI.Recv(addr, 1, 9, comm)
+            MPI.Put(np.full(4, 7.0), 4, 1, int(addr[0]), dwin)
+            MPI.Win_fence(0, dwin)
+        else:
+            MPI.Win_fence(0, dwin)
+        dwin.free()
+        print(f"LOCK-OK-{rank}")
+        MPI.Finalize()
+    """)
+    assert res.returncode == 0, res.stderr
+    for r in range(4):
+        assert f"LOCK-OK-{r}" in res.stdout

@@ -1,0 +1,547 @@
+"""Cross-process one-sided RMA over the native transport.
+
+Reference: /root/reference/src/onesided.jl:24-219 — the reference's windows
+span real OS processes (libmpi's RMA engine moves the bytes) and its suite
+drives them under ``mpiexec -n N`` (test/test_onesided.jl:17-130). This module
+is the multi-process analog for the ``tpurun --procs`` tier: every window rank
+lives in its own process, and the OWNER of each window slice is its agent —
+origins ship Put/Get/Accumulate/lock frames to the owner, whose drainer
+thread applies them under the window's per-process atomic mutex (giving the
+element-wise atomicity MPI guarantees for accumulates, src/onesided.jl:186-219).
+
+Design rules:
+
+- The drainer must NEVER block (it is the only thread that can process the
+  frame that would unblock it). Passive-target lock grants are queued through
+  a callback lock manager (:class:`LockManager`) instead of awaited.
+- Completion (Win_flush / Win_fence / Win_unlock) rides the transport's
+  per-peer FIFO ordering: a flush ack is generated only after the owner has
+  applied every earlier frame from that origin, so one ack completes them all.
+- Shared windows (Win_allocate_shared / Win_shared_query,
+  src/onesided.jl:72-107) are real POSIX shared memory
+  (``multiprocessing.shared_memory``): a peer's slab maps into this process
+  and loads/stores hit it directly — the contract the reference gets from
+  MPI_Win_allocate_shared.
+"""
+
+from __future__ import annotations
+
+import itertools
+import pickle
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ._runtime import _POLL, deadlock_timeout, require_env
+from .buffers import (extract_array, resolve_attached, write_flat,
+                      write_range)
+from .error import DeadlockError, MPIError
+from . import operators as _ops
+
+# Predefined ops travel by name (pickling an Op loses singleton identity);
+# custom ops travel pickled and must therefore be module-level functions.
+_PREDEFINED: dict[str, _ops.Op] = {
+    v.name: v for v in vars(_ops).values() if isinstance(v, _ops.Op)
+}
+
+
+def _op_spec(op: _ops.Op) -> Any:
+    return op.name if _PREDEFINED.get(op.name) is op else op
+
+
+def _resolve_op(spec: Any) -> _ops.Op:
+    return _PREDEFINED[spec] if isinstance(spec, str) else spec
+
+
+_engine_init_lock = threading.Lock()
+
+
+def _engine(ctx) -> "RmaEngine":
+    eng = getattr(ctx, "_rma_engine", None)
+    if eng is None:
+        with _engine_init_lock:     # THREAD_MULTIPLE: one engine per ctx
+            eng = getattr(ctx, "_rma_engine", None)
+            if eng is None:
+                eng = ctx._rma_engine = RmaEngine(ctx)
+    return eng
+
+
+class LockManager:
+    """Owner-side passive-target lock queue (src/onesided.jl:138-148).
+
+    Grant callbacks fire synchronously from request()/release() — never from
+    a blocked wait — so the backend drainer can pump it safely. Origins are
+    identified by world rank; EXCLUSIVE excludes all, SHARED excludes writers.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._readers: set[int] = set()
+        self._writer: Optional[int] = None
+        self._queue: deque[tuple[int, bool, Callable[[], None]]] = deque()
+
+    def request(self, origin: int, exclusive: bool,
+                grant: Callable[[], None]) -> None:
+        with self._lock:
+            self._queue.append((origin, exclusive, grant))
+            ready = self._pump()
+        for g in ready:
+            g()
+
+    def release(self, origin: int, exclusive: bool) -> None:
+        with self._lock:
+            if exclusive and self._writer == origin:
+                self._writer = None
+            else:
+                self._readers.discard(origin)
+            ready = self._pump()
+        for g in ready:
+            g()
+
+    def _pump(self) -> list[Callable[[], None]]:
+        ready: list[Callable[[], None]] = []
+        while self._queue:
+            origin, exclusive, grant = self._queue[0]
+            if exclusive:
+                if self._writer is not None or self._readers:
+                    break
+                self._writer = origin
+            else:
+                if self._writer is not None:
+                    break
+                self._readers.add(origin)
+            self._queue.popleft()
+            ready.append(grant)
+        return ready
+
+
+class ProcWinState:
+    """This process's slice of a window spanning multiple processes.
+
+    ``metas[r]`` is rank r's exposure: (disp_unit, nbytes, shm_meta) where
+    shm_meta is (segment_name, length, dtype_str) for shared windows.
+    """
+
+    is_proc = True
+
+    def __init__(self, win_id: Any, group: tuple[int, ...], my_rank: int,
+                 dynamic: bool, metas: list):
+        self.win_id = win_id
+        self.group = tuple(group)           # comm rank -> world rank
+        self.size = len(group)
+        self.my_rank = my_rank              # this process's comm rank
+        self.dynamic = dynamic
+        self.metas = metas
+        self.freed = False
+        self.local: Optional[Any] = None    # locally exposed buffer
+        self.attached: list[tuple[int, int, Any]] = []   # dynamic windows
+        self.atomic_lock = threading.Lock()
+        self.lockmgr = LockManager()
+        self.lock = threading.Lock()        # origin-side bookkeeping
+        self.dirty: set[int] = set()        # world ranks with unacked ops
+        self._shm_own = None                # SharedMemory this rank created
+        self._shm_peers: dict[int, tuple[Any, np.ndarray]] = {}
+
+    # -- owner-side application (drainer thread or local fast path) ----------
+    def _local_view(self, disp: int, count: int):
+        """Resolve [disp, disp+count) of THIS process's exposed memory."""
+        if self.dynamic:
+            return resolve_attached(self.attached, disp, self.my_rank)
+        if self.local is None:
+            raise MPIError(f"rank {self.my_rank} exposes no memory in this "
+                           "window")
+        return self.local, extract_array(self.local), int(disp)
+
+    def apply_put(self, disp: int, arr: np.ndarray) -> None:
+        with self.atomic_lock:
+            buf, tarr, off = self._local_view(disp, arr.size)
+            write_range(buf, off, np.asarray(arr, tarr.dtype))
+
+    def apply_acc(self, disp: int, arr: np.ndarray, op: _ops.Op,
+                  fetch: bool) -> Optional[np.ndarray]:
+        count = int(arr.size)
+        with self.atomic_lock:
+            buf, tarr, off = self._local_view(disp, count)
+            flat = np.asarray(tarr).reshape(-1)
+            old = flat[off:off + count].copy()
+            if op.name == "REPLACE":
+                new = np.asarray(arr, dtype=old.dtype)
+            elif op.name == "NO_OP":
+                new = None
+            else:
+                new = np.asarray(op(old, np.asarray(arr, dtype=old.dtype)))
+            if new is not None:
+                write_range(buf, off, new)
+        return old if fetch else None
+
+    def read(self, disp: int, count: int) -> np.ndarray:
+        with self.atomic_lock:
+            buf, tarr, off = self._local_view(disp, count)
+            return np.asarray(tarr).reshape(-1)[off:off + int(count)].copy()
+
+
+class RmaEngine:
+    """Per-process RMA hub: window registry + request/response matching."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.cond = threading.Condition()
+        self.windows: dict[Any, ProcWinState] = {}
+        # Frames can outrun window registration (the create-collective's
+        # result reaches a fast origin before this process): stash + replay.
+        self._pending: dict[Any, list[tuple[int, Any]]] = {}
+        self._responses: dict[int, Any] = {}
+        self._req_counter = itertools.count(1)
+        self._req_lock = threading.Lock()
+
+    # -- plumbing ------------------------------------------------------------
+    def new_reqid(self) -> int:
+        with self._req_lock:
+            return self.ctx.local_rank + self.ctx.size * next(self._req_counter)
+
+    def send(self, world_dst: int, item: tuple) -> None:
+        from .backend import send_frame
+        try:
+            send_frame(self.ctx.transport, world_dst, ("rma",) + item)
+        except MPIError:
+            raise
+        except (pickle.PicklingError, AttributeError, TypeError) as e:
+            raise MPIError(
+                "RMA payload is not picklable (custom reduction ops must be "
+                f"module-level functions in multi-process mode): {e}") from None
+        except Exception as e:
+            # transport failure (peer died mid-epoch): fate-share like the
+            # collective send path so siblings abort instead of timing out
+            err = MPIError(f"RMA send to rank {world_dst} failed: "
+                           f"{type(e).__name__}: {e}")
+            self.ctx.fail(err)
+            raise err from None
+
+    def respond(self, origin: int, reqid: int, payload: Any) -> None:
+        self.send(origin, ("resp", reqid, payload))
+
+    def wait_resp(self, reqid: int, what: str) -> Any:
+        limit = deadlock_timeout()
+        deadline = time.monotonic() + limit
+        with self.cond:
+            while reqid not in self._responses:
+                self.ctx.check_failure()
+                if time.monotonic() > deadline:
+                    raise DeadlockError(
+                        f"deadlock suspected: {what} blocked >{limit}s")
+                self.cond.wait(_POLL)
+            return self._responses.pop(reqid)
+
+    def deliver_resp(self, reqid: int, payload: Any) -> None:
+        with self.cond:
+            self._responses[reqid] = payload
+            self.cond.notify_all()
+
+    def register(self, win_id: Any, st: ProcWinState) -> None:
+        """Publish a window and replay frames that beat the registration.
+        Replay holds the registry lock so a frame arriving concurrently from
+        the same origin cannot be applied out of FIFO order."""
+        with self.cond:
+            self.windows[win_id] = st
+            for src, item in self._pending.pop(win_id, ()):
+                self.apply(st, src, item)
+
+    def unregister(self, win_id: Any) -> None:
+        with self.cond:
+            self.windows.pop(win_id, None)
+
+    def window_or_stash(self, win_id: Any, src: int,
+                        item: Any) -> Optional[ProcWinState]:
+        with self.cond:
+            st = self.windows.get(win_id)
+            if st is None:
+                self._pending.setdefault(win_id, []).append((src, item))
+            return st
+
+    # -- owner-side frame application ----------------------------------------
+    def apply(self, st: ProcWinState, src: int, item: tuple) -> None:
+        kind = item[1]
+        if kind == "put":
+            _, _, _, disp, arr = item
+            st.apply_put(disp, np.asarray(arr))
+        elif kind == "acc":
+            _, _, _, disp, arr, opspec, reqid, origin = item
+            old = st.apply_acc(disp, np.asarray(arr), _resolve_op(opspec),
+                               fetch=reqid is not None)
+            if reqid is not None:
+                self.respond(origin, reqid, old)
+        elif kind == "get":
+            _, _, _, disp, count, reqid, origin = item
+            self.respond(origin, reqid, st.read(disp, count))
+        elif kind == "flush":
+            _, _, _, reqid, origin = item
+            self.respond(origin, reqid, None)   # FIFO: earlier frames applied
+        elif kind == "lock":
+            _, _, _, reqid, origin, excl = item
+            st.lockmgr.request(
+                origin, excl, lambda: self.respond(origin, reqid, None))
+        elif kind == "unlock":
+            _, _, _, reqid, origin, excl = item
+            st.lockmgr.release(origin, excl)
+            self.respond(origin, reqid, None)
+        else:
+            raise MPIError(f"unknown RMA frame kind {kind!r}")
+
+
+def dispatch_rma(ctx, src_world: int, item: tuple) -> None:
+    """Backend drainer entry point for ("rma", ...) frames."""
+    eng = _engine(ctx)
+    if item[1] == "resp":
+        _, _, reqid, payload = item
+        eng.deliver_resp(reqid, payload)
+        return
+    st = eng.window_or_stash(item[2], src_world, item)
+    if st is not None:
+        eng.apply(st, src_world, item)
+
+
+# ---------------------------------------------------------------------------
+# window creation (collective over the comm's ProcChannel)
+# ---------------------------------------------------------------------------
+
+def create_proc_window(comm, base: Optional[Any], disp_unit: Optional[int],
+                       opname: str, *, dynamic: bool = False,
+                       shm_meta: Optional[tuple] = None) -> ProcWinState:
+    """Collectively create a multi-process window: share every rank's
+    exposure metadata, mint a world-unique window id at the group's first
+    process, register locally, replay any frames that arrived early."""
+    ctx, _ = require_env()
+    eng = _engine(ctx)
+    my = comm.rank()
+    nbytes = None if base is None else int(extract_array(base).nbytes)
+    contrib = (disp_unit, nbytes, shm_meta)
+
+    def combine(cs):
+        # runs at the group's first process; its cid space is world-unique
+        wid = ("win", ctx.alloc_cid())
+        return [(wid, list(cs))] * len(cs)
+
+    win_id, metas = comm.channel().run(my, contrib, combine, opname)
+    st = ProcWinState(win_id, comm.group, my, dynamic, metas)
+    st.local = base
+    eng.register(win_id, st)
+    return st
+
+
+def create_proc_shared(comm, dtype: np.dtype, length: int,
+                       opname: str) -> tuple[ProcWinState, np.ndarray]:
+    """Win_allocate_shared across processes: each rank allocates a real POSIX
+    shared-memory slab; peers map it on Win_shared_query."""
+    from multiprocessing import shared_memory
+    nbytes = max(1, int(length) * dtype.itemsize)
+    shm = shared_memory.SharedMemory(create=True, size=nbytes)
+    local = np.ndarray((int(length),), dtype=dtype, buffer=shm.buf)
+    local[...] = 0
+    st = create_proc_window(comm, local, dtype.itemsize, opname,
+                            shm_meta=(shm.name, int(length), dtype.str))
+    st._shm_own = shm
+    return st, local
+
+
+def proc_shared_query(st: ProcWinState, owner_rank: int):
+    """(size_bytes, disp_unit, live array) of a peer's shared slab, mapped
+    into this process via its POSIX segment name (src/onesided.jl:97-107)."""
+    owner = int(owner_rank)
+    disp_unit, nbytes, shm_meta = st.metas[owner]
+    if owner == st.my_rank:
+        arr = extract_array(st.local)
+        return arr.size * arr.dtype.itemsize, disp_unit, st.local
+    if shm_meta is None:
+        raise MPIError(f"rank {owner} exposes no shared memory in this window")
+    with st.lock:                    # THREAD_MULTIPLE: attach each peer once
+        if owner not in st._shm_peers:
+            from multiprocessing import shared_memory
+            name, length, dtype_str = shm_meta
+            seg = shared_memory.SharedMemory(name=name)
+            arr = np.ndarray((length,), dtype=np.dtype(dtype_str),
+                             buffer=seg.buf)
+            st._shm_peers[owner] = (seg, arr)
+        seg, arr = st._shm_peers[owner]
+    return arr.size * arr.dtype.itemsize, disp_unit, arr
+
+
+# ---------------------------------------------------------------------------
+# origin-side data movement
+# ---------------------------------------------------------------------------
+
+def _target_world(st: ProcWinState, target_rank: int) -> int:
+    r = int(target_rank)
+    if not (0 <= r < st.size):       # no negative wrap: match the in-process
+        raise MPIError(              # error contract, not IndexError
+            f"rank {target_rank} exposes no memory in this window")
+    return st.group[r]
+
+
+def _origin_flat(origin: Any, count: int) -> np.ndarray:
+    """Validated flat origin view — invalid operands fail at the origin with
+    a clean MPIError, not in the owner's drainer (which would abort the job)."""
+    arr = extract_array(origin)
+    if arr is None:
+        raise MPIError(f"not an RMA origin buffer: {type(origin).__name__}")
+    return np.ascontiguousarray(np.asarray(arr).reshape(-1)[:int(count)])
+
+
+def rma_put(st: ProcWinState, origin: Any, count: int, target_rank: int,
+            disp: int) -> None:
+    ctx, _ = require_env()
+    src = _origin_flat(origin, count)
+    world = _target_world(st, target_rank)
+    if world == ctx.local_rank:
+        st.apply_put(disp, src)
+        return
+    with st.lock:
+        st.dirty.add(world)
+    _engine(ctx).send(world, ("put", st.win_id, int(disp), src))
+
+
+def rma_get(st: ProcWinState, origin: Any, count: int, target_rank: int,
+            disp: int) -> None:
+    ctx, _ = require_env()
+    world = _target_world(st, target_rank)
+    if world == ctx.local_rank:
+        data = st.read(disp, int(count))
+    else:
+        eng = _engine(ctx)
+        reqid = eng.new_reqid()
+        eng.send(world, ("get", st.win_id, int(disp), int(count), reqid,
+                         ctx.local_rank))
+        data = eng.wait_resp(reqid, "Get")
+    write_flat(origin, np.asarray(data), int(count))
+
+
+def rma_accumulate(st: ProcWinState, origin_flat: np.ndarray, target_rank: int,
+                   disp: int, op: _ops.Op,
+                   fetch_into: Optional[Any] = None) -> None:
+    ctx, _ = require_env()
+    src = np.ascontiguousarray(np.asarray(origin_flat).reshape(-1))
+    count = int(src.size)
+    world = _target_world(st, target_rank)
+    if world == ctx.local_rank:
+        old = st.apply_acc(disp, src, op, fetch=fetch_into is not None)
+        if fetch_into is not None:
+            write_flat(fetch_into, old, count)
+        return
+    eng = _engine(ctx)
+    if fetch_into is None:
+        with st.lock:
+            st.dirty.add(world)
+        eng.send(world, ("acc", st.win_id, int(disp), src, _op_spec(op),
+                         None, ctx.local_rank))
+    else:
+        reqid = eng.new_reqid()
+        eng.send(world, ("acc", st.win_id, int(disp), src, _op_spec(op),
+                         reqid, ctx.local_rank))
+        old = eng.wait_resp(reqid, "Get_accumulate")
+        write_flat(fetch_into, np.asarray(old), count)
+
+
+# ---------------------------------------------------------------------------
+# origin-side epochs
+# ---------------------------------------------------------------------------
+
+def _flush_targets(st: ProcWinState, worlds) -> None:
+    ctx, _ = require_env()
+    eng = _engine(ctx)
+    reqids = [eng.new_reqid() for _ in worlds]
+    for world, rid in zip(worlds, reqids):
+        eng.send(world, ("flush", st.win_id, rid, ctx.local_rank))
+    for rid in reqids:
+        eng.wait_resp(rid, "Win_flush")
+
+
+def proc_flush(st: ProcWinState, target_rank: int) -> None:
+    world = _target_world(st, target_rank)
+    with st.lock:
+        pending = world in st.dirty
+        st.dirty.discard(world)
+    if pending:
+        _flush_targets(st, [world])
+
+
+def proc_fence(win) -> None:
+    """All RMA issued before the fence completes everywhere: flush every
+    dirty target (FIFO ack ⇒ applied), then a dissemination barrier."""
+    st = win._state
+    with st.lock:
+        dirty = sorted(st.dirty)
+        st.dirty.clear()
+    if dirty:
+        _flush_targets(st, dirty)
+    comm = win.comm
+    comm.channel().run(comm.rank(), None, lambda cs: [None] * len(cs),
+                       f"Win_fence@{comm.cid}", plan=("barrier",))
+
+
+def proc_lock(st: ProcWinState, target_rank: int, exclusive: bool) -> None:
+    ctx, _ = require_env()
+    world = _target_world(st, target_rank)
+    if world == ctx.local_rank:
+        ev = threading.Event()
+        st.lockmgr.request(ctx.local_rank, exclusive, ev.set)
+        limit = deadlock_timeout()
+        deadline = time.monotonic() + limit
+        while not ev.wait(_POLL):
+            ctx.check_failure()
+            if time.monotonic() > deadline:
+                raise DeadlockError(
+                    f"deadlock suspected: Win_lock blocked >{limit}s")
+        return
+    eng = _engine(ctx)
+    reqid = eng.new_reqid()
+    eng.send(world, ("lock", st.win_id, reqid, ctx.local_rank, exclusive))
+    eng.wait_resp(reqid, "Win_lock")
+
+
+def proc_unlock(st: ProcWinState, target_rank: int, exclusive: bool) -> None:
+    """Win_unlock returns only once the epoch's ops completed at the target
+    (src/onesided.jl:145-148): the ack answers after all earlier frames."""
+    ctx, _ = require_env()
+    world = _target_world(st, target_rank)
+    if world == ctx.local_rank:
+        st.lockmgr.release(ctx.local_rank, exclusive)
+        return
+    eng = _engine(ctx)
+    reqid = eng.new_reqid()
+    eng.send(world, ("unlock", st.win_id, reqid, ctx.local_rank, exclusive))
+    eng.wait_resp(reqid, "Win_unlock")
+    with st.lock:
+        st.dirty.discard(world)
+
+
+def proc_free(win) -> None:
+    """Collective free: barrier (every rank stops issuing RMA), then tear
+    down local registration and shared-memory mappings."""
+    st = win._state
+    comm = win.comm
+    comm.channel().run(comm.rank(), None, lambda cs: [None] * len(cs),
+                       f"Win_free@{comm.cid}", plan=("barrier",))
+    ctx, _ = require_env()
+    _engine(ctx).unregister(st.win_id)
+    st.freed = True
+    for seg, _ in st._shm_peers.values():
+        try:
+            seg.close()
+        except Exception:
+            pass          # numpy views may still be exported (BufferError)
+    st._shm_peers.clear()
+    if st._shm_own is not None:
+        try:
+            # unlink first, in its own try: it needs no view release, and a
+            # BufferError from close() (live st.local export) must not leak
+            # the /dev/shm segment for the life of the job
+            st._shm_own.unlink()
+        except Exception:
+            pass
+        try:
+            st._shm_own.close()
+        except Exception:
+            pass
+        st._shm_own = None

@@ -14,8 +14,9 @@ per-communicator collective protocol. What changes is the rendezvous: the
 :class:`ProcChannel` gathers pickled contributions to the communicator's
 rank-0 process, runs ``combine`` there, and scatters per-rank results —
 the same "last arriver combines" contract, executed at a distinguished
-process. Shared-object features (one-sided windows, Comm_spawn) require a
-shared address space and raise in this mode.
+process. One-sided windows work across processes via the RMA wire engine
+(``tpu_mpi._rma_wire``): owners apply Put/Get/Accumulate/lock frames shipped
+by origins, and shared windows are real POSIX shared memory.
 
 Launch: ``tpurun -n N --procs script.py``. The launcher is the rendezvous
 server: children report their transport ports, receive the full address map,
@@ -518,6 +519,9 @@ class ProcContext(SpmdContext):
             _, cid, rnd, tag, src, opname, payload = item
             self._proc_channel(cid).deliver_alg(rnd, tuple(tag), src, opname,
                                                 payload)
+        elif kind == "rma":
+            from ._rma_wire import dispatch_rma
+            dispatch_rma(self, src_world, _unpack(item))
         elif kind == "abort":
             _, text = item
             with self._failure_lock:

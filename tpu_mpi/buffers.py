@@ -214,6 +214,39 @@ def write_flat(dest: Any, src: Any, count: Optional[int] = None) -> Any:
     raise MPIError(f"cannot write into {type(dest).__name__}")
 
 
+def write_range(buf: Any, off: int, new: np.ndarray) -> None:
+    """Write 1-d ``new`` into the flat element range [off, off+len(new)) of a
+    window-exposable buffer (the RMA write primitive: onesided.Put /
+    Accumulate and the multi-process owner apply path share it). DeviceBuffer
+    targets rebind the whole array; host arrays write in place."""
+    n = int(np.asarray(new).size)
+    if isinstance(buf, DeviceBuffer):
+        flat = buf.value.reshape(-1).at[off:off + n].set(
+            np.asarray(new, dtype=buf.value.dtype))
+        buf.value = flat.reshape(buf.value.shape)
+    else:
+        arr = extract_array(buf)
+        if arr is None:
+            raise MPIError(f"cannot write into {type(buf).__name__}")
+        # .flat is a logical C-order view regardless of strides — reshape(-1)
+        # on a non-contiguous view would copy and silently drop the write
+        np.asarray(arr).flat[off:off + n] = new
+
+
+def resolve_attached(attached, addr: int, who: str):
+    """Resolve a dynamic-window byte address against an attach list of
+    (base_addr, nbytes, buf) entries → (buf, array, element offset). Shared
+    by the in-process and multi-process dynamic-window paths
+    (src/onesided.jl:109-121 addressing contract)."""
+    addr = int(addr)
+    for (base_addr, nbytes, buf) in attached:
+        if base_addr <= addr < base_addr + nbytes:
+            arr = extract_array(buf)
+            off = (addr - base_addr) // arr.dtype.itemsize
+            return buf, arr, int(off)
+    raise MPIError(f"address {addr:#x} not attached on rank {who}")
+
+
 def clone_like(x: Any, value: Any) -> Any:
     """An operand of the same registry kind as x holding ``value``."""
     if isinstance(x, DeviceBuffer):

@@ -31,7 +31,8 @@ from typing import Any, Optional
 import numpy as np
 
 from ._runtime import require_env, deadlock_timeout, _POLL
-from .buffers import DeviceBuffer, extract_array, element_count, write_flat
+from .buffers import (DeviceBuffer, extract_array, element_count,
+                      resolve_attached, write_flat, write_range)
 from .comm import Comm
 from .datatypes import Get_address
 from .error import DeadlockError, MPIError
@@ -120,6 +121,10 @@ class Win:
         85-92): the shared state is only invalidated once every rank of the
         communicator has called free, so stragglers can still detach."""
         st = self._state
+        if getattr(st, "is_proc", False):
+            from ._rma_wire import proc_free
+            proc_free(self)
+            return
         with st._free_lock:
             st._free_count += 1
             if st._free_count >= st.size:
@@ -130,11 +135,15 @@ class Win:
         return f"<Win {kind} over comm of size {self._state.size}>"
 
 
+def _is_proc_mode(comm: Comm) -> bool:
+    """Multi-process worlds route RMA through the wire engine
+    (tpu_mpi._rma_wire): owners apply frames, shared memory is real POSIX
+    shm — the reference's windows likewise span OS processes via libmpi."""
+    return not getattr(comm.ctx, "supports_shared_objects", True)
+
+
 def _collective_state(comm: Comm, contrib, opname: str) -> Any:
     """One rendezvous that makes the last arriver build shared state."""
-    if not getattr(comm.ctx, "supports_shared_objects", True):
-        raise MPIError("one-sided RMA windows require a shared address space; "
-                       "not supported in multi-process mode (yet)")
 
     def combine(cs):
         st = _WinState(len(cs), dynamic=all(c is None for c in cs))
@@ -154,6 +163,11 @@ def Win_create(base: Any, comm: Comm, **infokws) -> Win:
     if arr is None:
         raise MPIError(f"not a window buffer: {type(base).__name__}")
     disp_unit = arr.dtype.itemsize
+    if _is_proc_mode(comm):
+        from ._rma_wire import create_proc_window
+        st = create_proc_window(comm, base, disp_unit,
+                                f"Win_create@{comm.cid}")
+        return Win(st, comm)
     st = _collective_state(comm, (base, disp_unit), f"Win_create@{comm.cid}")
     return Win(st, comm)
 
@@ -161,6 +175,11 @@ def Win_create(base: Any, comm: Comm, **infokws) -> Win:
 def Win_create_dynamic(comm: Comm, **infokws) -> Win:
     """Collectively create a window with no initial memory
     (src/onesided.jl:47-56); use :func:`Win_attach` to expose buffers."""
+    if _is_proc_mode(comm):
+        from ._rma_wire import create_proc_window
+        st = create_proc_window(comm, None, None,
+                                f"Win_create_dynamic@{comm.cid}", dynamic=True)
+        return Win(st, comm)
     st = _collective_state(comm, None, f"Win_create_dynamic@{comm.cid}")
     st.dynamic = True
     return Win(st, comm)
@@ -172,6 +191,11 @@ def Win_allocate_shared(T: Any, length: int, comm: Comm, **infokws):
     rank's slab via :func:`Win_shared_query`. Ranks share one address space
     here, so the owner's numpy array *is* the shared block."""
     dtype = np.dtype(T) if not hasattr(T, "np_dtype") else T.np_dtype
+    if _is_proc_mode(comm):
+        from ._rma_wire import create_proc_shared
+        st, local = create_proc_shared(comm, dtype, int(length),
+                                       f"Win_allocate_shared@{comm.cid}")
+        return Win(st, comm), local
     local = np.zeros(int(length), dtype=dtype)
     st = _collective_state(comm, (local, dtype.itemsize),
                            f"Win_allocate_shared@{comm.cid}")
@@ -183,6 +207,9 @@ def Win_shared_query(win: Win, owner_rank: int):
     (src/onesided.jl:97-107). The buffer is the live shared array — the
     pointer-free analog of the reference's baseptr."""
     win._check()
+    if getattr(win._state, "is_proc", False):
+        from ._rma_wire import proc_shared_query
+        return proc_shared_query(win._state, owner_rank)
     entry = win._state.buffers.get(int(owner_rank))
     if entry is None:
         raise MPIError(f"rank {owner_rank} exposes no memory in this window")
@@ -199,15 +226,21 @@ def Win_attach(win: Win, base: Any) -> None:
         raise MPIError("Win_attach requires a dynamic window")
     arr = extract_array(base)
     addr = Get_address(arr)
+    entry = (addr, arr.size * arr.dtype.itemsize, base)
+    if getattr(win._state, "is_proc", False):
+        win._state.attached.append(entry)      # local list; owner resolves
+        return
     rank = win.comm.rank()
-    win._state.attached[rank].append((addr, arr.size * arr.dtype.itemsize, base))
+    win._state.attached[rank].append(entry)
 
 
 def Win_detach(win: Win, base: Any) -> None:
     """Remove an attached buffer (src/onesided.jl:116-121)."""
     win._check()
-    rank = win.comm.rank()
-    lst = win._state.attached[rank]
+    if getattr(win._state, "is_proc", False):
+        lst = win._state.attached
+    else:
+        lst = win._state.attached[win.comm.rank()]
     for i, (_, _, b) in enumerate(lst):
         if b is base:
             del lst[i]
@@ -222,16 +255,25 @@ def Win_detach(win: Win, base: Any) -> None:
 def Win_fence(assert_: int, win: Win) -> None:
     """Collective epoch separator (src/onesided.jl:123-126): all RMA issued
     before the fence completes at every rank — a rendezvous barrier here,
-    since Put/Get complete synchronously in shared memory."""
+    since Put/Get complete synchronously in shared memory; multi-process
+    windows first flush every dirty target over the wire."""
     win._check()
+    if getattr(win._state, "is_proc", False):
+        from ._rma_wire import proc_fence
+        proc_fence(win)
+        return
     win.comm.channel().run(win.comm.rank(), None, lambda cs: [None] * len(cs),
                            f"Win_fence@{win.comm.cid}")
 
 
 def Win_flush(rank: int, win: Win) -> None:
     """Complete outstanding RMA to ``rank`` (src/onesided.jl:128-131).
-    Synchronous ops ⇒ ordering is already guaranteed; kept for API parity."""
+    Synchronous in shared memory; multi-process windows await the owner's
+    FIFO ack, which completes every earlier op from this origin."""
     win._check()
+    if getattr(win._state, "is_proc", False):
+        from ._rma_wire import proc_flush
+        proc_flush(win._state, rank)
 
 
 def Win_sync(win: Win) -> None:
@@ -246,7 +288,11 @@ def Win_lock(lock_type: LockType, rank: int, assert_: int, win: Win) -> None:
     win._check()
     ctx, _ = require_env()
     excl = lock_type is LOCK_EXCLUSIVE or lock_type.val == LOCK_EXCLUSIVE.val
-    win._state.user_locks[int(rank)].acquire(ctx, excl)
+    if getattr(win._state, "is_proc", False):
+        from ._rma_wire import proc_lock
+        proc_lock(win._state, int(rank), excl)
+    else:
+        win._state.user_locks[int(rank)].acquire(ctx, excl)
     win._held.append((int(rank), excl))
 
 
@@ -257,7 +303,11 @@ def Win_unlock(rank: int, win: Win) -> None:
     for i in range(len(win._held) - 1, -1, -1):
         if win._held[i][0] == rank:
             _, excl = win._held.pop(i)
-            win._state.user_locks[rank].release(excl)
+            if getattr(win._state, "is_proc", False):
+                from ._rma_wire import proc_unlock
+                proc_unlock(win._state, rank, excl)
+            else:
+                win._state.user_locks[rank].release(excl)
             return
     raise MPIError(f"Win_unlock: no lock held on rank {rank}")
 
@@ -273,13 +323,8 @@ def _target_view(win: Win, target_rank: int, target_disp: int, count: int):
     st = win._state
     target_rank = int(target_rank)
     if st.dynamic:
-        addr = int(target_disp)
-        for (base_addr, nbytes, buf) in st.attached[target_rank]:
-            if base_addr <= addr < base_addr + nbytes:
-                arr = extract_array(buf)
-                off = (addr - base_addr) // arr.dtype.itemsize
-                return buf, arr, int(off)
-        raise MPIError(f"address {addr:#x} not attached on rank {target_rank}")
+        return resolve_attached(st.attached[target_rank], target_disp,
+                                target_rank)
     if target_rank not in st.buffers:
         raise MPIError(f"rank {target_rank} exposes no memory in this window")
     buf, _ = st.buffers[target_rank]
@@ -304,6 +349,10 @@ def Get(origin: Any, *args) -> None:
     else:
         raise TypeError("Get(origin, [count, rank, disp,] win)")
     win._check()
+    if getattr(win._state, "is_proc", False):
+        from ._rma_wire import rma_get
+        rma_get(win._state, origin, int(count), target_rank, target_disp)
+        return
     buf, tarr, off = _target_view(win, target_rank, target_disp, count)
     data = np.asarray(tarr).reshape(-1)[off:off + count]
     write_flat(origin, data, int(count))
@@ -321,14 +370,21 @@ def Put(origin: Any, *args) -> None:
         raise TypeError("Put(origin, [count, rank, disp,] win)")
     win._check()
     count = int(count)
+    if getattr(win._state, "is_proc", False):
+        from ._rma_wire import rma_put
+        rma_put(win._state, origin, count, target_rank, target_disp)
+        return
     buf, tarr, off = _target_view(win, target_rank, target_disp, count)
     src = _origin_array(origin).reshape(-1)[:count]
+    new = np.asarray(src, dtype=tarr.dtype)
     if isinstance(buf, DeviceBuffer):
-        flat = buf.value.reshape(-1).at[off:off + count].set(
-            np.asarray(src, dtype=buf.value.dtype))
-        buf.value = flat.reshape(buf.value.shape)
+        # DeviceBuffer writes rebind the whole array: concurrent Puts into
+        # DISTINCT slots of one target (legal in a fence epoch) would lose
+        # updates without serialization under the per-target mutex.
+        with win._state.atomic_locks[int(target_rank)]:
+            write_range(buf, off, new)
     else:
-        np.asarray(tarr).reshape(-1)[off:off + count] = np.asarray(src)
+        write_range(buf, off, new)   # host byte-writes to distinct slots
 
 
 def _apply_op(win: Win, target_rank: int, target_disp: int, origin_flat, op: Op,
@@ -336,6 +392,11 @@ def _apply_op(win: Win, target_rank: int, target_disp: int, origin_flat, op: Op,
     """op-combine origin into the target range under the per-target atomic
     mutex; optionally snapshot the old values first (Get_accumulate)."""
     st = win._state
+    if getattr(st, "is_proc", False):
+        from ._rma_wire import rma_accumulate
+        rma_accumulate(st, origin_flat, target_rank, target_disp, op,
+                       fetch_into=fetch_into)
+        return
     count = int(np.asarray(origin_flat).size)
     with st.atomic_locks[int(target_rank)]:
         buf, tarr, off = _target_view(win, target_rank, target_disp, count)
@@ -350,11 +411,7 @@ def _apply_op(win: Win, target_rank: int, target_disp: int, origin_flat, op: Op,
         else:
             new = np.asarray(op(old, np.asarray(origin_flat, dtype=old.dtype)))
         if new is not None:
-            if isinstance(buf, DeviceBuffer):
-                fb = buf.value.reshape(-1).at[off:off + count].set(new)
-                buf.value = fb.reshape(buf.value.shape)
-            else:
-                flat[off:off + count] = new
+            write_range(buf, off, new)
 
 
 def Accumulate(origin: Any, count: int, target_rank: int, target_disp: int,
