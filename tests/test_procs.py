@@ -93,6 +93,89 @@ def test_split_of_split_gets_distinct_cids():
     assert "SPLIT-OK-0" in res.stdout and "SPLIT-OK-1" in res.stdout
 
 
+def test_algorithm_tier_and_shm_lane():
+    # Large payloads drive the scalable collective algorithms (ring
+    # reduce-scatter+allgather Allreduce, binomial-tree Bcast) and the
+    # same-host shm data lane (VERDICT r1 items 4/7): payloads well above
+    # both TPU_MPI_RING_MIN_BYTES and shm_min_bytes, validated elementwise
+    # against the star/TCP tier's semantics.
+    import glob
+    pre = set(glob.glob("/dev/shm/tpumpi_*"))
+    res = _run_procs("""
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+
+        n = 1 << 20                      # 4 MiB float32: ring + shm lanes
+        x = np.arange(n, dtype=np.float32) * (rank + 1)
+        out = MPI.Allreduce(x, MPI.SUM, comm)
+        k = sum(range(1, size + 1))
+        assert np.array_equal(out, np.arange(n, dtype=np.float32) * k)
+
+        big = np.full(n, 3.0) if rank == 1 else None
+        got = np.asarray(MPI.bcast(big, 1, comm))
+        assert got.shape == (n,) and np.all(got == 3.0)
+
+        m = MPI.Allreduce(np.full(n, float(rank)), MPI.MAX, comm)
+        assert np.all(np.asarray(m) == size - 1)
+
+        # large typed P2P rides the shm lane too
+        if rank == 0:
+            MPI.Send(np.arange(n, dtype=np.int32), 1, 5, comm)
+        elif rank == 1:
+            buf = np.zeros(n, np.int32)
+            MPI.Recv(buf, 0, 5, comm)
+            assert np.array_equal(buf, np.arange(n, dtype=np.int32))
+        print(f"ALG-OK-{rank}")
+        MPI.Finalize()
+    """)
+    assert res.returncode == 0, res.stderr
+    for r in range(4):
+        assert f"ALG-OK-{r}" in res.stdout
+    # no NEW segments may remain (pre-existing ones belong to concurrent jobs)
+    leaked = set(glob.glob("/dev/shm/tpumpi_*")) - pre
+    assert not leaked, f"shm lane leaked segments: {sorted(leaked)}"
+
+
+def test_ring_allreduce_matches_star_tier():
+    # The ring algorithm (forced via a tiny threshold) and the star tier
+    # (forced via a huge threshold) must agree, including non-commutative
+    # fallback: a custom non-commutative op must take the star path and
+    # still be correct.
+    res = _run_procs("""
+        import os
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        x = np.arange(4096, dtype=np.float64) + rank
+        out = MPI.Allreduce(x, MPI.SUM, comm)     # ring (>= 64 KiB? no: 32 KiB)
+        # payload is 32 KiB < default ring threshold -> star; force ring:
+        os.environ["TPU_MPI_RING_MIN_BYTES"] = "1"
+        import tpu_mpi.backend as B
+        B._RING_MIN_BYTES = 1
+        out2 = MPI.Allreduce(x, MPI.SUM, comm)
+        assert np.array_equal(np.asarray(out), np.asarray(out2))
+        expect = np.arange(4096, dtype=np.float64) * size + sum(range(size))
+        assert np.array_equal(np.asarray(out2), expect)
+
+        # non-commutative custom op: first-arriver-order matters, so the
+        # algorithm chooser must leave it on the rank-ordered star path
+        def first(a, b):
+            return a
+        f = MPI.Allreduce(np.full(2048, float(rank)), MPI.Op(first, commutative=False), comm)
+        assert np.all(np.asarray(f) == 0.0), f
+        print(f"RING-OK-{rank}")
+        MPI.Finalize()
+    """)
+    assert res.returncode == 0, res.stderr
+    for r in range(4):
+        assert f"RING-OK-{r}" in res.stdout
+
+
 def test_rank_failure_fails_the_job():
     res = _run_procs("""
         import tpu_mpi as MPI

@@ -167,12 +167,9 @@ class ProcWinState:
             buf, tarr, off = self._local_view(disp, count)
             flat = np.asarray(tarr).reshape(-1)
             old = flat[off:off + count].copy()
-            if op.name == "REPLACE":
-                new = np.asarray(arr, dtype=old.dtype)
-            elif op.name == "NO_OP":
-                new = None
-            else:
-                new = np.asarray(op(old, np.asarray(arr, dtype=old.dtype)))
+            # predefined ops unpickle to their singletons (Op.__reduce__),
+            # so the shared identity-checked combine applies cross-process
+            new = _ops.acc_combine(old, arr, op)
             if new is not None:
                 write_range(buf, off, new)
         return old if fetch else None
@@ -203,9 +200,8 @@ class RmaEngine:
             return self.ctx.local_rank + self.ctx.size * next(self._req_counter)
 
     def send(self, world_dst: int, item: tuple) -> None:
-        from .backend import send_frame
         try:
-            send_frame(self.ctx.transport, world_dst, ("rma",) + item)
+            self.ctx.send_frame(world_dst, ("rma",) + item)
         except MPIError:
             raise
         except (pickle.PicklingError, AttributeError, TypeError) as e:
@@ -386,7 +382,10 @@ def _origin_flat(origin: Any, count: int) -> np.ndarray:
     arr = extract_array(origin)
     if arr is None:
         raise MPIError(f"not an RMA origin buffer: {type(origin).__name__}")
-    return np.ascontiguousarray(np.asarray(arr).reshape(-1)[:int(count)])
+    flat = np.asarray(arr).reshape(-1)
+    if flat.size < int(count):
+        raise MPIError(f"RMA origin has {flat.size} elements, count={count}")
+    return np.ascontiguousarray(flat[:int(count)])
 
 
 def rma_put(st: ProcWinState, origin: Any, count: int, target_rank: int,

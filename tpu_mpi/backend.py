@@ -51,29 +51,127 @@ _RING_MIN_BYTES = int(os.environ.get("TPU_MPI_RING_MIN_BYTES", str(64 * 1024)))
 # ---------------------------------------------------------------------------
 # Zero-copy wire encoding: pickle protocol 5 with out-of-band buffers.
 # A frame is [magic][nbufs u32][skel_len u64][skeleton pickle]
-# [len u64 + raw bytes]*. Array payloads (numpy, and jax via _JaxLeaf) travel
-# as raw buffer bytes — no pickle byte-copy — and decode as zero-copy views
-# into the received frame (the reference gets this from libmpi's typed
-# transport; VERDICT r1 weak item 7).
+# [flag u8 + len u64 + body]*. Array payloads (numpy, and jax via _JaxLeaf)
+# travel out of band — no pickle byte-copy — by one of two lanes per buffer:
+#
+# - flag 0 (inline): raw buffer bytes in the TCP stream, decoded as zero-copy
+#   views into the received frame (the reference gets this from libmpi's
+#   typed transport; VERDICT r1 weak item 7);
+# - flag 1 (shm): for large buffers bound for a SAME-HOST rank, the body is
+#   just the name of a one-shot POSIX shm segment holding the bytes — the
+#   libmpi shared-memory-BTL analog. The sender writes the segment (tmpfs:
+#   one memcpy), the receiver maps it, unlinks it immediately (the mapping
+#   keeps it alive) and decodes arrays as views straight into the mapping, so
+#   the payload never crosses a socket and is copied exactly once end to end.
+#   The launcher sweeps any segments orphaned by a crashed rank.
 # ---------------------------------------------------------------------------
 
-_OOB_MAGIC = b"\x01TMB5"
+_OOB_MAGIC = b"\x01TMB6"
 _STAR = object()     # "no algorithm applies; use the generic star rendezvous"
 
+_SHM_DIR = "/dev/shm"
+_shm_counter = itertools.count()
 
-def dumps_oob_parts(item: Any) -> list:
+
+_shm_min_cached: Optional[int] = None
+
+
+def _shm_min_bytes() -> int:
+    """Payload threshold for the shm lane; 0 (or a missing /dev/shm)
+    disables. Resolved once — this sits on the per-message send path, and
+    neither the config nor /dev/shm's existence changes mid-job."""
+    global _shm_min_cached
+    if _shm_min_cached is None:
+        _shm_min_cached = (config.load().shm_min_bytes
+                           if os.path.isdir(_SHM_DIR) else 0)
+    return _shm_min_cached
+
+
+def shm_job_tag() -> str:
+    """Per-job namespace for shm segment names (the coordinator port is
+    shared by every rank of a job and by the launcher, which sweeps
+    ``tpumpi_<tag>_*`` leftovers after the job ends)."""
+    coord = os.environ.get("TPU_MPI_PROC_COORD", "")
+    return coord.rsplit(":", 1)[-1] or "local"
+
+
+def _shm_spill(mv: memoryview) -> bytes:
+    """Write a buffer into a fresh one-shot shm segment; return its name."""
+    name = f"tpumpi_{shm_job_tag()}_{os.getpid()}_{next(_shm_counter)}"
+    path = os.path.join(_SHM_DIR, name)
+    fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o600)
+    try:
+        view = mv.cast("B")
+        off = 0
+        while off < view.nbytes:
+            off += os.write(fd, view[off:])
+    except BaseException:
+        os.close(fd)
+        try:                       # don't leave a partial segment pinning RAM
+            os.unlink(path)
+        except OSError:
+            pass
+        raise
+    os.close(fd)
+    return name.encode()
+
+
+def sweep_segments(tag: str, only_dead_creators: bool = False) -> None:
+    """Unlink shm-lane segments for a job tag. The launcher calls this after
+    every child has exited (a clean run leaves nothing — receivers unlink at
+    load time); ranks launched by an external scheduler call it with
+    ``only_dead_creators=True`` at attach, reclaiming segments whose creating
+    process (the pid embedded in the name) is gone."""
+    import glob
+    for seg in glob.glob(os.path.join(_SHM_DIR, f"tpumpi_{tag}_*")):
+        if only_dead_creators:
+            try:
+                pid = int(os.path.basename(seg).split("_")[2])
+            except (IndexError, ValueError):
+                continue
+            if os.path.exists(f"/proc/{pid}"):
+                continue
+        try:
+            os.unlink(seg)
+        except OSError:
+            pass
+
+
+def _shm_load(name: str) -> memoryview:
+    """Map a one-shot segment and unlink it; the returned view (and any
+    arrays decoded over it) keeps the mapping alive until GC."""
+    import mmap as _mmap
+    path = os.path.join(_SHM_DIR, name)
+    fd = os.open(path, os.O_RDWR)
+    try:
+        os.unlink(path)
+        size = os.fstat(fd).st_size
+        m = _mmap.mmap(fd, size)
+    finally:
+        os.close(fd)
+    return memoryview(m)
+
+
+def dumps_oob_parts(item: Any, shm_ok: bool = False) -> list:
     """Encode as a list of wire segments (header/skeleton bytes + raw array
     buffers). Sent with ``transport.sendv`` so array payloads go from their
-    own memory straight to the socket — no join copy."""
+    own memory straight to the socket — no join copy. With ``shm_ok`` (the
+    destination shares this host), large buffers take the shm lane instead."""
     bufs: list[pickle.PickleBuffer] = []
     skel = pickle.dumps(item, protocol=5, buffer_callback=bufs.append)
     parts = [_OOB_MAGIC + struct.pack("<IQ", len(bufs), len(skel)), skel]
+    shm_min = _shm_min_bytes() if shm_ok else 0
     for pb in bufs:
         mv = pb.raw()
         if not mv.c_contiguous:
             mv = memoryview(bytes(mv))
-        parts.append(struct.pack("<Q", mv.nbytes))
-        parts.append(mv.cast("B"))
+        if shm_min and mv.nbytes >= shm_min:
+            name = _shm_spill(mv)
+            parts.append(struct.pack("<BQ", 1, len(name)))
+            parts.append(name)
+        else:
+            parts.append(struct.pack("<BQ", 0, mv.nbytes))
+            parts.append(mv.cast("B"))
     return parts
 
 
@@ -81,9 +179,10 @@ def dumps_oob(item: Any) -> bytes:
     return b"".join(dumps_oob_parts(item))
 
 
-def send_frame(transport, world_dst: int, item: Any) -> None:
+def send_frame(transport, world_dst: int, item: Any,
+               shm_ok: bool = False) -> None:
     """Encode + send a protocol frame with scatter-gather zero-copy."""
-    transport.sendv(world_dst, dumps_oob_parts(item))
+    transport.sendv(world_dst, dumps_oob_parts(item, shm_ok=shm_ok))
 
 
 def loads_oob(frame: bytes) -> Any:
@@ -97,9 +196,12 @@ def loads_oob(frame: bytes) -> Any:
     off += skel_len
     bufs = []
     for _ in range(nbufs):
-        (ln,) = struct.unpack_from("<Q", frame, off)
-        off += 8
-        bufs.append(mv[off:off + ln])
+        flag, ln = struct.unpack_from("<BQ", frame, off)
+        off += 9
+        if flag == 1:
+            bufs.append(_shm_load(bytes(mv[off:off + ln]).decode()))
+        else:
+            bufs.append(mv[off:off + ln])
         off += ln
     return pickle.loads(skel, buffers=bufs)
 
@@ -155,9 +257,10 @@ class _RemoteMailbox:
             raise MPIError(
                 "cannot send an unpicklable object to another process; "
                 "multi-process ranks do not share an address space")
-        send_frame(self.ctx.transport, self.world_rank,
-                   ("p2p", msg.src, msg.tag, msg.cid, _pack(msg.payload),
-                    msg.count, msg.dtype, msg.kind))
+        self.ctx.send_frame(self.world_rank,
+                            ("p2p", msg.src, msg.tag, msg.cid,
+                             _pack(msg.payload), msg.count, msg.dtype,
+                             msg.kind))
 
     def notify(self) -> None:  # failure broadcast reaches processes via abort
         pass
@@ -232,8 +335,8 @@ class ProcChannel(_Waitable):
     # -- algorithm tier -------------------------------------------------------
     def _send_alg(self, world_dst: int, rnd: int, tag: tuple, rank: int,
                   opname: str, payload: Any) -> None:
-        send_frame(self.ctx.transport, world_dst,
-                   ("alg", self.cid, rnd, tag, rank, opname, _pack(payload)))
+        self.ctx.send_frame(world_dst, ("alg", self.cid, rnd, tag, rank,
+                                        opname, _pack(payload)))
 
     def _wait_alg(self, rnd: int, tag: tuple, opname: str) -> Any:
         key = ("alg", rnd) + tag
@@ -442,7 +545,13 @@ class ProcChannel(_Waitable):
         PicklingError mid-protocol (the p2p proxy already guards its
         equivalent case)."""
         try:
-            parts = dumps_oob_parts(item)
+            parts = dumps_oob_parts(item, shm_ok=self.ctx.shm_ok(world_dst))
+        except OSError as e:
+            err = MPIError(
+                f"collective {opname} could not stage its payload in the shm "
+                f"lane (/dev/shm full or unwritable?): {e}")
+            self.ctx.fail(err)
+            raise err from None
         except Exception as e:
             err = MPIError(
                 f"collective {opname} payload is not picklable and "
@@ -462,10 +571,15 @@ class ProcContext(SpmdContext):
     """
 
     def __init__(self, local_rank: int, size: int, transport,
-                 universe_size: Optional[int] = None):
+                 universe_size: Optional[int] = None,
+                 same_host: Optional[Sequence[bool]] = None):
         super().__init__(size, universe_size=universe_size)
         self.local_rank = local_rank
         self.transport = transport
+        # which peers share this host (shm lane eligibility); default: all,
+        # the single-launcher `tpurun --procs` shape.
+        self._same_host = tuple(same_host) if same_host is not None \
+            else (True,) * size
         self._cid_counter = itertools.count(0)
         self.mailboxes = [
             Mailbox(self) if r == local_rank else _RemoteMailbox(self, r)
@@ -475,6 +589,16 @@ class ProcContext(SpmdContext):
                                          name="tpu-mpi-drainer")
         self._drainer_stop = threading.Event()
         self._drainer.start()
+
+    # -- frame transmit -------------------------------------------------------
+    def shm_ok(self, world_dst: int) -> bool:
+        """Whether the shm lane may carry payloads to this peer."""
+        return (0 <= world_dst < len(self._same_host)
+                and self._same_host[world_dst])
+
+    def send_frame(self, world_dst: int, item: Any) -> None:
+        send_frame(self.transport, world_dst, item,
+                   shm_ok=self.shm_ok(world_dst))
 
     # -- frame pump -----------------------------------------------------------
     def _drain(self) -> None:
@@ -633,7 +757,12 @@ def proc_attach() -> tuple[ProcContext, int]:
     if isinstance(addrs, dict) and "error" in addrs:
         raise MPIError(f"rendezvous failed: {addrs['error']}")
     transport.set_peers(addrs)
-    ctx = ProcContext(rank, size, transport)
+    my_host = addrs[rank].rsplit(":", 1)[0]
+    same_host = [a.rsplit(":", 1)[0] == my_host for a in addrs]
+    # Scheduler-launched jobs have no tpurun parent to sweep crashed ranks'
+    # shm segments; reclaim any whose creating process is gone.
+    sweep_segments(shm_job_tag(), only_dead_creators=True)
+    ctx = ProcContext(rank, size, transport, same_host=same_host)
     set_env((ctx, rank))
     # Deterministic teardown: stop the drainer + native progress thread at
     # interpreter exit rather than relying on GC-order __del__.

@@ -49,6 +49,10 @@ class Config:
     rendezvous_timeout: float = 600.0
     # max native-transport frame size (corrupt-stream guard), bytes.
     max_frame_bytes: int = 1 << 31
+    # multi-process tier: array payloads at least this large travel between
+    # same-host ranks through one-shot POSIX shm segments instead of the TCP
+    # stream (the libmpi shared-memory-BTL analog); 0 disables the shm lane.
+    shm_min_bytes: int = 1 << 18
 
     def replace(self, **kw: Any) -> "Config":
         d = {f.name: getattr(self, f.name) for f in fields(self)}
@@ -65,6 +69,7 @@ _ENV_MAP = {
     "deadlock_timeout": "TPU_MPI_DEADLOCK_TIMEOUT",
     "rendezvous_timeout": "TPU_MPI_RENDEZVOUS_TIMEOUT",
     "max_frame_bytes": "TPU_MPI_MAX_FRAME_BYTES",
+    "shm_min_bytes": "TPU_MPI_SHM_MIN_BYTES",
 }
 
 _lock = threading.Lock()

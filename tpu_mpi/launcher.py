@@ -141,6 +141,19 @@ def launch_processes(path: str, nprocs: int,
         for p in procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
+        # Sweep shm-lane segments orphaned by a crashed/killed rank — but
+        # only once every child is really gone, or a rank still mid-spill
+        # would recreate segments after the sweep (a clean run unlinks every
+        # segment at receive time; see backend._shm_load).
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+        from .backend import sweep_segments
+        sweep_segments(str(coord.port))
 
 
 def install_tpurun(command: str = "tpurun",

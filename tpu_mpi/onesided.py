@@ -36,7 +36,7 @@ from .buffers import (DeviceBuffer, extract_array, element_count,
 from .comm import Comm
 from .datatypes import Get_address
 from .error import DeadlockError, MPIError
-from .operators import Op, REPLACE, NO_OP, as_op
+from .operators import Op, REPLACE, NO_OP, acc_combine, as_op
 
 
 class LockType:
@@ -375,8 +375,10 @@ def Put(origin: Any, *args) -> None:
         rma_put(win._state, origin, count, target_rank, target_disp)
         return
     buf, tarr, off = _target_view(win, target_rank, target_disp, count)
-    src = _origin_array(origin).reshape(-1)[:count]
-    new = np.asarray(src, dtype=tarr.dtype)
+    src = _origin_array(origin).reshape(-1)
+    if src.size < count:
+        raise MPIError(f"Put origin has {src.size} elements, count={count}")
+    new = np.asarray(src[:count], dtype=tarr.dtype)
     if isinstance(buf, DeviceBuffer):
         # DeviceBuffer writes rebind the whole array: concurrent Puts into
         # DISTINCT slots of one target (legal in a fence epoch) would lose
@@ -404,12 +406,7 @@ def _apply_op(win: Win, target_rank: int, target_disp: int, origin_flat, op: Op,
         old = flat[off:off + count].copy()
         if fetch_into is not None:
             write_flat(fetch_into, old, count)
-        if op is REPLACE:
-            new = np.asarray(origin_flat, dtype=old.dtype)
-        elif op is NO_OP:
-            new = None
-        else:
-            new = np.asarray(op(old, np.asarray(origin_flat, dtype=old.dtype)))
+        new = acc_combine(old, origin_flat, op)
         if new is not None:
             write_range(buf, off, new)
 

@@ -65,6 +65,14 @@ class Op:
     def __repr__(self) -> str:
         return f"<Op {self.name}>"
 
+    def __reduce__(self):
+        # Predefined ops unpickle to their canonical singletons, so identity
+        # checks (``op is REPLACE``) hold across process boundaries (the RMA
+        # wire engine and cross-process collectives ship ops by pickle).
+        if _PREDEFINED.get(self.name) is self:
+            return (_predefined_op, (self.name,))
+        return (Op, (self.fn, self.commutative, self.name, self.ufunc))
+
 
 def _sum(a, b):
     return a + b
@@ -134,6 +142,26 @@ BOR = Op(_bor, commutative=True, name="BOR", ufunc=_np.bitwise_or)
 BXOR = Op(_bxor, commutative=True, name="BXOR", ufunc=_np.bitwise_xor)
 REPLACE = Op(_replace, commutative=False, name="REPLACE")
 NO_OP = Op(_no_op, commutative=False, name="NO_OP")
+
+_PREDEFINED = {op.name: op for op in (SUM, PROD, MIN, MAX, LAND, LOR, LXOR,
+                                      BAND, BOR, BXOR, REPLACE, NO_OP)}
+
+
+def _predefined_op(name: str) -> Op:
+    return _PREDEFINED[name]
+
+
+def acc_combine(old: Any, incoming: Any, op: Op):
+    """MPI accumulate semantics for a target range: the new target values,
+    or None to leave the target unchanged (NO_OP). The single owner of the
+    REPLACE/NO_OP dispatch used by both the in-process path
+    (onesided._apply_op) and the cross-process wire engine
+    (_rma_wire.ProcWinState.apply_acc)."""
+    if op is REPLACE:
+        return _np.asarray(incoming, dtype=old.dtype)
+    if op is NO_OP:
+        return None
+    return _np.asarray(op(old, _np.asarray(incoming, dtype=old.dtype)))
 
 # Function → builtin Op dispatch (src/operators.jl:39-45 maps + * min max & | ⊻).
 _BUILTIN_MAP: dict[Any, Op] = {
