@@ -1,0 +1,183 @@
+"""Allreduce bandwidth sweep: GB/s vs message size, Float32, 8 B - 1 GB.
+
+The BASELINE.json headline metric. Three lanes, each exercised when the
+hardware allows:
+
+- ``host``   — the framework's host-path ``MPI.Allreduce`` over rank threads
+  (jitted fold + zero-copy DeviceBuffer rebind); runs everywhere, measures
+  the deployment path a single-host user hits.
+- ``psum``   — in-graph ``lax.psum`` via ``tpu_mpi.xla.allreduce`` inside
+  jit/shard_map (needs >= 2 XLA devices); the ICI lane. Reports ring bus
+  bandwidth 2(n-1)/n * bytes / t.
+- ``pallas`` — the hand-written Pallas ring-allreduce kernel
+  (``tpu_mpi.xla.pallas_kernels.ring_allreduce``), same bus-bandwidth
+  accounting (needs >= 2 devices).
+
+Usage: python benchmarks/allreduce_sweep.py [--max-bytes N] [--ranks N]
+       [--lanes host,psum,pallas] [-o results/file.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from common import best_block, detect_platform, emit, iters_for, size_sweep
+
+REPEATS = 3
+
+
+def bench_host(nranks: int, sizes: list[int], use_device: bool) -> list[dict]:
+    import numpy as np
+    import tpu_mpi as MPI
+    from tpu_mpi import spmd_run
+    import time
+
+    rows = []
+    for nbytes in sizes:
+        n = max(1, nbytes // 4)
+        warmup, iters = iters_for(nbytes)
+
+        def body():
+            MPI.Init()
+            comm = MPI.COMM_WORLD
+            if use_device:
+                import jax.numpy as jnp
+                from tpu_mpi.buffers import DeviceBuffer
+                buf = DeviceBuffer(jnp.ones(n, jnp.float32))
+                out = DeviceBuffer(jnp.zeros(n, jnp.float32))
+            else:
+                buf = np.ones(n, np.float32)
+                out = np.zeros(n, np.float32)
+            for _ in range(warmup):
+                MPI.Allreduce(buf, out, MPI.SUM, comm)
+            reps = []
+            for _ in range(REPEATS):
+                MPI.Barrier(comm)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    MPI.Allreduce(buf, out, MPI.SUM, comm)
+                MPI.Barrier(comm)
+                reps.append((time.perf_counter() - t0) / iters)
+            MPI.Finalize()
+            return reps
+
+        dt = best_block(spmd_run(body, nranks))
+        rows.append({"bytes": n * 4, "lat_us": round(dt * 1e6, 2),
+                     "algbw_gbps": round(n * 4 / dt / 1e9, 3)})
+        print(f"host  {n * 4:>11d} B  {dt * 1e6:>10.1f} us  "
+              f"{rows[-1]['algbw_gbps']:>8.3f} GB/s", file=sys.stderr)
+    return rows
+
+
+def _bench_in_graph(sizes: list[int], fn_of_mesh, max_iters: int = 10 ** 9,
+                    repeats: int = REPEATS) -> list[dict]:
+    """Shared driver for the psum and pallas lanes."""
+    import time
+    import jax
+    import jax.numpy as jnp
+
+    from common import devices_with_watchdog
+    devs = devices_with_watchdog()
+    n = len(devs)
+    rows = []
+    for nbytes in sizes:
+        # MPI Allreduce semantics (same as bench.py's in-graph path): every
+        # rank contributes nbytes, so the sharded global operand is n*nbytes
+        per_elems = max(1, nbytes // 4)
+        cnt = per_elems * n
+        warmup, iters = iters_for(nbytes)
+        warmup, iters = min(warmup, max_iters), min(iters, max_iters)
+        f = fn_of_mesh(devs, cnt)
+        x = jnp.ones(cnt, jnp.float32)
+        try:
+            f(x).block_until_ready()
+        except Exception as e:
+            print(f"in-graph {nbytes}B skipped: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            continue
+        for _ in range(warmup):
+            f(x).block_until_ready()
+        dt = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                f(x).block_until_ready()
+            dt = min(dt, (time.perf_counter() - t0) / iters)
+        per_rank = per_elems * 4
+        busbw = 2 * (n - 1) / n * per_rank / dt / 1e9
+        rows.append({"bytes": per_rank, "lat_us": round(dt * 1e6, 2),
+                     "busbw_gbps": round(busbw, 3)})
+        print(f"graph {per_rank:>11d} B  {dt * 1e6:>10.1f} us  "
+              f"{busbw:>8.3f} GB/s bus", file=sys.stderr)
+    return rows
+
+
+def bench_psum(sizes: list[int]) -> list[dict]:
+    import jax
+    from jax.sharding import PartitionSpec as P
+    import tpu_mpi as MPI
+    from tpu_mpi import xla
+
+    def make(devs, cnt):
+        mesh = xla.make_mesh({"x": len(devs)}, devices=devs)
+        return jax.jit(jax.shard_map(
+            lambda v: xla.allreduce(v, MPI.SUM, axis="x"),
+            mesh=mesh, in_specs=P("x"), out_specs=P()))
+    return _bench_in_graph(sizes, make)
+
+
+def bench_pallas(sizes: list[int]) -> list[dict]:
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from tpu_mpi import xla
+    from tpu_mpi.xla import pallas_kernels as pk
+
+    def make(devs, cnt):
+        mesh = xla.make_mesh({"x": len(devs)}, devices=devs)
+        return jax.jit(jax.shard_map(
+            lambda v: pk.ring_allreduce(v, "sum", axis="x"),
+            mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+            check_vma=False))   # pallas_call outputs carry no vma info
+    import jax as _jax
+    interp = _jax.devices()[0].platform != "tpu"
+    # the interpret machine runs the kernel step-by-step in Python — cap the
+    # iteration count there; Mosaic-on-TPU gets the full OSU schedule
+    return _bench_in_graph(sizes, make,
+                           max_iters=2 if interp else 10 ** 9,
+                           repeats=1 if interp else REPEATS)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-bytes", type=int, default=1 << 30)
+    ap.add_argument("--ranks", type=int, default=4)
+    ap.add_argument("--lanes", default="host,psum,pallas")
+    ap.add_argument("-o", "--out", default="-")
+    args = ap.parse_args()
+
+    plat = detect_platform()
+    sizes = size_sweep(args.max_bytes)
+    lanes = args.lanes.split(",")
+    record: dict = {"benchmark": "allreduce_sweep", "platform": plat,
+                    "ranks": args.ranks, "lanes": {}}
+    multi = plat["devices"] >= 2
+    if "host" in lanes:
+        use_device = plat["platform"] != "cpu"
+        record["lanes"]["host"] = bench_host(args.ranks, sizes, use_device)
+    if "psum" in lanes and multi:
+        record["lanes"]["psum"] = bench_psum(sizes)
+    if "pallas" in lanes and multi:
+        # the interpret machine (CPU-sim) executes the kernel step-by-step in
+        # Python (~1 s/call + minutes-long "compiles") — there it is a
+        # liveness check on two sizes, not a measurement; Mosaic-on-TPU runs
+        # the sampled sweep for real
+        interp = plat["platform"] != "tpu"
+        sub = sizes[:2] if interp else (
+            sizes[::4] + ([sizes[-1]] if (len(sizes) - 1) % 4 else []))
+        record["lanes"]["pallas"] = bench_pallas(sub)
+    emit(args.out, record)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Shared harness for the benchmark sweeps (BASELINE.json `metric` +
+`configs[4]`: Allreduce GB/s vs message size, OSU-style P2P latency/BW).
+
+The reference publishes no numbers (SURVEY.md §6) — these sweeps are the
+repo's own deliverable. Conventions follow the OSU micro-benchmarks: per
+message size, several warmup rounds, then the best of REPEATS timed blocks
+(max-across-ranks within a block, min across blocks), bandwidth in GB/s
+(1e9 bytes/s).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Callable, Sequence
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def iters_for(nbytes: int) -> tuple[int, int]:
+    """(warmup, iters) scaled down for big messages, OSU-style."""
+    if nbytes <= 1 << 16:
+        return 10, 100
+    if nbytes <= 1 << 22:
+        return 5, 40
+    if nbytes <= 1 << 26:
+        return 3, 10
+    return 2, 5
+
+
+def best_block(times: Sequence[Sequence[float]]) -> float:
+    """times[rank][repeat] → min over repeats of max over ranks."""
+    nrep = len(times[0])
+    return min(max(t[i] for t in times) for i in range(nrep))
+
+
+def size_sweep(max_bytes: int, min_bytes: int = 8) -> list[int]:
+    """Power-of-two byte sizes, 8 B … max_bytes."""
+    out, b = [], min_bytes
+    while b <= max_bytes:
+        out.append(b)
+        b <<= 1
+    return out
+
+
+def devices_with_watchdog(timeout_s: float = 240.0):
+    """jax.devices() via the TPU tunnel can hang indefinitely when the tunnel
+    is unhealthy; probe it on a daemon thread so sweeps always terminate
+    (same guard as bench.py's _devices_with_watchdog)."""
+    import threading
+    box: list = []
+
+    def probe():
+        try:
+            import jax
+            box.append(jax.devices())
+        except Exception as e:
+            box.append(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not box:
+        raise TimeoutError(f"jax.devices() did not return within {timeout_s}s")
+    if isinstance(box[0], Exception):
+        raise box[0]
+    return box[0]
+
+
+def detect_platform() -> dict:
+    """One-shot platform record for the results file."""
+    devs = devices_with_watchdog()
+    return {
+        "devices": len(devs),
+        "platform": devs[0].platform,
+        "device_kind": getattr(devs[0], "device_kind", "?"),
+        "python": sys.version.split()[0],
+    }
+
+
+def emit(path: str, record: dict) -> None:
+    record = dict(record, timestamp=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+    if path == "-":
+        print(json.dumps(record, indent=2))
+        return
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(f"wrote {path}", file=sys.stderr)

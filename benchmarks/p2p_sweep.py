@@ -1,0 +1,152 @@
+"""OSU-style point-to-point latency and bandwidth sweeps (BASELINE.json
+configs[4]: "P2P pattern (Isend/Irecv + Waitall), message sizes 1KB-64MB").
+
+Two ranks:
+
+- ``latency``  — ping-pong: rank 0 ``Send``s, rank 1 echoes; half the
+  round-trip is the one-way latency (osu_latency shape).
+- ``bandwidth``— windowed streaming: rank 0 posts WINDOW ``Isend``s, rank 1
+  WINDOW ``Irecv``s + ``Waitall``, then a 1-byte ack; bytes*WINDOW/t
+  (osu_bw shape).
+
+Runs on the thread-rank tier by default (the single-host deployment path);
+``--procs`` runs the same sweep across two OS processes over the native
+C++ transport + shm lane, the multi-host deployment shape.
+
+Usage: python benchmarks/p2p_sweep.py [--max-bytes N] [--procs] [-o file]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from common import detect_platform, emit, iters_for, size_sweep
+
+WINDOW = 64
+REPEATS = 3
+
+
+def _sweep_body(max_bytes: int, emit_row) -> None:
+    """SPMD program: runs on 2 ranks, reports rows via emit_row on rank 0."""
+    import numpy as np
+    import tpu_mpi as MPI
+
+    comm = MPI.COMM_WORLD
+    rank = comm.rank()
+    peer = 1 - rank
+
+    for nbytes in size_sweep(max_bytes):
+        n = max(1, nbytes // 4)
+        buf = np.ones(n, np.float32)
+        rbuf = np.zeros(n, np.float32)
+        warmup, iters = iters_for(nbytes)
+
+        # --- latency: ping-pong ---
+        lat = float("inf")
+        for rep in range(REPEATS + 1):   # first block is warmup
+            it = warmup if rep == 0 else iters
+            MPI.Barrier(comm)
+            t0 = time.perf_counter()
+            for _ in range(it):
+                if rank == 0:
+                    MPI.Send(buf, peer, 7, comm)
+                    MPI.Recv(rbuf, peer, 7, comm)
+                else:
+                    MPI.Recv(rbuf, peer, 7, comm)
+                    MPI.Send(buf, peer, 7, comm)
+            dt = (time.perf_counter() - t0) / it / 2
+            if rep > 0:
+                lat = min(lat, dt)
+
+        # --- bandwidth: windowed Isend/Irecv + Waitall ---
+        bw_iters = max(2, iters // 8)
+        ack = np.zeros(1, np.float32)
+        bw = 0.0
+        for rep in range(REPEATS + 1):
+            it = 1 if rep == 0 else bw_iters
+            MPI.Barrier(comm)
+            t0 = time.perf_counter()
+            for _ in range(it):
+                if rank == 0:
+                    reqs = [MPI.Isend(buf, peer, 11, comm) for _ in range(WINDOW)]
+                    MPI.Waitall(reqs)
+                    MPI.Recv(ack, peer, 12, comm)
+                else:
+                    reqs = [MPI.Irecv(rbuf, peer, 11, comm) for _ in range(WINDOW)]
+                    MPI.Waitall(reqs)
+                    MPI.Send(ack, peer, 12, comm)
+            dt = (time.perf_counter() - t0) / it
+            if rep > 0:
+                bw = max(bw, n * 4 * WINDOW / dt / 1e9)
+
+        if rank == 0:
+            emit_row({"bytes": n * 4, "lat_us": round(lat * 1e6, 2),
+                      "bw_gbps": round(bw, 3)})
+
+
+def run_threads(max_bytes: int) -> list[dict]:
+    import tpu_mpi as MPI
+    from tpu_mpi import spmd_run
+
+    rows: list[dict] = []
+
+    def body():
+        MPI.Init()
+        def emit_row(row):
+            rows.append(row)
+            print(f"p2p {row['bytes']:>11d} B  {row['lat_us']:>9.2f} us  "
+                  f"{row['bw_gbps']:>8.3f} GB/s", file=sys.stderr)
+        _sweep_body(max_bytes, emit_row)
+        MPI.Finalize()
+
+    spmd_run(body, 2)
+    return rows
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-bytes", type=int, default=1 << 26)
+    ap.add_argument("--procs", action="store_true",
+                    help="two OS processes over the native transport")
+    ap.add_argument("--rows-out", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("-o", "--out", default="-")
+    args = ap.parse_args()
+
+    if os.environ.get("TPU_MPI_PROC_RANK") is not None:
+        # child re-entry under --procs: run the sweep; rank 0 appends rows to
+        # the file named by --rows-out (launch_processes owns the job control)
+        import tpu_mpi as MPI
+        import json
+        MPI.Init()
+        with open(args.rows_out or os.devnull, "a") as f:
+            _sweep_body(args.max_bytes,
+                        lambda row: (f.write(json.dumps(row) + "\n"), f.flush()))
+        MPI.Finalize()
+        return
+
+    if args.procs:
+        import json
+        import tempfile
+        from tpu_mpi.launcher import launch_processes
+        with tempfile.NamedTemporaryFile("r", suffix=".jsonl") as rows_f:
+            code = launch_processes(
+                os.path.abspath(__file__), 2,
+                ["--max-bytes", str(args.max_bytes), "--rows-out", rows_f.name],
+                timeout=3600)
+            if code != 0:
+                sys.exit(code)
+            rows = [json.loads(l) for l in rows_f.read().splitlines()]
+        tier = "procs"
+    else:
+        rows = run_threads(args.max_bytes)
+        tier = "threads"
+
+    emit(args.out, {"benchmark": "p2p_sweep", "tier": tier, "window": WINDOW,
+                    "platform": detect_platform(), "rows": rows})
+
+
+if __name__ == "__main__":
+    main()
