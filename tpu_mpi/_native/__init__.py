@@ -74,6 +74,8 @@ def load() -> ctypes.CDLL:
         lib.tm_port.argtypes = [ctypes.c_void_p]
         lib.tm_set_peers.restype = ctypes.c_int
         lib.tm_set_peers.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.tm_grow.restype = ctypes.c_int
+        lib.tm_grow.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p]
         lib.tm_send.restype = ctypes.c_int
         lib.tm_send.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                 ctypes.c_void_p, ctypes.c_longlong]
@@ -117,6 +119,14 @@ class NativeTransport:
         csv = ",".join(addrs).encode()
         if self._lib.tm_set_peers(self._h, csv) != 0:
             raise NativeBuildError(f"tm_set_peers rejected {addrs!r}")
+
+    def grow(self, addrs: list[str]) -> None:
+        """Extend the world to len(addrs) ranks (MPI_Comm_spawn support);
+        the full new address table, existing ranks' slots unchanged."""
+        csv = ",".join(addrs).encode()
+        if self._lib.tm_grow(self._h, len(addrs), csv) != 0:
+            raise NativeBuildError(f"tm_grow rejected {addrs!r}")
+        self.size = len(addrs)
 
     def send(self, dst: int, payload: bytes) -> None:
         rc = self._lib.tm_send(self._h, dst, payload, len(payload))

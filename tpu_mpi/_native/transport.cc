@@ -126,7 +126,7 @@ class Transport {
  public:
   Transport(int rank, int size) : rank_(rank), size_(size) {
     peer_fds_.assign(size, -1);
-    peer_locks_ = std::vector<std::mutex>(size);
+    for (int i = 0; i < size; ++i) peer_locks_.emplace_back();
   }
 
   ~Transport() { stop(); }
@@ -142,7 +142,7 @@ class Transport {
     addr.sin_port = 0;
     if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
       return false;
-    if (::listen(listen_fd_, size_ + 8) < 0) return false;
+    if (::listen(listen_fd_, size_.load() + 8) < 0) return false;
     socklen_t alen = sizeof(addr);
     if (::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen) < 0)
       return false;
@@ -155,18 +155,44 @@ class Transport {
 
   int port() const { return port_; }
 
-  // csv: "host:port,host:port,..." indexed by rank.
-  bool set_peers(const std::string& csv) {
-    std::lock_guard<std::mutex> g(peers_mtx_);
-    peer_addrs_.clear();
+  static std::vector<std::string> parse_csv(const std::string& csv) {
+    std::vector<std::string> out;
     size_t pos = 0;
     while (pos <= csv.size()) {
       size_t comma = csv.find(',', pos);
       if (comma == std::string::npos) comma = csv.size();
-      peer_addrs_.push_back(csv.substr(pos, comma - pos));
+      out.push_back(csv.substr(pos, comma - pos));
       pos = comma + 1;
     }
-    return static_cast<int>(peer_addrs_.size()) == size_;
+    return out;
+  }
+
+  // csv: "host:port,host:port,..." indexed by rank.
+  bool set_peers(const std::string& csv) {
+    std::lock_guard<std::mutex> g(peers_mtx_);
+    peer_addrs_ = parse_csv(csv);
+    return static_cast<int>(peer_addrs_.size()) == size_.load();
+  }
+
+  // Extend the world in place (dynamic process management, MPI_Comm_spawn):
+  // the csv is the FULL new address table. Existing ranks' slots keep their
+  // addresses (deque element references are stable across push_back);
+  // concurrent indexers fetch their slot pointers under peers_mtx_ (see
+  // slot_for), so the deque's internal bookkeeping is never raced; size_
+  // publishes last so a send to a new rank only passes the bounds check
+  // once its slot exists.
+  bool grow(int new_size, const std::string& csv) {
+    std::lock_guard<std::mutex> g(peers_mtx_);
+    if (new_size < size_.load()) return false;
+    std::vector<std::string> addrs = parse_csv(csv);
+    if (static_cast<int>(addrs.size()) != new_size) return false;
+    peer_addrs_ = std::move(addrs);
+    while (static_cast<int>(peer_fds_.size()) < new_size) {
+      peer_fds_.push_back(-1);
+      peer_locks_.emplace_back();
+    }
+    size_.store(new_size);
+    return true;
   }
 
   // Blocking framed send. Thread-safe per destination.
@@ -180,7 +206,8 @@ class Transport {
   // given buffers, written with writev — no join copy on the send path (the
   // Python codec hands the pickle skeleton and each array buffer separately).
   bool sendv(int dst, const void** bufs, const int64_t* lens, int nbufs) {
-    if (dst < 0 || dst >= size_ || stopped_.load() || nbufs < 0) return false;
+    if (dst < 0 || dst >= size_.load() || stopped_.load() || nbufs < 0)
+      return false;
     int64_t total = 0;
     for (int i = 0; i < nbufs; ++i) total += lens[i];
     if (dst == rank_) {  // self-send: straight to the inbox
@@ -196,12 +223,22 @@ class Transport {
       push_frame(std::move(f));
       return true;
     }
-    std::lock_guard<std::mutex> g(peer_locks_[dst]);
-    int fd = peer_fds_[dst];
+    std::mutex* plk;
+    int* fd_slot;
+    {
+      // Deque operator[] walks internal bookkeeping that a concurrent
+      // grow() push_back mutates; fetch the slot pointers under peers_mtx_
+      // (the references themselves stay valid after unlock).
+      std::lock_guard<std::mutex> g(peers_mtx_);
+      plk = &peer_locks_[dst];
+      fd_slot = &peer_fds_[dst];
+    }
+    std::lock_guard<std::mutex> g(*plk);
+    int fd = *fd_slot;
     if (fd < 0) {
       fd = connect_peer(dst);
       if (fd < 0) return false;
-      peer_fds_[dst] = fd;
+      *fd_slot = fd;
     }
     FrameHeader h{kMagic, rank_, total};
     std::vector<iovec> iov;
@@ -213,7 +250,7 @@ class Transport {
                        static_cast<size_t>(lens[i])});
     if (!writev_all(fd, iov.data(), iov.size())) {
       ::close(fd);
-      peer_fds_[dst] = -1;
+      *fd_slot = -1;
       return false;
     }
     return true;
@@ -255,13 +292,27 @@ class Transport {
       (void)!::write(wake_pipe_[1], &c, 1);
     }
     if (progress_.joinable()) progress_.join();
-    for (int i = 0; i < static_cast<int>(peer_fds_.size()); ++i) {
+    int npeers;
+    {
+      std::lock_guard<std::mutex> g(peers_mtx_);
+      npeers = static_cast<int>(peer_fds_.size());
+    }
+    for (int i = 0; i < npeers; ++i) {
+      std::mutex* plk;
+      int* fd_slot;
+      {
+        // slot pointers fetched under peers_mtx_ (concurrent grow safety,
+        // same discipline as sendv)
+        std::lock_guard<std::mutex> g(peers_mtx_);
+        plk = &peer_locks_[i];
+        fd_slot = &peer_fds_[i];
+      }
       // Lock out concurrent send(): closing under a live write_all would
       // hand the fd number back to the OS for reuse mid-write.
-      std::lock_guard<std::mutex> g(peer_locks_[i]);
-      if (peer_fds_[i] >= 0) {
-        ::close(peer_fds_[i]);
-        peer_fds_[i] = -1;
+      std::lock_guard<std::mutex> g(*plk);
+      if (*fd_slot >= 0) {
+        ::close(*fd_slot);
+        *fd_slot = -1;
       }
     }
     for (Conn& c : conns_)
@@ -427,14 +478,17 @@ class Transport {
     }
   }
 
-  int rank_, size_;
+  int rank_;
+  std::atomic<int> size_;
   int listen_fd_ = -1;
   int port_ = -1;
   int wake_pipe_[2] = {-1, -1};
   std::mutex peers_mtx_;
   std::vector<std::string> peer_addrs_;
-  std::vector<int> peer_fds_;
-  std::vector<std::mutex> peer_locks_;
+  // deques: growth must not move live slots (grow() appends while sends to
+  // existing peers hold references into them)
+  std::deque<int> peer_fds_;
+  std::deque<std::mutex> peer_locks_;
   std::mutex q_mtx_;
   std::condition_variable q_cv_;
   std::deque<Frame> inbox_;
@@ -460,6 +514,10 @@ int tm_port(void* h) { return static_cast<Transport*>(h)->port(); }
 
 int tm_set_peers(void* h, const char* csv) {
   return static_cast<Transport*>(h)->set_peers(csv) ? 0 : -1;
+}
+
+int tm_grow(void* h, int new_size, const char* csv) {
+  return static_cast<Transport*>(h)->grow(new_size, csv) ? 0 : -1;
 }
 
 int tm_send(void* h, int dst, const void* buf, long long len) {

@@ -79,9 +79,18 @@ class _Waitable:
     cond: threading.Condition
 
     def _wait_for(self, pred: Callable[[], bool], what: str,
-                  timeout: Optional[float] = None) -> bool:
-        """Wait (cond held) until pred() or failure/deadlock. Returns pred()."""
-        limit = deadlock_timeout() if timeout is None else timeout
+                  timeout: Optional[float] = None,
+                  limit: Optional[float] = None) -> bool:
+        """Wait (cond held) until pred() or failure/deadlock. Returns pred().
+
+        ``timeout`` makes expiry return False (Test*-style polling);
+        ``limit`` overrides the deadlock budget but keeps the raising
+        semantics (ops that legitimately outlast it, e.g. Comm_spawn's
+        child-process rendezvous)."""
+        if timeout is not None:
+            limit = timeout
+        elif limit is None:
+            limit = deadlock_timeout()
         deadline = time.monotonic() + limit
         while not pred():
             self.ctx.check_failure()
@@ -92,6 +101,17 @@ class _Waitable:
                 raise DeadlockError(f"deadlock suspected: blocked >{limit}s in {what}")
             self.cond.wait(min(_POLL, remaining))
         return True
+
+
+def collective_wait_limit(opname: str) -> Optional[float]:
+    """Per-op override of the deadlock budget: a Comm_spawn collective
+    legitimately blocks while child processes boot (cold interpreter + jax
+    import), so non-root ranks wait with the rendezvous budget, not the
+    60 s deadlock one."""
+    if opname.startswith("Comm_spawn"):
+        from . import config
+        return max(deadlock_timeout(), config.load().rendezvous_timeout)
+    return None
 
 
 class Message:
@@ -278,7 +298,8 @@ class CollectiveChannel(_Waitable):
                 self.cond.notify_all()
             else:
                 self._wait_for(lambda: self.results is not None,
-                               f"collective {opname}")
+                               f"collective {opname}",
+                               limit=collective_wait_limit(opname))
             assert self.results is not None
             res = self.results[rank]
             self.picked += 1

@@ -1,0 +1,56 @@
+"""Bootstrap for a Comm_spawn'ed child process (multi-process tier).
+
+The analog of mpiexec starting ``julia spawned_worker.jl`` for
+MPI_Comm_spawn (/root/reference/src/comm.jl:135-147,
+test/spawned_worker.jl:6-8): the spawner launched this interpreter with
+``python -m tpu_mpi._spawn_child`` and the rendezvous env
+(TPU_MPI_PROC_{RANK,SIZE,COORD}) plus TPU_MPI_SPAWN_SPEC pointing at a
+pickled spec. We join the parent world's transport mesh as a new world
+rank, carve out the children's own COMM_WORLD, install the parent
+intercomm for Comm_get_parent, and run the command.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+
+
+def main() -> int:
+    spec_path = os.environ["TPU_MPI_SPAWN_SPEC"]
+    with open(spec_path, "rb") as f:
+        spec = pickle.load(f)
+
+    from .backend import proc_attach
+    ctx, rank = proc_attach()
+
+    child_group = tuple(spec["child_group"])
+    # The children form their own job world (spawned MPI jobs get their own
+    # MPI_COMM_WORLD); transport numbering stays global.
+    for r in child_group:
+        ctx.worlds[r] = (child_group, spec["world_cid"])
+
+    from .comm import Intercomm, _run_spawned
+    ctx.parent_comm[rank] = Intercomm(
+        child_group, tuple(spec["parent_group"]), spec["inter_cid"],
+        name="parent_intercomm")
+    ctx.spawn_argv[rank] = list(spec["worker_argv"])
+
+    command = spec["command"]
+    if isinstance(command, bytes):
+        command = pickle.loads(command)
+    try:
+        _run_spawned(command, spec["argv"])
+    except SystemExit as e:
+        return int(e.code or 0) if not isinstance(e.code, str) else 1
+    except BaseException as e:
+        ctx.fail(e, rank)
+        print(f"tpu_mpi spawned rank {rank} failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

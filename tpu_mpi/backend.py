@@ -31,6 +31,7 @@ import os
 import pickle
 import socket
 import struct
+import sys
 import threading
 from typing import Any, Callable, Optional, Sequence
 
@@ -38,7 +39,7 @@ import numpy as np
 
 from . import config
 from ._runtime import (ANY_SOURCE, Mailbox, Message, SpmdContext, _Waitable,
-                       set_env)
+                       collective_wait_limit, set_env)
 from .error import AbortError, CollectiveMismatchError, MPIError
 
 _POLL_MS = 50
@@ -90,7 +91,12 @@ def _shm_min_bytes() -> int:
 def shm_job_tag() -> str:
     """Per-job namespace for shm segment names (the coordinator port is
     shared by every rank of a job and by the launcher, which sweeps
-    ``tpumpi_<tag>_*`` leftovers after the job ends)."""
+    ``tpumpi_<tag>_*`` leftovers after the job ends). Comm_spawn'ed children
+    inherit the job tag via TPU_MPI_SHM_TAG — their PROC_COORD points at an
+    ephemeral spawn coordinator nothing would ever sweep."""
+    tag = os.environ.get("TPU_MPI_SHM_TAG")
+    if tag:
+        return tag
     coord = os.environ.get("TPU_MPI_PROC_COORD", "")
     return coord.rsplit(":", 1)[-1] or "local"
 
@@ -500,7 +506,8 @@ class ProcChannel(_Waitable):
                                     _pack(contrib)), opname)
             with self.cond:
                 self._wait_for(lambda: (rnd,) in self.inbox,
-                               f"collective {opname}")
+                               f"collective {opname}",
+                               limit=collective_wait_limit(opname))
                 res = self.inbox.pop((rnd,))
             return _unpack(res)
 
@@ -572,7 +579,8 @@ class ProcContext(SpmdContext):
 
     def __init__(self, local_rank: int, size: int, transport,
                  universe_size: Optional[int] = None,
-                 same_host: Optional[Sequence[bool]] = None):
+                 same_host: Optional[Sequence[bool]] = None,
+                 addrs: Optional[Sequence[str]] = None):
         super().__init__(size, universe_size=universe_size)
         self.local_rank = local_rank
         self.transport = transport
@@ -580,6 +588,11 @@ class ProcContext(SpmdContext):
         # the single-launcher `tpurun --procs` shape.
         self._same_host = tuple(same_host) if same_host is not None \
             else (True,) * size
+        # world address table ("host:port" per rank) — the basis for
+        # Comm_spawn world growth; empty when unknown (no spawn possible).
+        self.addrs: list[str] = list(addrs or [])
+        self._grow_lock = threading.Lock()
+        self._spawned_procs: list = []
         self._cid_counter = itertools.count(0)
         self.mailboxes = [
             Mailbox(self) if r == local_rank else _RemoteMailbox(self, r)
@@ -677,19 +690,123 @@ class ProcContext(SpmdContext):
             ch.group = tuple(group)
         return ch
 
-    def alloc_cid(self) -> int:
+    def alloc_cid(self):
         """Process-namespaced context ids. alloc_cid runs inside combine(),
         which executes only at the allocating comm's ROOT process — each
         process has its own counter, so two different roots would mint the
         same id (observed: a split-of-a-split deadlocks on the reused
-        channel). Stride by world size, offset by this process's rank:
-        disjoint id spaces, still plain ints."""
-        return 2 + self.local_rank + self.size * next(self._cid_counter)
+        channel). Tuple of (world rank, local counter): disjoint by
+        construction, and — unlike the old size-strided ints — immune to the
+        world growing mid-job (Comm_spawn changes self.size, which would
+        change the stride and re-collide)."""
+        return ("c", self.local_rank, next(self._cid_counter))
+
+    # -- dynamic process management (MPI_Comm_spawn, src/comm.jl:135-147) -----
+    def spawn_processes(self, n: int, command, argv, parent_group):
+        """Launch ``n`` child OS processes that join this world's transport
+        mesh as world ranks [W, W+n) while forming their own COMM_WORLD.
+        Runs at the spawning comm's star-root process only (inside combine).
+        Returns (child_group, inter_cid, world_cid, world_addrs) — shipped
+        to every parent, which then applies the growth locally.
+
+        Concurrent spawns from communicators with different roots are not
+        coordinated (no resource-manager universe); the reference delegates
+        that to mpiexec's universe."""
+        import pickle
+        import subprocess
+        import tempfile
+
+        from .comm import _worker_argv
+
+        if not self.addrs:
+            raise MPIError("Comm_spawn needs the world address table; this "
+                           "process was not attached via rendezvous")
+        with self._grow_lock:
+            base = len(self.addrs)
+        child_group = tuple(range(base, base + n))
+        inter_cid = self.alloc_cid()
+        world_cid = self.alloc_cid()
+        if callable(command):
+            command_wire: Any = pickle.dumps(command)
+        else:
+            command_wire = str(command)
+        spec = {
+            "command": command_wire,
+            "argv": [str(a) for a in (argv or [])],
+            "worker_argv": _worker_argv(command, argv),
+            "parent_group": tuple(parent_group),
+            "child_group": child_group,
+            "inter_cid": inter_cid,
+            "world_cid": world_cid,
+        }
+        fd, spec_path = tempfile.mkstemp(prefix="tpu_mpi_spawn_", suffix=".pkl")
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(spec, f)
+        cfg = config.load()
+        # bind/advertise like the launcher's coordinator: children run on
+        # THIS host, so in a multi-host world their transport addresses must
+        # be advertised as this host's routable name, not loopback
+        coord = Coordinator(n, host=cfg.coordinator_bind, rank_base=base,
+                            base_addrs=list(self.addrs),
+                            advertise=cfg.coordinator_advertise or None)
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        procs = []
+        try:
+            for i in range(n):
+                env = dict(os.environ)
+                old_pp = env.get("PYTHONPATH", "")
+                env["PYTHONPATH"] = (pkg_parent
+                                     + (os.pathsep + old_pp if old_pp else ""))
+                env["TPU_MPI_PROC_RANK"] = str(base + i)
+                env["TPU_MPI_PROC_SIZE"] = str(base + n)
+                env["TPU_MPI_PROC_COORD"] = coord.address
+                env["TPU_MPI_SPAWN_SPEC"] = spec_path
+                # children inherit the JOB's shm namespace, not the ephemeral
+                # spawn-coordinator port, so the launcher's end-of-job sweep
+                # reclaims their segments too
+                env["TPU_MPI_SHM_TAG"] = shm_job_tag()
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "tpu_mpi._spawn_child"], env=env))
+            world_addrs = coord.wait_map(config.load().rendezvous_timeout)
+        except BaseException:
+            for p in procs:
+                p.terminate()
+            raise
+        finally:
+            coord.close()
+            # every child reads the spec before it rendezvouses, so once the
+            # map is (or fails to be) complete the file is dead weight
+            try:
+                os.unlink(spec_path)
+            except OSError:
+                pass
+        self._spawned_procs.extend(procs)
+        return (child_group, inter_cid, world_cid, world_addrs)
+
+    def apply_growth(self, world_addrs: Sequence[str]) -> None:
+        """Extend this process's view of the world to the new address table
+        (idempotent; every parent rank calls it after a spawn completes)."""
+        with self._grow_lock:
+            if len(world_addrs) <= len(self.addrs):
+                return
+            self.transport.grow(list(world_addrs))
+            my_host = (self.addrs[self.local_rank].rsplit(":", 1)[0]
+                       if self.addrs else "")
+            for r in range(len(self.addrs), len(world_addrs)):
+                self.mailboxes.append(_RemoteMailbox(self, r))
+                self.initialized.append(False)
+                self.finalized.append(False)
+                self.thread_level.append(None)
+                self.main_threads.append(None)
+            self._same_host = tuple(
+                a.rsplit(":", 1)[0] == my_host for a in world_addrs)
+            self.addrs = list(world_addrs)
+            self.size = len(world_addrs)
 
     # -- overrides: shared-address-space features -----------------------------
     def add_ranks(self, n: int, world_cid: Any):
-        raise MPIError("Comm_spawn is not supported in multi-process mode; "
-                       "launch the full world up front (tpurun -n N --procs)")
+        raise MPIError("internal: thread-tier add_ranks called on the "
+                       "multi-process context (use spawn_processes)")
 
     @property
     def supports_shared_objects(self) -> bool:
@@ -715,6 +832,26 @@ class ProcContext(SpmdContext):
                     pass
 
     def shutdown(self) -> None:
+        # Reap spawned children first: their intercomm traffic rides this
+        # process's transport, so stopping it while they still run would
+        # strand them (mpiexec waits for the whole universe). One shared
+        # 60 s budget; stragglers get SIGTERM, then SIGKILL, and are always
+        # wait()ed so nothing stays a zombie.
+        import time as _time
+        deadline = _time.monotonic() + 60
+        for p in self._spawned_procs:
+            try:
+                p.wait(timeout=max(0.0, deadline - _time.monotonic()))
+            except Exception:
+                p.terminate()
+                try:
+                    p.wait(timeout=5)
+                except Exception:
+                    p.kill()
+                    try:
+                        p.wait(timeout=5)
+                    except Exception:
+                        pass
         self._drainer_stop.set()
         self.transport.stop()
 
@@ -762,7 +899,7 @@ def proc_attach() -> tuple[ProcContext, int]:
     # Scheduler-launched jobs have no tpurun parent to sweep crashed ranks'
     # shm segments; reclaim any whose creating process is gone.
     sweep_segments(shm_job_tag(), only_dead_creators=True)
-    ctx = ProcContext(rank, size, transport, same_host=same_host)
+    ctx = ProcContext(rank, size, transport, same_host=same_host, addrs=addrs)
     set_env((ctx, rank))
     # Deterministic teardown: stop the drainer + native progress thread at
     # interpreter exit rather than relying on GC-order __del__.
@@ -776,22 +913,44 @@ def proc_attach() -> tuple[ProcContext, int]:
 # ---------------------------------------------------------------------------
 
 class Coordinator:
-    """Address-map rendezvous server run by the launcher process."""
+    """Address-map rendezvous server run by the launcher process.
 
-    def __init__(self, nprocs: int, host: str = "127.0.0.1"):
+    ``host`` is the bind interface; ``advertise`` is the address children
+    dial AND the host loopback-connected children are paired with in the
+    world map. For multi-host jobs bind "0.0.0.0" and advertise a routable
+    name (config ``coordinator_bind`` / ``coordinator_advertise``)."""
+
+    def __init__(self, nprocs: int, host: str = "127.0.0.1",
+                 port: int = 0, advertise: Optional[str] = None,
+                 rank_base: int = 0,
+                 base_addrs: Optional[list[str]] = None):
+        # rank_base/base_addrs: spawn rendezvous (MPI_Comm_spawn) — the
+        # ``nprocs`` registrants carry absolute world ranks
+        # [rank_base, rank_base+nprocs) and every side receives the FULL
+        # world map (existing ranks' addresses + the new ones).
         self.nprocs = nprocs
+        self.rank_base = rank_base
+        self.base_addrs = list(base_addrs or [])
+        self._map: Optional[list[str]] = None
+        self._map_ready = threading.Event()
         self.host = host
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.sock.bind((host, 0))
+        self.sock.bind((host, port))
         self.sock.listen(nprocs + 4)
         self.port = self.sock.getsockname()[1]
+        if advertise:
+            self.advertise_host = advertise
+        elif host in ("0.0.0.0", "::", ""):
+            self.advertise_host = socket.gethostname()
+        else:
+            self.advertise_host = host
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
 
     @property
     def address(self) -> str:
-        return f"{self.host}:{self.port}"
+        return f"{self.advertise_host}:{self.port}"
 
     def _serve(self) -> None:
         conns: dict[int, socket.socket] = {}     # rank -> connection
@@ -812,6 +971,7 @@ class Coordinator:
                 except Exception:
                     c.close()                    # garbled registration
                     continue
+                rank -= self.rank_base
                 if rank in conns or not (0 <= rank < self.nprocs):
                     # Duplicate or out-of-range rank: reject THIS registrant
                     # with a diagnostic instead of overwriting a sibling's
@@ -828,11 +988,14 @@ class Coordinator:
                 # A child on another host reports its transport port; pair it
                 # with the address it connected from (loopback children report
                 # the coordinator-visible host).
-                chost = peer[0] if peer[0] not in ("127.0.0.1", "::1") else self.host
+                chost = (peer[0] if peer[0] not in ("127.0.0.1", "::1")
+                         else self.advertise_host)
                 addrs[rank] = f"{chost}:{port}"
                 conns[rank] = c
-            world = [addrs[r] for r in range(self.nprocs)]
+            world = self.base_addrs + [addrs[r] for r in range(self.nprocs)]
             payload = (json.dumps(world) + "\n").encode()
+            self._map = world
+            self._map_ready.set()
             for c in conns.values():
                 try:
                     c.sendall(payload)
@@ -848,6 +1011,16 @@ class Coordinator:
                 except Exception:
                     pass
                 c.close()
+
+    def wait_map(self, timeout: float) -> list[str]:
+        """Block until every expected registrant arrived; the full world
+        address table (spawn rendezvous: the spawner needs it to grow the
+        parents)."""
+        if not self._map_ready.wait(timeout):
+            raise MPIError(f"spawn rendezvous timed out waiting for "
+                           f"{self.nprocs} children")
+        assert self._map is not None
+        return list(self._map)
 
     def close(self) -> None:
         try:

@@ -347,6 +347,37 @@ def Comm_spawn(command, argv=None, maxprocs: int = 1, comm: Comm = COMM_WORLD,
     ctx = comm.ctx
     worker_argv = _worker_argv(command, argv)
 
+    # A comparable identity for `command` (ADVICE r1): ranks disagreeing on
+    # WHAT to spawn must be detected, not resolved by whichever rank's
+    # closure runs the combine. Callables compare by qualified name + module.
+    if callable(command):
+        command_id = (getattr(command, "__module__", ""),
+                      getattr(command, "__qualname__", repr(command)))
+    else:
+        command_id = str(command)
+    contrib = (int(maxprocs), command_id, tuple(worker_argv))
+
+    if hasattr(ctx, "spawn_processes"):
+        # Multi-process tier: the star-root process launches real child OS
+        # processes that join the transport mesh (the honest analog of
+        # libmpi spawning via the process manager, src/comm.jl:135-147);
+        # every parent then grows its world view.
+        def combine_procs(cs):
+            if any(c != cs[0] for c in cs[1:]):
+                from .error import CollectiveMismatchError
+                raise CollectiveMismatchError(
+                    f"Comm_spawn arguments disagree across ranks: {cs!r}")
+            return [ctx.spawn_processes(int(maxprocs), command, argv,
+                                        parent_group)] * len(cs)
+
+        child_group, inter_cid, _world_cid, world_addrs = comm.channel().run(
+            my_rank, contrib, combine_procs, f"Comm_spawn@{comm.cid}")
+        ctx.apply_growth(world_addrs)
+        if errors is not None:
+            errors[:] = [0] * int(maxprocs)
+        return Intercomm(parent_group, tuple(child_group), inter_cid,
+                         name="spawn_intercomm")
+
     def combine(cs):
         # Spawn is collective: every parent rank must agree on what to spawn
         # (libmpi validates root-side args; here all ranks contribute, so
@@ -369,15 +400,6 @@ def Comm_spawn(command, argv=None, maxprocs: int = 1, comm: Comm = COMM_WORLD,
             ctx.start_rank_thread(r, lambda: _run_spawned(command, argv))
         return [(child_group, inter_cid)] * len(cs)
 
-    # A comparable identity for `command` too (ADVICE r1): ranks disagreeing
-    # on WHAT to spawn must be detected, not resolved by whichever rank's
-    # closure runs the combine. Callables compare by qualified name + module.
-    if callable(command):
-        command_id = (getattr(command, "__module__", ""),
-                      getattr(command, "__qualname__", repr(command)))
-    else:
-        command_id = str(command)
-    contrib = (int(maxprocs), command_id, tuple(worker_argv))
     child_group, inter_cid = comm.channel().run(
         my_rank, contrib, combine, f"Comm_spawn@{comm.cid}")
     if errors is not None:
@@ -405,7 +427,7 @@ def Intercomm_merge(intercomm: Intercomm, high: bool) -> Comm:
     _, world_rank = require_env()
     slot = a.index(world_rank) if world_rank in a else len(a) + b.index(world_rank)
     total = len(a) + len(b)
-    chan = ctx.channel(("merge", intercomm.cid), total)
+    chan = ctx.channel(("merge", intercomm.cid), total, group=tuple(a) + tuple(b))
 
     def combine(cs):
         cid = ctx.alloc_cid()

@@ -43,6 +43,9 @@ class Config:
     # interface the coordinator binds ("127.0.0.1" single-host; "0.0.0.0"
     # to serve a real cluster over DCN).
     coordinator_bind: str = "127.0.0.1"
+    # address remote hosts dial for the coordinator ("" = the bind address,
+    # or the hostname when binding 0.0.0.0).
+    coordinator_advertise: str = ""
     # seconds a blocking wait may stall before DeadlockError.
     deadlock_timeout: float = 60.0
     # seconds a child waits for the world address map at rendezvous.
@@ -66,6 +69,7 @@ _ENV_MAP = {
     "nprocs": "TPU_MPI_NPROCS",
     "coordinator": "TPU_MPI_PROC_COORD",
     "coordinator_bind": "TPU_MPI_COORD_BIND",
+    "coordinator_advertise": "TPU_MPI_COORD_ADVERTISE",
     "deadlock_timeout": "TPU_MPI_DEADLOCK_TIMEOUT",
     "rendezvous_timeout": "TPU_MPI_RENDEZVOUS_TIMEOUT",
     "max_frame_bytes": "TPU_MPI_MAX_FRAME_BYTES",

@@ -66,11 +66,23 @@ def launch_script(path: str, nprocs: int, script_args: Optional[list[str]] = Non
 def launch_processes(path: str, nprocs: int,
                      script_args: Optional[list[str]] = None,
                      timeout: Optional[float] = None,
-                     sim: Optional[int] = None) -> int:
+                     sim: Optional[int] = None,
+                     world_size: Optional[int] = None,
+                     rank_base: int = 0,
+                     coordinator: Optional[str] = None,
+                     coord_port: int = 0) -> int:
     """Run a script as N OS processes over the native transport (the
     reference's actual launch model, bin/mpiexecjl:55-64: mpiexec forks N
     processes; ranks bind at Init). Returns the job exit code; any rank
-    failing nonzero fails the job, mpiexec-style."""
+    failing nonzero fails the job, mpiexec-style.
+
+    Multi-host (SURVEY §3.5 "multi-host → per-host processes"): one tpurun
+    invocation per host, each launching its local share of a bigger world —
+    ``world_size`` = total ranks, ``rank_base`` = this host's first rank.
+    The first host creates the rendezvous Coordinator (bind/advertise from
+    config, fixed ``coord_port`` so peers can be pointed at it); the others
+    pass ``coordinator="host:port"`` and join it.
+    """
     import signal
     import subprocess
 
@@ -78,20 +90,36 @@ def launch_processes(path: str, nprocs: int,
     from .backend import Coordinator
 
     cfg = config.load()
-    coord = Coordinator(nprocs, host=cfg.coordinator_bind)
+    world = world_size if world_size is not None else nprocs
+    if not (0 <= rank_base and rank_base + nprocs <= world):
+        raise MPIError(f"local ranks [{rank_base}, {rank_base + nprocs}) "
+                       f"outside world of {world}")
+    coord = None
+    if coordinator is None:
+        coord = Coordinator(world, host=cfg.coordinator_bind, port=coord_port,
+                            advertise=cfg.coordinator_advertise or None)
+        coord_addr = coord.address
+        if world > nprocs:
+            # remaining hosts need this address; print it where a wrapping
+            # scheduler can scrape it
+            print(f"tpurun: coordinator at {coord_addr} "
+                  f"(waiting for {world - nprocs} remote ranks)",
+                  file=sys.stderr, flush=True)
+    else:
+        coord_addr = coordinator
     procs: list[subprocess.Popen] = []
     try:
         # Children run `python script.py`, whose sys.path[0] is the script's
         # directory — make sure they can import this tpu_mpi no matter where
         # the script lives (the mpiexecjl --project flag analog).
         pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        for rank in range(nprocs):
+        for rank in range(rank_base, rank_base + nprocs):
             env = dict(os.environ)
             old_pp = env.get("PYTHONPATH", "")
             env["PYTHONPATH"] = (pkg_parent + (os.pathsep + old_pp if old_pp else ""))
             env["TPU_MPI_PROC_RANK"] = str(rank)
-            env["TPU_MPI_PROC_SIZE"] = str(nprocs)
-            env["TPU_MPI_PROC_COORD"] = coord.address
+            env["TPU_MPI_PROC_SIZE"] = str(world)
+            env["TPU_MPI_PROC_COORD"] = coord_addr
             # The native transport reads knobs from the environment only;
             # export the merged config so TOML-persisted values reach children.
             env.setdefault("TPU_MPI_MAX_FRAME_BYTES", str(cfg.max_frame_bytes))
@@ -137,7 +165,8 @@ def launch_processes(path: str, nprocs: int,
                 p.kill()
         return code
     finally:
-        coord.close()
+        if coord is not None:
+            coord.close()
         for p in procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
@@ -153,7 +182,7 @@ def launch_processes(path: str, nprocs: int,
                     p.kill()
                     p.wait()
         from .backend import sweep_segments
-        sweep_segments(str(coord.port))
+        sweep_segments(coord_addr.rsplit(":", 1)[-1])
 
 
 def install_tpurun(command: str = "tpurun",
@@ -197,6 +226,22 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("--procs", action="store_true",
                    help="one OS process per rank over the native transport "
                         "(multi-host deployment shape) instead of rank threads")
+    p.add_argument("--world-size", type=int, default=None, metavar="N",
+                   help="total ranks across every host (multi-host --procs); "
+                        "default: -n (single-host world)")
+    p.add_argument("--rank-base", type=int, default=0, metavar="K",
+                   help="first world rank launched by this invocation "
+                        "(multi-host --procs)")
+    # no config default here: cfg.coordinator maps TPU_MPI_PROC_COORD, the
+    # env the launcher sets FOR children — a nested tpurun inheriting it
+    # would register with the parent job's coordinator
+    p.add_argument("--coordinator", default=None,
+                   metavar="HOST:PORT",
+                   help="join an existing rendezvous coordinator instead of "
+                        "creating one (hosts 2..H of a multi-host job)")
+    p.add_argument("--coord-port", type=int, default=0, metavar="P",
+                   help="fixed port for the coordinator this invocation "
+                        "creates (so other hosts can be pointed at it)")
     p.add_argument("--timeout", type=float, default=None,
                    help="abort the job after SECONDS")
     p.add_argument("script", help="Python script to run on every rank")
@@ -219,7 +264,13 @@ def main(argv: Optional[list[str]] = None) -> int:
     try:
         if args.procs:
             return launch_processes(args.script, args.np, args.script_args,
-                                    timeout=args.timeout, sim=args.sim)
+                                    timeout=args.timeout, sim=args.sim,
+                                    world_size=args.world_size,
+                                    rank_base=args.rank_base,
+                                    coordinator=args.coordinator,
+                                    coord_port=args.coord_port)
+        if args.world_size is not None or args.rank_base or args.coordinator:
+            raise MPIError("--world-size/--rank-base/--coordinator require --procs")
         launch_script(args.script, args.np, args.script_args, timeout=args.timeout)
     except SystemExit as e:
         if e.code is None:
