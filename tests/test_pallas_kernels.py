@@ -109,3 +109,57 @@ def test_ring_attention_matches_full_attention():
     p /= p.sum(axis=1, keepdims=True)
     expect = p @ v
     np.testing.assert_allclose(np.asarray(out), expect, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_reduce_scatter():
+    n = 4
+    mesh = _mesh(n)
+    rng = np.random.RandomState(3)
+    x = rng.randn(n, n * 50).astype(np.float32)   # each rank contributes n*50
+    out = _run(mesh, lambda v: pk.ring_reduce_scatter(v, "sum", axis="x"),
+               jnp.asarray(x.reshape(-1)))
+    got = np.asarray(out).reshape(n, 50)
+    total = x.sum(0).reshape(n, 50)               # block r belongs to rank r
+    for r in range(n):
+        np.testing.assert_allclose(got[r], total[r], rtol=1e-5)
+
+
+def test_pairwise_alltoall():
+    n = 4
+    mesh = _mesh(n)
+    per = 30
+    # rank s's block for dest d = 100*s + 10*d + arange(per)
+    x = np.zeros((n, n * per), np.float32)
+    for s in range(n):
+        for d in range(n):
+            x[s, d * per:(d + 1) * per] = 100 * s + 10 * d + np.arange(per)
+    out = _run(mesh, lambda v: pk.pairwise_alltoall(v, axis="x"),
+               jnp.asarray(x.reshape(-1)))
+    got = np.asarray(out).reshape(n, n * per)
+    for r in range(n):
+        for s in range(n):
+            np.testing.assert_array_equal(
+                got[r, s * per:(s + 1) * per],
+                100 * s + 10 * r + np.arange(per, dtype=np.float32))
+
+
+def test_ring_attention_causal():
+    n = 4
+    t_local, d = 8, 16
+    mesh = _mesh(n)
+    rng = np.random.RandomState(4)
+    t = n * t_local
+    q = rng.randn(t, d).astype(np.float32)
+    k = rng.randn(t, d).astype(np.float32)
+    v = rng.randn(t, d).astype(np.float32)
+
+    out = _run(mesh,
+               lambda a, b, c: pk.ring_attention(a, b, c, axis="x", causal=True),
+               jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+
+    s = (q @ k.T) / np.sqrt(d)
+    s = np.where(np.tril(np.ones((t, t), bool)), s, -np.inf)
+    p = np.exp(s - s.max(axis=1, keepdims=True))
+    p /= p.sum(axis=1, keepdims=True)
+    expect = p @ v
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=2e-4, atol=2e-5)

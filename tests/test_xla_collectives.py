@@ -137,3 +137,63 @@ def test_grad_through_collective(mesh8):
 
     g = jax.grad(loss)(jnp.arange(8.0))
     assert np.allclose(g, 2 * np.arange(8.0))
+
+
+def test_scatterv(mesh8):
+    # counts per rank, replicated flat send buffer; each rank's padded chunk
+    # holds its segment then zeros (static-shape *v contract)
+    counts = [1, 2, 3, 1, 4, 2, 1, 2]
+    total = sum(counts)
+    full = jnp.arange(float(total))
+    m = max(counts)
+    out = smap(mesh8, lambda v: xla.scatterv(v, counts, axis="x"),
+               P(), P("x"))(full)           # (8*m,) stacked padded chunks
+    got = np.asarray(out).reshape(8, m)
+    displs = np.concatenate([[0], np.cumsum(counts[:-1])])
+    for r in range(8):
+        np.testing.assert_array_equal(
+            got[r, :counts[r]], np.arange(displs[r], displs[r] + counts[r]))
+        assert np.all(got[r, counts[r]:] == 0)
+
+
+def test_gatherv(mesh8):
+    counts = [2, 1, 3, 2, 1, 2, 4, 1]
+    m = max(counts)
+    # each rank contributes a max-padded local block of `counts[rank]` valid rows
+    blocks = np.zeros((8, m), np.float32)
+    for r in range(8):
+        blocks[r, :counts[r]] = np.arange(counts[r]) + 10 * r
+    x = jnp.asarray(blocks.reshape(-1))
+    out = smap(mesh8, lambda v: xla.gatherv(v.reshape(m), counts, axis="x"),
+               P("x"), P())(x)
+    expect = np.concatenate([blocks[r, :counts[r]] for r in range(8)])
+    np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+def test_alltoallv(mesh8):
+    rng = np.random.default_rng(0)
+    counts = rng.integers(0, 4, size=(8, 8)).tolist()
+    # build each rank's flat send buffer in destination order
+    sends = []
+    for s in range(8):
+        segs = [1000 * s + 10 * d + np.arange(counts[s][d], dtype=np.float32)
+                for d in range(8)]
+        sends.append(np.concatenate(segs) if any(counts[s]) else
+                     np.zeros(0, np.float32))
+    width = max(len(b) for b in sends)
+    stacked = np.zeros((8, width), np.float32)
+    for s in range(8):
+        stacked[s, :len(sends[s])] = sends[s]
+    x = jnp.asarray(stacked.reshape(-1))
+
+    def body(v):
+        return xla.alltoallv(v.reshape(width), counts, axis="x")
+
+    out_len = max(sum(counts[s][d] for s in range(8)) for d in range(8))
+    out = np.asarray(smap(mesh8, body, P("x"), P("x"))(x)).reshape(8, out_len)
+    for r in range(8):
+        expect = np.concatenate(
+            [1000 * s + 10 * r + np.arange(counts[s][r], dtype=np.float32)
+             for s in range(8)] or [np.zeros(0, np.float32)])
+        np.testing.assert_array_equal(out[r, :len(expect)], expect)
+        assert np.all(out[r, len(expect):] == 0)

@@ -13,7 +13,8 @@ The reference's entire call stack (user â†’ Allreduce! â†’ Buffer/Op/Datatype â†
 """
 
 from .mesh import (comm_mesh, local_device_count, make_mesh, world_mesh)
-from .collectives import (allgather, allgatherv, allreduce, alltoall, barrier,
-                          bcast, exscan, gather, rank, reduce, reduce_scatter,
-                          ring_shift, scan, scatter, sendrecv, size)
+from .collectives import (allgather, allgatherv, allreduce, alltoall,
+                          alltoallv, barrier, bcast, exscan, gather, gatherv,
+                          rank, reduce, reduce_scatter, ring_shift, scan,
+                          scatter, scatterv, sendrecv, size)
 from . import pallas_kernels

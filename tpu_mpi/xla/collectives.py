@@ -22,6 +22,8 @@ from __future__ import annotations
 
 from typing import Any, Optional, Sequence, Union
 
+import numpy as np
+
 from ..operators import MAX, MIN, Op, PROD, SUM, as_op
 
 Axis = Union[str, Sequence[str]]
@@ -139,6 +141,98 @@ def allgatherv(x: Any, counts: Sequence[int], *, axis: str = "x"):
     g = lax.all_gather(jnp.pad(x, pad), axis)      # (n, m, ...)
     parts = [g[i, :int(c)] for i, c in enumerate(counts)]
     return _replicate(jnp.concatenate(parts, axis=0), axis)
+
+
+def gatherv(x: Any, counts: Sequence[int], *, root: int = 0, axis: str = "x"):
+    """Variable-count rooted gather (src/collective.jl:363-403). Rooted-ness
+    is a host-API concept — in-graph every rank holds the concatenated
+    result (the allgatherv path); ``root`` is accepted for API parity."""
+    return allgatherv(x, counts, axis=axis)
+
+
+def scatterv(x: Any, counts: Sequence[int], *, root: int = 0,
+             axis: str = "x"):
+    """Variable-count scatter (src/collective.jl:156-196) under the
+    static-shape regime: ``x`` is the replicated flat send buffer; every
+    rank gets a max(counts)-sized chunk whose first counts[rank] elements
+    are its segment and the rest zeros (SURVEY.md §2.3: '*v' needs
+    max-padding + per-rank slice sizes)."""
+    import jax.numpy as jnp
+    lax = _lax()
+    counts = [int(c) for c in counts]
+    n = size(axis)
+    if len(counts) != n:
+        raise ValueError(f"scatterv: {len(counts)} counts for {n} ranks")
+    if sum(counts) > x.shape[0]:
+        raise ValueError(f"scatterv: counts sum to {sum(counts)} but the "
+                         f"send buffer holds {x.shape[0]}")
+    m = max(counts)
+    displs = np.concatenate([[0], np.cumsum(counts[:-1])]).astype(np.int32)
+    idx = lax.axis_index(axis)
+    start = jnp.asarray(displs)[idx]
+    ln = jnp.asarray(np.asarray(counts, np.int32))[idx]
+    xpad = jnp.pad(x, [(0, m)] + [(0, 0)] * (x.ndim - 1))
+    chunk = lax.dynamic_slice_in_dim(xpad, start, m, axis=0)
+    keep = jnp.arange(m) < ln
+    return jnp.where(keep.reshape((m,) + (1,) * (x.ndim - 1)), chunk, 0)
+
+
+def alltoallv(x: Any, counts: Sequence[Sequence[int]], *, axis: str = "x"):
+    """Variable-count all-to-all (src/collective.jl:545-578), the EP
+    token-routing primitive (SURVEY.md §2.5). ``counts[s][d]`` = elements
+    rank s sends to rank d (a static table — XLA needs static shapes, so
+    the counts are compile-time, exactly the capacity-bound EP regime).
+
+    ``x`` is the flat local send buffer laid out in destination order
+    (segment d at offset sum(counts[rank][:d])). Returns a flat buffer of
+    static length max_r(total received by r); rank r's first
+    sum_s(counts[s][r]) elements are its segments in source order, the
+    rest zeros."""
+    import jax.numpy as jnp
+    lax = _lax()
+    counts = [[int(c) for c in row] for row in counts]
+    n = size(axis)
+    if len(counts) != n or any(len(row) != n for row in counts):
+        raise ValueError(f"alltoallv: counts must be {n}x{n} "
+                         f"(got {len(counts)}x{min(map(len, counts))})")
+    if any(sum(row) > x.shape[0] for row in counts):
+        raise ValueError("alltoallv: a rank's send counts exceed the send "
+                         f"buffer length {x.shape[0]}")
+    idx = lax.axis_index(axis)
+    m = max(max(row) for row in counts)             # block pad
+    sdispls = np.zeros((n, n), np.int32)            # [s][d] send offset
+    for s in range(n):
+        sdispls[s, 1:] = np.cumsum(counts[s][:-1])
+    rdispls = np.zeros((n, n), np.int32)            # [s][d] recv offset at d
+    for d in range(n):
+        acc = 0
+        for s in range(n):
+            rdispls[s, d] = acc
+            acc += counts[s][d]
+    xpad = jnp.pad(x, [(0, m)] + [(0, 0)] * (x.ndim - 1))
+    lens = jnp.asarray(np.asarray(counts, np.int32))   # [s][d]
+    blocks = []
+    for d in range(n):
+        st = jnp.asarray(sdispls[:, d])[idx]
+        blk = lax.dynamic_slice_in_dim(xpad, st, m, axis=0)
+        keep = jnp.arange(m) < lens[idx, d]
+        blocks.append(jnp.where(
+            keep.reshape((m,) + (1,) * (x.ndim - 1)), blk, 0))
+    stacked = jnp.stack(blocks)                        # (n, m, ...)
+    recv = lax.all_to_all(stacked, axis, split_axis=0, concat_axis=0,
+                          tiled=False)                 # (n, m, ...) by source
+    total_r = [sum(counts[s][d] for s in range(n)) for d in range(n)]
+    out_len = max(total_r)
+    out = jnp.zeros((out_len,) + x.shape[1:], x.dtype)
+    pos = jnp.arange(m)
+    for s in range(n):
+        st = jnp.asarray(rdispls[s, :])[idx]
+        keep = pos < lens[s, idx]
+        seg = jnp.where(keep.reshape((m,) + (1,) * (x.ndim - 1)), recv[s], 0)
+        # disjoint valid regions → scatter-add places each source segment at
+        # its displacement without a dynamic-length slice
+        out = out.at[st + pos].add(seg, mode="drop")
+    return out
 
 
 def scatter(x: Any, *, root: int = 0, axis: str = "x"):

@@ -75,6 +75,27 @@ def _from_tile(tile, shape, size: int):
     return tile.reshape(-1)[:size].reshape(shape)
 
 
+def _to_block_tile(x, n: int):
+    """Per-rank-block layout: x (size divisible by n) viewed as n equal
+    blocks, each padded independently to a SUBLANE-aligned (rows_b, LANE)
+    tile, concatenated to (n*rows_b, LANE). Unlike _to_tile (end-padding),
+    block boundaries land exactly on chunk boundaries — what Reduce_scatter
+    and Alltoall semantics need (rank i's block = x[i*per:(i+1)*per])."""
+    import jax.numpy as jnp
+    flat = x.reshape(-1)
+    if flat.size % n:
+        raise ValueError(f"size {flat.size} not divisible by {n} ranks")
+    per = flat.size // n
+    rows = -(-per // LANE)
+    rows_b = -(-rows // SUBLANE) * SUBLANE
+    blocks = flat.reshape(n, per)
+    pad = rows_b * LANE - per
+    if pad:
+        blocks = jnp.concatenate(
+            [blocks, jnp.zeros((n, pad), flat.dtype)], axis=1)
+    return blocks.reshape(n * rows_b, LANE), per, rows_b
+
+
 def _neighbor_barrier(my, n: int):
     """Barrier with both ring neighbors. Run before each ring step's DMA: a
     send into a neighbor's double-buffer slot is only safe once the neighbor
@@ -235,6 +256,138 @@ def ring_allreduce(x, op: Any = "sum", *, axis: str = "x",
 
 
 # ---------------------------------------------------------------------------
+# ring reduce-scatter (the first half of the ring allreduce, standalone:
+# the gradient-sharding primitive of ZeRO/FSDP-style data parallelism)
+# ---------------------------------------------------------------------------
+
+def _ring_reduce_scatter_kernel(n: int, chunk: int, combine: Callable,
+                                axis: str, local_ref, out_ref, acc_ref,
+                                comm_ref, send_sem, recv_sem):
+    import jax
+    pl, pltpu = _pl(), _pltpu()
+    my = jax.lax.axis_index(axis)
+    acc_ref[:] = local_ref[:]
+    # start at (my-1) so after n-1 hops the fully-reduced chunk lands on
+    # index `my` (MPI Reduce_scatter_block: rank i owns block i)
+    idx = (my - 1) % n
+    for step in range(n - 1):
+        s, r = step % 2, (step + 1) % 2
+        _neighbor_barrier(my, n)
+        comm_ref[s] = acc_ref[pl.ds(idx * chunk, chunk), :]
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_ref.at[s],
+            dst_ref=comm_ref.at[r],
+            send_sem=send_sem.at[s],
+            recv_sem=recv_sem.at[r],
+            device_id=(my + 1) % n,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        idx = (idx - 1) % n
+        acc_ref[pl.ds(idx * chunk, chunk), :] = combine(
+            acc_ref[pl.ds(idx * chunk, chunk), :], comm_ref[r])
+    out_ref[:] = acc_ref[pl.ds(my * chunk, chunk), :]
+
+
+def ring_reduce_scatter(x, op: Any = "sum", *, axis: str = "x",
+                        interpret: Optional[bool] = None):
+    """Reduce_scatter over an RDMA ring ((n-1)/n·bytes on the wire): every
+    rank contributes the full x (size divisible by n) and receives block
+    `rank` of the elementwise reduction — the XLA-tier psum_scatter
+    (xla/collectives.py reduce_scatter) written natively against the ICI.
+    Returns a flat (x.size/n,) array."""
+    import jax
+    pl, pltpu = _pl(), _pltpu()
+    n = jax.lax.axis_size(axis)
+    if n == 1:
+        return x.reshape(-1)
+    tile, per, rows_b = _to_block_tile(x, n)
+    kern = functools.partial(_ring_reduce_scatter_kernel, n, rows_b,
+                             _combine_fn(op), axis)
+    out = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((rows_b, LANE), tile.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((n * rows_b, LANE), tile.dtype),   # accumulator
+            pltpu.VMEM((2, rows_b, LANE), tile.dtype),    # comm double buffer
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=_interpret(interpret),
+        compiler_params=pltpu.CompilerParams(collective_id=4),
+    )(tile)
+    return out.reshape(-1)[:per]
+
+
+# ---------------------------------------------------------------------------
+# pairwise all-to-all (direct RDMA between every pair — one hop per block,
+# versus a ring's k-hop forwarding; the Ulysses/EP reshard primitive)
+# ---------------------------------------------------------------------------
+
+def _alltoall_kernel(n: int, chunk: int, axis: str, local_ref, out_ref,
+                     send_sem, recv_sem):
+    import jax
+    pl, pltpu = _pl(), _pltpu()
+    my = jax.lax.axis_index(axis)
+    out_ref[pl.ds(my * chunk, chunk), :] = local_ref[pl.ds(my * chunk, chunk), :]
+    # one all-pairs barrier: every peer must have entered the kernel (its
+    # out_ref allocated) before anyone's direct Put lands
+    bar = pltpu.get_barrier_semaphore()
+    for d in range(1, n):
+        pltpu.semaphore_signal(bar, inc=1, device_id=(my + d) % n,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(bar, n - 1)
+    # fire all n-1 puts concurrently; per-distance semaphore slots so no
+    # reuse hazard and no per-step ordering
+    rdmas = []
+    for k in range(1, n):
+        dst = (my + k) % n
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=local_ref.at[pl.ds(dst * chunk, chunk), :],
+            dst_ref=out_ref.at[pl.ds(my * chunk, chunk), :],
+            send_sem=send_sem.at[k - 1],
+            recv_sem=recv_sem.at[k - 1],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdmas.append(rdma)
+    for rdma in rdmas:
+        rdma.wait()
+
+
+def pairwise_alltoall(x, *, axis: str = "x", interpret: Optional[bool] = None):
+    """All-to-all block exchange via direct pairwise RDMA: x (size divisible
+    by n) is n destination blocks; the result's block s is what rank s sent
+    here (src/collective.jl:489-532 semantics, one ICI hop per block).
+    Returns a flat array of x.size with source-ordered blocks."""
+    import jax
+    pl, pltpu = _pl(), _pltpu()
+    n = jax.lax.axis_size(axis)
+    if n == 1:
+        return x.reshape(-1)
+    tile, per, rows_b = _to_block_tile(x, n)
+    kern = functools.partial(_alltoall_kernel, n, rows_b, axis)
+    out = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((n * rows_b, LANE), tile.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((n - 1,)),
+            pltpu.SemaphoreType.DMA((n - 1,)),
+        ],
+        interpret=_interpret(interpret),
+        compiler_params=pltpu.CompilerParams(collective_id=5),
+    )(tile)
+    blocks = out.reshape(n, rows_b * LANE)[:, :per]
+    return blocks.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
 # collective permute (compiled Put: the in-graph RMA / halo / pipeline hop)
 # ---------------------------------------------------------------------------
 
@@ -298,7 +451,7 @@ def collective_permute(x, perm: Sequence[int], *, axis: str = "x",
 # MXU computes blockwise attention with online softmax)
 # ---------------------------------------------------------------------------
 
-def _ring_attention_kernel(n: int, scale: float, axis: str,
+def _ring_attention_kernel(n: int, scale: float, axis: str, causal: bool,
                            q_ref, k_ref, v_ref, out_ref,
                            kv_comm, acc, m_ref, l_ref, send_sem, recv_sem):
     import jax
@@ -331,6 +484,13 @@ def _ring_attention_kernel(n: int, scale: float, axis: str,
         v = kv_comm[s, 1].astype(jnp.float32)
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if causal:
+            # the resident K/V block at this step originated on rank
+            # (my - step); mask keys whose global index exceeds the query's
+            src = (my - step) % n
+            qg = my * t + jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+            kg = src * t + jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+            scores = jnp.where(qg >= kg, scores, -jnp.inf)
         m_new = jnp.maximum(m_ref[:], jnp.max(scores, axis=1, keepdims=True))
         corr = jnp.exp(m_ref[:] - m_new)
         p = jnp.exp(scores - m_new)
@@ -343,12 +503,14 @@ def _ring_attention_kernel(n: int, scale: float, axis: str,
     out_ref[:] = (acc[:] / l_ref[:]).astype(out_ref.dtype)
 
 
-def ring_attention(q, k, v, *, axis: str = "x",
+def ring_attention(q, k, v, *, axis: str = "x", causal: bool = False,
                    interpret: Optional[bool] = None):
     """Fused blockwise attention over a sequence sharded along `axis`: each
     rank holds a (T_local, d) block of Q/K/V; K/V blocks rotate around the
     RDMA ring while the MXU consumes the resident block (online-softmax
-    accumulation), overlapping communication with compute. Non-causal.
+    accumulation), overlapping communication with compute. ``causal=True``
+    masks by global position (query i attends keys ≤ i across the whole
+    sharded sequence).
 
     The Pallas counterpart of tpu_mpi.parallel.ring.ring_attention
     (ppermute-based); the substrate demo SURVEY.md §5 requires. q/k/v:
@@ -366,7 +528,7 @@ def ring_attention(q, k, v, *, axis: str = "x",
         q, k, v = (jnp.concatenate([a, z], axis=1) for a in (q, k, v))
     dp = q.shape[1]
     scale = 1.0 / math.sqrt(d)
-    kern = functools.partial(_ring_attention_kernel, n, scale, axis)
+    kern = functools.partial(_ring_attention_kernel, n, scale, axis, causal)
     out = pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((t, dp), q.dtype),
