@@ -1,0 +1,15 @@
+"""Hello world: init, identify your rank, finalize.
+
+Run: tpurun --sim 4 examples/01-hello.py
+(the tpu_mpi analog of the reference's docs/examples/01-hello.jl)
+"""
+
+import tpu_mpi as MPI
+
+MPI.Init()
+
+comm = MPI.COMM_WORLD
+print(f"Hello world, I am rank {MPI.Comm_rank(comm)} of {MPI.Comm_size(comm)}")
+MPI.Barrier(comm)
+
+MPI.Finalize()

@@ -1,0 +1,21 @@
+"""Reduce: sum every rank's value at the root; custom ops are any
+jittable/callable binary function.
+
+Run: tpurun --sim 4 examples/03-reduce.py
+(the tpu_mpi analog of the reference's docs/examples/03-reduce.jl)
+"""
+
+import tpu_mpi as MPI
+
+MPI.Init()
+
+comm = MPI.COMM_WORLD
+root = 0
+r = MPI.Comm_rank(comm)
+
+sr = MPI.Reduce(r, MPI.SUM, root, comm)
+
+if r == root:
+    print(f"sum of ranks = {sr}")
+
+MPI.Finalize()

@@ -1,0 +1,33 @@
+"""Nonblocking ring exchange: every rank Isends to its right neighbor and
+Irecvs from its left, then waits on both requests.
+
+Run: tpurun --sim 4 examples/04-sendrecv.py
+(the tpu_mpi analog of the reference's docs/examples/04-sendrecv.jl)
+"""
+
+import numpy as np
+
+import tpu_mpi as MPI
+
+MPI.Init()
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+size = MPI.Comm_size(comm)
+
+dst = (rank + 1) % size
+src = (rank - 1) % size
+
+N = 4
+send_mesg = np.full(N, float(rank))
+recv_mesg = np.zeros(N)
+
+rreq = MPI.Irecv(recv_mesg, src, src + 32, comm)
+print(f"{rank}: Sending   {rank} -> {dst} = {send_mesg}")
+sreq = MPI.Isend(send_mesg, dst, rank + 32, comm)
+
+MPI.Waitall([rreq, sreq])
+print(f"{rank}: Received {src} -> {rank} = {recv_mesg}")
+assert np.all(recv_mesg == src)
+
+MPI.Finalize()

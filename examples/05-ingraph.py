@@ -1,0 +1,39 @@
+"""The TPU-native face: the same collectives as compiled XLA ops inside
+jit/shard_map over a device mesh — zero host round-trips, differentiable,
+overlappable with compute. This is where the framework outgrows the
+reference (whose collectives always cross the FFI boundary into libmpi).
+
+Run: tpurun --sim 8 examples/05-ingraph.py   (single rank drives the mesh)
+"""
+
+import numpy as np
+
+import tpu_mpi as MPI
+
+MPI.Init()
+
+comm = MPI.COMM_WORLD
+if MPI.Comm_rank(comm) == 0:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_mpi import xla
+
+    n = len(jax.devices())
+    mesh = xla.world_mesh("x")
+
+    @jax.jit
+    def step(x):
+        f = jax.shard_map(lambda v: xla.allreduce(v, MPI.SUM, axis="x"),
+                          mesh=mesh, in_specs=P("x"), out_specs=P())
+        return f(x)
+
+    x = jnp.arange(float(n * 4))
+    out = step(x)
+    expect = np.asarray(x).reshape(n, 4).sum(axis=0)
+    assert np.allclose(np.asarray(out), expect)
+    print(f"in-graph psum over {n} devices: {np.asarray(out)}")
+
+MPI.Barrier(comm)
+MPI.Finalize()

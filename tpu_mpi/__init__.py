@@ -74,3 +74,9 @@ from .onesided import (Accumulate, Fetch_and_op, Get, Get_accumulate,
 from .topology import (Cart_coords, Cart_create, Cart_get, Cart_rank,
                        Cart_shift, Cart_sub, CartComm, Cartdim_get,
                        Dims_create)
+def install_tpurun(*args, **kwargs):
+    """Install the ``tpurun`` wrapper executable (MPI.install_mpiexecjl
+    analog). Lazy import: eagerly importing .launcher here would put it in
+    sys.modules and make ``python -m tpu_mpi.launcher`` warn + re-execute."""
+    from .launcher import install_tpurun as _install
+    return _install(*args, **kwargs)
