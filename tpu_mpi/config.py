@@ -56,6 +56,10 @@ class Config:
     # same-host ranks through one-shot POSIX shm segments instead of the TCP
     # stream (the libmpi shared-memory-BTL analog); 0 disables the shm lane.
     shm_min_bytes: int = 1 << 18
+    # blocking-send flow control: a Send/send blocks while the destination's
+    # unexpected queue holds more than this many bytes (the rendezvous-
+    # protocol analog; Isend keeps buffered semantics). 0 disables.
+    send_highwater_bytes: int = 1 << 26
 
     def replace(self, **kw: Any) -> "Config":
         d = {f.name: getattr(self, f.name) for f in fields(self)}
@@ -74,6 +78,7 @@ _ENV_MAP = {
     "rendezvous_timeout": "TPU_MPI_RENDEZVOUS_TIMEOUT",
     "max_frame_bytes": "TPU_MPI_MAX_FRAME_BYTES",
     "shm_min_bytes": "TPU_MPI_SHM_MIN_BYTES",
+    "send_highwater_bytes": "TPU_MPI_SEND_HIGHWATER_BYTES",
 }
 
 _lock = threading.Lock()

@@ -190,12 +190,19 @@ def _my_mailbox(comm: Comm):
 
 
 def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
-          dtype: Optional[Datatype], kind: str) -> None:
+          dtype: Optional[Datatype], kind: str, block: bool = False) -> None:
     ctx, _ = require_env()
     ctx.check_failure()
     my_rank = comm.rank()
     msg = Message(my_rank, int(tag), comm.cid, payload, count, dtype, kind)
-    ctx.mailboxes[_resolve(comm, dest)].post(msg)
+    mb = ctx.mailboxes[_resolve(comm, dest)]
+    if block and hasattr(mb, "post_blocking"):
+        # flow control for blocking sends; only the thread tier has a local
+        # handle on the destination queue (the multi-process proxy inherits
+        # TCP's own backpressure on the wire)
+        mb.post_blocking(msg, "Send")
+    else:
+        mb.post(msg)
 
 
 # ---------------------------------------------------------------------------
@@ -205,25 +212,36 @@ def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
 def Send(buf: Any, dest: int, tag: int, comm: Comm) -> None:
     """Blocking typed send (src/pointtopoint.jl:179-200); scalars welcome.
 
-    Buffered-send semantics: the payload is snapshotted at call time and the
-    call returns immediately (libmpi may do the same for small messages)."""
+    The payload is snapshotted at call time; the call returns once the
+    destination's unexpected queue has room (flow control — the rendezvous
+    analog; small/first messages complete immediately, libmpi-eager style)."""
     if dest == PROC_NULL:
         return
     count = element_count(buf)
     arr = to_wire(buf, count)
-    _post(comm, dest, tag, arr, count, to_datatype(arr.dtype), "typed")
+    _post(comm, dest, tag, arr, count, to_datatype(arr.dtype), "typed",
+          block=True)
 
 
 def Isend(buf: Any, dest: int, tag: int, comm: Comm) -> Request:
-    """Nonblocking send (src/pointtopoint.jl:226-239); completes immediately."""
+    """Nonblocking send (src/pointtopoint.jl:226-239); completes immediately
+    — buffered semantics, never subject to the blocking-send flow control
+    (an Isend that blocked could deadlock MPI-legal exchange patterns)."""
     if dest == PROC_NULL:
         return Request("null", status=STATUS_EMPTY)
-    Send(buf, dest, tag, comm)
+    count = element_count(buf)
+    arr = to_wire(buf, count)
+    _post(comm, dest, tag, arr, count, to_datatype(arr.dtype), "typed")
     return Request("send", buffer=buf, status=STATUS_EMPTY)
 
 
 def send(obj: Any, dest: int, tag: int, comm: Comm) -> None:
-    """Serialized-object send (src/pointtopoint.jl:208-211)."""
+    """Serialized-object send (src/pointtopoint.jl:208-211); blocking, so
+    subject to the same flow control as Send."""
+    _send_obj(obj, dest, tag, comm, block=True)
+
+
+def _send_obj(obj: Any, dest: int, tag: int, comm: Comm, block: bool) -> None:
     if dest == PROC_NULL:
         return
     try:
@@ -232,14 +250,15 @@ def send(obj: Any, dest: int, tag: int, comm: Comm) -> None:
         # In-process transport: unpicklable objects travel by reference
         # (the multi-process mailbox proxy rejects this kind with a clear
         # error — no shared address space there).
-        _post(comm, dest, tag, obj, 0, None, "objref")
+        _post(comm, dest, tag, obj, 0, None, "objref", block=block)
         return
-    _post(comm, dest, tag, data, len(data), None, "object")
+    _post(comm, dest, tag, data, len(data), None, "object", block=block)
 
 
 def isend(obj: Any, dest: int, tag: int, comm: Comm) -> Request:
-    """Nonblocking serialized send (src/pointtopoint.jl:249-252)."""
-    send(obj, dest, tag, comm)
+    """Nonblocking serialized send (src/pointtopoint.jl:249-252); buffered,
+    never blocks (see Isend)."""
+    _send_obj(obj, dest, tag, comm, block=False)
     return Request("send", status=STATUS_EMPTY)
 
 
