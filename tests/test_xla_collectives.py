@@ -197,3 +197,32 @@ def test_alltoallv(mesh8):
              for s in range(8)] or [np.zeros(0, np.float32)])
         np.testing.assert_array_equal(out[r, :len(expect)], expect)
         assert np.all(out[r, len(expect):] == 0)
+
+
+def test_allreduce_prod_native_signs_and_zeros(mesh8):
+    # float PROD lowers natively (log/exp + sign parity); negatives, zeros
+    # and mixed magnitudes must all come out right
+    vals = np.array([2.0, -3.0, 0.5, -1.0, 4.0, -0.25, 1.5, -2.0],
+                    dtype=np.float32)
+    f = smap(mesh8, lambda v: xla.allreduce(v, MPI.PROD, axis="x"),
+             P("x"), P())
+    out = f(jnp.asarray(vals))
+    np.testing.assert_allclose(np.asarray(out), [np.prod(vals)], rtol=1e-5)
+
+    withzero = vals.copy()
+    withzero[3] = 0.0
+    out = f(jnp.asarray(withzero))
+    np.testing.assert_array_equal(np.asarray(out), [0.0])
+
+
+def test_allreduce_logical_ops(mesh8):
+    x = jnp.asarray(np.array([1, 0, 1, 1, 0, 1, 1, 1], dtype=np.int32))
+    land = smap(mesh8, lambda v: xla.allreduce(v, MPI.LAND, axis="x"),
+                P("x"), P())(x)
+    lor = smap(mesh8, lambda v: xla.allreduce(v, MPI.LOR, axis="x"),
+               P("x"), P())(x)
+    lxor = smap(mesh8, lambda v: xla.allreduce(v, MPI.LXOR, axis="x"),
+                P("x"), P())(x)
+    assert np.asarray(land) == [0]     # one rank holds 0
+    assert np.asarray(lor) == [1]
+    assert np.asarray(lxor) == [0]     # six ones -> even parity

@@ -206,3 +206,171 @@ def transformer_train_step(cfg: TransformerConfig, mesh, lr: float = 1e-2, *,
         in_specs=(specs, data_spec, data_spec),
         out_specs=(specs, P())))
     return step, specs
+
+
+# ---------------------------------------------------------------------------
+# pipeline x expert-parallel variant: the remaining two axes of the 5-way
+# parallelism matrix (SURVEY.md §2.5 rows PP and EP), composed in one step
+# ---------------------------------------------------------------------------
+
+def transformer_pp_moe_init(key, cfg: TransformerConfig, n_experts: int) -> dict:
+    """Layer-stacked params for the pipelined MoE transformer: every layer
+    tensor carries a leading (n_layers,) dim (sharded over 'pp'); the expert
+    FFN weights add an (n_experts,) dim (sharded over 'ep')."""
+    def dense(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    d, f, L, E = cfg.d_model, cfg.d_ff, cfg.n_layers, n_experts
+    keys = jax.random.split(key, 6)
+    return {
+        "embed": dense(keys[0], (cfg.vocab, d), d ** -0.5),
+        "ln_f": jnp.ones((d,), cfg.dtype),
+        "ln1": jnp.ones((L, d), cfg.dtype),
+        "w_qkv": dense(keys[1], (L, d, 3 * d), d ** -0.5),
+        "w_proj": dense(keys[2], (L, d, d), (2 * d * L) ** -0.5),
+        "ln2": jnp.ones((L, d), cfg.dtype),
+        "w_gate": dense(keys[3], (L, d, E), d ** -0.5),
+        "w_in": dense(keys[4], (L, E, d, f), d ** -0.5),
+        "w_out": dense(keys[5], (L, E, f, d), (2 * f * L) ** -0.5),
+    }
+
+
+def transformer_pp_moe_specs(pp_axis: str, ep_axis: str) -> dict:
+    """PartitionSpecs matching transformer_pp_moe_init."""
+    lyr = P(pp_axis)
+    return {
+        "embed": P(), "ln_f": P(),
+        "ln1": lyr, "w_qkv": lyr, "w_proj": lyr, "ln2": lyr,
+        "w_gate": lyr,
+        "w_in": P(pp_axis, ep_axis), "w_out": P(pp_axis, ep_axis),
+    }
+
+
+def _pp_moe_stage(cfg: TransformerConfig, n_experts: int, ep_axis: str,
+                  capacity: int, stage_params: dict, x: jnp.ndarray,
+                  positions: jnp.ndarray) -> jnp.ndarray:
+    """One pipeline stage: this rank's block of layers, each a causal dense
+    attention plus a top-1 MoE FFN routed over the 'ep' axis."""
+    from ..parallel.ep import moe_dispatch_combine
+
+    b, t, d = x.shape
+    h, dh = cfg.n_heads, cfg.head_dim
+    L_local = stage_params["w_qkv"].shape[0]
+    for i in range(L_local):
+        # -- attention (heads local: this config spends its devices on pp/ep)
+        y = _rms_norm(x, stage_params["ln1"][i])
+        qkv = (y @ stage_params["w_qkv"][i]).reshape(b, t, h, 3, dh)
+        qkv = qkv.transpose(0, 2, 1, 3, 4)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        q, k = _rope(q, positions), _rope(k, positions)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q * dh ** -0.5, k)
+        mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+        s = jnp.where(mask, s, -1e30)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, d)
+        x = x + o @ stage_params["w_proj"][i]
+
+        # -- MoE FFN: route each token to its argmax expert over 'ep';
+        # Switch-style scaling by the selected gate probability keeps the
+        # router differentiable (argmax alone would never train w_gate)
+        y = _rms_norm(x, stage_params["ln2"][i]).reshape(b * t, d)
+        gate = jax.nn.softmax(y @ stage_params["w_gate"][i], axis=-1)
+        eidx = jnp.argmax(gate, axis=-1)
+        p_sel = jnp.take_along_axis(gate, eidx[:, None], axis=-1)
+        w_in = stage_params["w_in"][i, 0]      # this rank's expert shard
+        w_out = stage_params["w_out"][i, 0]
+
+        def expert(tok):
+            return jax.nn.gelu(tok @ w_in) @ w_out
+
+        out = moe_dispatch_combine(y, eidx.astype(jnp.int32), expert,
+                                   capacity=capacity, axis=ep_axis)
+        x = x + (out * p_sel).reshape(b, t, d)
+    return x
+
+
+def transformer_pp_moe_train_step(cfg: TransformerConfig, mesh,
+                                  n_experts: int, lr: float = 1e-2, *,
+                                  dp_axis: str = "dp", pp_axis: str = "pp",
+                                  ep_axis: str = "ep",
+                                  microbatches: Optional[int] = None):
+    """Jitted DP × PP × EP train step: batch sharded over 'dp', layers
+    sharded over 'pp' (GPipe microbatch rotation via
+    tpu_mpi.parallel.pp.pipeline_forward), expert FFNs sharded over 'ep'
+    (padded-all_to_all routing via tpu_mpi.parallel.ep). Together with
+    transformer_train_step (DP × TP × SP) this covers the full 5-axis
+    parallelism matrix of SURVEY.md §2.5.
+
+    Returns (step, param_specs); step(params, tokens, labels) -> (params,
+    loss). n_experts must equal the 'ep' axis size (one expert per rank).
+    """
+    from ..parallel.pp import pipeline_forward
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    for a in (dp_axis, pp_axis, ep_axis):
+        if a not in sizes:
+            raise ValueError(f"mesh is missing axis {a!r}")
+    if n_experts != sizes[ep_axis]:
+        raise ValueError(f"n_experts={n_experts} must equal the {ep_axis!r} "
+                         f"axis size {sizes[ep_axis]}")
+    if cfg.n_layers % sizes[pp_axis]:
+        raise ValueError(f"n_layers={cfg.n_layers} must divide over "
+                         f"{sizes[pp_axis]} pipeline stages")
+    n_pp = sizes[pp_axis]
+    m = microbatches or max(2, 2 * n_pp)
+    specs = transformer_pp_moe_specs(pp_axis, ep_axis)
+
+    def local_step(params, tokens, labels):
+        b, t = tokens.shape
+        if b % m:
+            raise ValueError(f"local batch {b} must divide into {m} microbatches")
+        positions = jnp.arange(t)
+        capacity = max(1, 2 * (b // m) * t // n_experts)
+
+        def loss_fn(p):
+            stage = {k: p[k] for k in
+                     ("ln1", "w_qkv", "w_proj", "ln2", "w_gate",
+                      "w_in", "w_out")}
+            e = p["embed"][tokens].reshape(m, b // m, t, cfg.d_model)
+
+            def stage_fn(sp_, x):
+                return _pp_moe_stage(cfg, n_experts, ep_axis,
+                                     capacity, sp_, x, positions)
+
+            acts = pipeline_forward(stage_fn, stage, e, axis=pp_axis)
+            acts = acts.reshape(b, t, cfg.d_model)
+            logits = (_rms_norm(acts, p["ln_f"])
+                      @ p["embed"].T).astype(jnp.float32)
+            l = _xent(logits, labels)
+            # only the last stage's emissions are the real model output
+            last = lax.axis_index(pp_axis) == n_pp - 1
+            return lax.psum(jnp.where(last, l, 0.0), pp_axis)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+
+        def reduce_leaf(path_key, g):
+            if path_key in ("w_in", "w_out"):
+                # ep-sharded experts: each rank owns its expert's grads, but
+                # the batch is REPLICATED over ep — every replica's loss
+                # back-propagates through the same expert via the all_to_all
+                # transpose, so the raw grad is ep_size times the per-batch
+                # gradient; normalize or experts train at an inflated lr
+                return lax.psum(g, dp_axis) / sizes[ep_axis]
+            if path_key in ("embed", "ln_f"):
+                # fully replicated, with distinct per-stage contributions
+                return lax.pmean(lax.psum(g, (dp_axis, pp_axis)), ep_axis)
+            # pp-sharded, ep-replicated layer tensors
+            return lax.pmean(lax.psum(g, dp_axis), ep_axis)
+
+        grads = {k: reduce_leaf(k, g) for k, g in grads.items()}
+        params = jax.tree_util.tree_map(
+            lambda p_, g: (p_ - lr * g).astype(p_.dtype), params, grads)
+        loss = lax.pmean(lax.pmean(loss, dp_axis), ep_axis)
+        return params, loss
+
+    data_spec = P(dp_axis, None)
+    step = jax.jit(jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(specs, data_spec, data_spec),
+        out_specs=(specs, P())))
+    return step, specs

@@ -197,9 +197,12 @@ def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
     msg = Message(my_rank, int(tag), comm.cid, payload, count, dtype, kind)
     mb = ctx.mailboxes[_resolve(comm, dest)]
     if block and hasattr(mb, "post_blocking"):
-        # flow control for blocking sends; only the thread tier has a local
-        # handle on the destination queue (the multi-process proxy inherits
-        # TCP's own backpressure on the wire)
+        # Flow control for blocking sends. Thread tier only: the
+        # multi-process proxy ships the frame and returns — the receiving
+        # drainer reads every frame into the unexpected queue unconditionally
+        # (it also carries collective/abort frames and must not stall), so
+        # cross-process blocking sends remain unbounded-buffered. A
+        # receiver-side credit protocol is the known fix if this bites.
         mb.post_blocking(msg, "Send")
     else:
         mb.post(msg)
@@ -209,6 +212,13 @@ def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
 # Blocking / nonblocking send
 # ---------------------------------------------------------------------------
 
+def _send_typed(buf: Any, dest: int, tag: int, comm: Comm, block: bool) -> None:
+    count = element_count(buf)
+    arr = to_wire(buf, count)
+    _post(comm, dest, tag, arr, count, to_datatype(arr.dtype), "typed",
+          block=block)
+
+
 def Send(buf: Any, dest: int, tag: int, comm: Comm) -> None:
     """Blocking typed send (src/pointtopoint.jl:179-200); scalars welcome.
 
@@ -217,10 +227,7 @@ def Send(buf: Any, dest: int, tag: int, comm: Comm) -> None:
     analog; small/first messages complete immediately, libmpi-eager style)."""
     if dest == PROC_NULL:
         return
-    count = element_count(buf)
-    arr = to_wire(buf, count)
-    _post(comm, dest, tag, arr, count, to_datatype(arr.dtype), "typed",
-          block=True)
+    _send_typed(buf, dest, tag, comm, block=True)
 
 
 def Isend(buf: Any, dest: int, tag: int, comm: Comm) -> Request:
@@ -229,9 +236,7 @@ def Isend(buf: Any, dest: int, tag: int, comm: Comm) -> Request:
     (an Isend that blocked could deadlock MPI-legal exchange patterns)."""
     if dest == PROC_NULL:
         return Request("null", status=STATUS_EMPTY)
-    count = element_count(buf)
-    arr = to_wire(buf, count)
-    _post(comm, dest, tag, arr, count, to_datatype(arr.dtype), "typed")
+    _send_typed(buf, dest, tag, comm, block=False)
     return Request("send", buffer=buf, status=STATUS_EMPTY)
 
 
@@ -329,8 +334,9 @@ def _object_of(msg: Message) -> Any:
 
 def Sendrecv(sendbuf: Any, dest: int, sendtag: int,
              recvbuf: Any, src: int, recvtag: int, comm: Comm) -> Status:
-    """Combined send+receive (ref ``Sendrecv!`` :376-393); safe against
-    head-of-line blocking because sends are buffered."""
+    """Combined send+receive (ref ``Sendrecv!`` :376-393); deadlock-safe:
+    the receive posts first, and the flow-controlled Send always admits a
+    message that matches a posted receive."""
     rreq = Irecv(recvbuf, src, recvtag, comm) if src != PROC_NULL else None
     Send(sendbuf, dest, sendtag, comm)
     if rreq is None:
