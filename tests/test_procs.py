@@ -420,3 +420,30 @@ def test_slow_combine_does_not_false_positive_deadlock():
     assert res.returncode == 0, res.stderr + res.stdout
     for r in range(3):
         assert f"SLOW-OK-{r}" in res.stdout
+
+
+def test_debug_sequence_check_across_processes():
+    """TPU_MPI_DEBUG_SEQUENCE stamps every cross-process P2P frame; ordered
+    wire traffic passes the receiver's monotonic check."""
+    res = _run_procs("""
+        import os
+        os.environ["TPU_MPI_DEBUG_SEQUENCE"] = "1"
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank, size = comm.rank(), comm.size()
+        peer = (rank + 1) % size
+        src = (rank - 1) % size
+        for i in range(8):
+            MPI.Send(np.array([float(rank * 100 + i)]), peer, i, comm)
+        buf = np.zeros(1)
+        for i in range(8):
+            MPI.Recv(buf, src, i, comm)
+            assert buf[0] == src * 100 + i, (rank, i, buf)
+        print(f"SEQ-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """, nprocs=3)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(3):
+        assert f"SEQ-OK-{r}" in res.stdout

@@ -117,10 +117,12 @@ def collective_wait_limit(opname: str) -> Optional[float]:
 class Message:
     """An in-flight point-to-point message (typed buffer or serialized object)."""
 
-    __slots__ = ("src", "tag", "cid", "payload", "count", "dtype", "kind")
+    __slots__ = ("src", "tag", "cid", "payload", "count", "dtype", "kind",
+                 "seq")
 
     def __init__(self, src: int, tag: int, cid: int, payload: Any,
-                 count: int, dtype: Any, kind: str):
+                 count: int, dtype: Any, kind: str,
+                 seq: Optional[int] = None):
         self.src = src
         self.tag = tag
         self.cid = cid
@@ -128,6 +130,7 @@ class Message:
         self.count = count      # element count (typed) or byte length (object)
         self.dtype = dtype
         self.kind = kind        # "typed" | "object"
+        self.seq = seq          # debug sequence-check stamp (None = off)
 
 
 class PendingRecv:
@@ -168,6 +171,7 @@ class Mailbox(_Waitable):
         self.queue: list[Message] = []        # unexpected messages, FIFO
         self.recvs: list[PendingRecv] = []    # posted receives, FIFO
         self.queued_bytes = 0                 # unexpected-queue footprint
+        self._seq_seen: dict = {}             # (src, cid) -> last debug seq
 
     @staticmethod
     def _nbytes(msg: Message) -> int:
@@ -211,6 +215,22 @@ class Mailbox(_Waitable):
             self._post_locked(msg)
 
     def _post_locked(self, msg: Message) -> None:
+        if msg.seq is not None:
+            # debug sequence check (SURVEY.md §5 race detection): every
+            # sender stamps a per-(sender, cid) counter; delivery must see
+            # it strictly increasing — a reordered/duplicated/lost frame in
+            # any transport tier fails loudly here instead of corrupting
+            # matching order silently.
+            key = (msg.src, msg.cid)
+            last = self._seq_seen.get(key, 0)
+            if msg.seq != last + 1:
+                err = MPIError(
+                    f"P2P sequence violation from comm-rank {msg.src} "
+                    f"cid {msg.cid}: got #{msg.seq} after #{last} "
+                    f"(reordered, duplicated, or dropped message)")
+                self.ctx.fail(err)
+                raise err
+            self._seq_seen[key] = msg.seq
         for pr in self.recvs:
             if not pr.cancelled and pr.matches(msg):
                 self.recvs.remove(pr)
@@ -392,6 +412,9 @@ class SpmdContext:
             r: (tuple(range(size)), 0) for r in range(size)}
         self.parent_comm: dict[int, Any] = {}     # spawned rank -> intercomm
         self.spawn_argv: dict[int, list] = {}     # spawned rank -> its argv
+        # debug sequence-check counters: (dest_world, cid, src_comm_rank)
+        self._seq_counters: dict = {}
+        self._seq_lock = threading.Lock()
         self.spawned_threads: list[threading.Thread] = []
         self._spawn_lock = threading.Lock()
 

@@ -264,10 +264,24 @@ class _RemoteMailbox:
             raise MPIError(
                 "cannot send an unpicklable object to another process; "
                 "multi-process ranks do not share an address space")
+        if self.ctx.debug_seq:
+            # Stamp AND ship under one lock: a concurrent sender thread that
+            # stamped first must also hit the wire first, or the receiver's
+            # monotonic check would flag legal THREAD_MULTIPLE interleavings.
+            # Serializing sends per process is an acceptable debug-mode cost.
+            with self.ctx._seq_lock:
+                seq = self.ctx._seq_counters.get(
+                    (self.world_rank, msg.cid, msg.src), 0) + 1
+                self.ctx._seq_counters[(self.world_rank, msg.cid, msg.src)] = seq
+                self.ctx.send_frame(self.world_rank,
+                                    ("p2p", msg.src, msg.tag, msg.cid,
+                                     _pack(msg.payload), msg.count, msg.dtype,
+                                     msg.kind, seq))
+            return
         self.ctx.send_frame(self.world_rank,
                             ("p2p", msg.src, msg.tag, msg.cid,
                              _pack(msg.payload), msg.count, msg.dtype,
-                             msg.kind))
+                             msg.kind, None))
 
     def notify(self) -> None:  # failure broadcast reaches processes via abort
         pass
@@ -621,6 +635,9 @@ class ProcContext(SpmdContext):
         # world address table ("host:port" per rank) — the basis for
         # Comm_spawn world growth; empty when unknown (no spawn possible).
         self.addrs: list[str] = list(addrs or [])
+        # snapshot of the debug-sequence flag (read per message on the wire
+        # path; a config.load() there would take the config lock per send)
+        self.debug_seq = config.load().debug_sequence_check
         self._grow_lock = threading.Lock()
         self._spawned_procs: list = []
         self._cid_counter = itertools.count(0)
@@ -671,9 +688,9 @@ class ProcContext(SpmdContext):
     def _dispatch(self, src_world: int, item: Any) -> None:
         kind = item[0]
         if kind == "p2p":
-            _, src, tag, cid, payload, count, dtype, mkind = item
+            _, src, tag, cid, payload, count, dtype, mkind, seq = item
             msg = Message(src, tag, cid, _unpack(payload), count, dtype,
-                          mkind)
+                          mkind, seq=seq)
             self.mailboxes[self.local_rank].post(msg)
         elif kind == "coll":
             _, cid, rnd, src, opname, contrib = item

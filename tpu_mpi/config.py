@@ -60,6 +60,10 @@ class Config:
     # unexpected queue holds more than this many bytes (the rendezvous-
     # protocol analog; Isend keeps buffered semantics). 0 disables.
     send_highwater_bytes: int = 1 << 26
+    # debug mode (SURVEY §5 race detection): stamp every P2P message with a
+    # per-(sender, dest, cid) sequence number and fail loudly on any
+    # reordering/duplication/loss at delivery.
+    debug_sequence_check: bool = False
 
     def replace(self, **kw: Any) -> "Config":
         d = {f.name: getattr(self, f.name) for f in fields(self)}
@@ -79,6 +83,7 @@ _ENV_MAP = {
     "max_frame_bytes": "TPU_MPI_MAX_FRAME_BYTES",
     "shm_min_bytes": "TPU_MPI_SHM_MIN_BYTES",
     "send_highwater_bytes": "TPU_MPI_SEND_HIGHWATER_BYTES",
+    "debug_sequence_check": "TPU_MPI_DEBUG_SEQUENCE",
 }
 
 _lock = threading.Lock()

@@ -130,6 +130,40 @@ CAPABILITIES: dict[str, dict[str, float]] = {
 }
 
 
+def platform_probe() -> dict:
+    """One-shot platform report — the runtime analog of the reference's
+    build-time ``gen_consts`` probe (/root/reference/deps/gen_consts.jl:
+    compiled and executed under mpiexec to discover the ABI's constants).
+    Here the 'ABI' is the accelerator platform: backend, TPU generation,
+    device inventory with physical coords, torus bounds, process metadata,
+    and the generation's capability constants. ``tpurun --probe`` prints it
+    as JSON."""
+    report: dict = {
+        "backend": get_backend().name,
+        "library_version": Get_library_version(),
+        "api_version": list(Get_version()),
+        "generation": tpu_generation(),
+        "device_count": device_count(),
+        "ici_topology": (list(ici_topology()) if ici_topology() else None),
+        "capabilities": capabilities(),
+    }
+    try:
+        import jax
+        report["devices"] = [{
+            "id": d.id,
+            "kind": getattr(d, "device_kind", "?"),
+            "process": getattr(d, "process_index", 0),
+            "coords": (list(d.coords)
+                       if getattr(d, "coords", None) is not None else None),
+            "core_on_chip": getattr(d, "core_on_chip", None),
+        } for d in _devices()]
+        report["process_count"] = jax.process_count()
+        report["process_index"] = jax.process_index()
+    except Exception:
+        pass
+    return report
+
+
 def capabilities(generation: Optional[str] = None) -> dict[str, float]:
     """Capability row for a generation (default: the local chip; a modest
     v5e row when the generation is unknown so ratios stay computable)."""

@@ -244,10 +244,28 @@ def main(argv: Optional[list[str]] = None) -> int:
                         "creates (so other hosts can be pointed at it)")
     p.add_argument("--timeout", type=float, default=None,
                    help="abort the job after SECONDS")
-    p.add_argument("script", help="Python script to run on every rank")
+    p.add_argument("--probe", action="store_true",
+                   help="print the platform probe (backend, generation, "
+                        "topology, capabilities) as JSON and exit")
+    p.add_argument("script", nargs="?",
+                   help="Python script to run on every rank")
     p.add_argument("script_args", nargs=argparse.REMAINDER,
                    help="arguments passed to the script")
     args = p.parse_args(argv)
+
+    if args.probe:
+        import json
+        # same cpu-sim defaulting as a real launch, so the probe reports
+        # the platform a job would actually run on
+        if args.sim is None and cfg.backend == "cpu-sim":
+            args.sim = cfg.sim_devices
+        if args.sim is not None:
+            _force_sim_devices(args.sim)
+        from .implementations import platform_probe
+        print(json.dumps(platform_probe(), indent=2))
+        return 0
+    if args.script is None:
+        p.error("script is required (or use --probe)")
 
     if args.sim is None and config.load().backend == "cpu-sim":
         args.sim = config.load().sim_devices

@@ -194,6 +194,9 @@ def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
     ctx, _ = require_env()
     ctx.check_failure()
     my_rank = comm.rank()
+    # no seq stamp here: thread-tier delivery is atomic with ordering (one
+    # mailbox lock), so there is nothing to check and the hot path stays
+    # config-free; the wire proxy stamps under its own lock (backend.py)
     msg = Message(my_rank, int(tag), comm.cid, payload, count, dtype, kind)
     mb = ctx.mailboxes[_resolve(comm, dest)]
     if block and hasattr(mb, "post_blocking"):
