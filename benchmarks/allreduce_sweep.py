@@ -13,13 +13,20 @@ hardware allows:
   (``tpu_mpi.xla.pallas_kernels.ring_allreduce``), same bus-bandwidth
   accounting (needs >= 2 devices).
 
+- ``procs``  — the same host-path Allreduce across OS processes over the
+  native C++ transport (ring reduce-scatter+allgather above the size
+  threshold, star rendezvous below — the tier VERDICT r1 item 4 asked to
+  quantify). Runs via ``launch_processes``.
+
 Usage: python benchmarks/allreduce_sweep.py [--max-bytes N] [--ranks N]
-       [--lanes host,psum,pallas] [-o results/file.json]
+       [--lanes host,psum,pallas,procs] [-o results/file.json]
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import sys
 
 from common import best_block, detect_platform, emit, iters_for, size_sweep
@@ -148,13 +155,70 @@ def bench_pallas(sizes: list[int]) -> list[dict]:
                            repeats=1 if interp else REPEATS)
 
 
+def bench_procs(nranks: int, max_bytes: int) -> list[dict]:
+    """Cross-process Allreduce sweep: re-enter this script as an SPMD child
+    under launch_processes; rank 0 writes rows to --rows-out."""
+    import tempfile
+    from tpu_mpi.launcher import launch_processes
+
+    with tempfile.NamedTemporaryFile("r", suffix=".jsonl") as rows_f:
+        code = launch_processes(
+            os.path.abspath(__file__), nranks,
+            ["--max-bytes", str(max_bytes), "--rows-out", rows_f.name],
+            timeout=3600)
+        if code != 0:
+            print(f"procs lane failed with exit code {code}", file=sys.stderr)
+            return []
+        return [json.loads(l) for l in rows_f.read().splitlines()]
+
+
+def _procs_child(max_bytes: int, rows_out: str) -> None:
+    import time
+    import numpy as np
+    import tpu_mpi as MPI
+
+    MPI.Init()
+    comm = MPI.COMM_WORLD
+    rank = comm.rank()
+    with open(rows_out or os.devnull, "a") as f:
+        for nbytes in size_sweep(max_bytes):
+            n = max(1, nbytes // 4)
+            buf = np.ones(n, np.float32)
+            out = np.zeros(n, np.float32)
+            warmup, iters = iters_for(nbytes)
+            iters = max(2, iters // 4)       # wire rounds cost more
+            for _ in range(warmup):
+                MPI.Allreduce(buf, out, MPI.SUM, comm)
+            best = float("inf")
+            for _ in range(REPEATS):
+                MPI.Barrier(comm)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    MPI.Allreduce(buf, out, MPI.SUM, comm)
+                MPI.Barrier(comm)
+                best = min(best, (time.perf_counter() - t0) / iters)
+            if rank == 0:
+                row = {"bytes": n * 4, "lat_us": round(best * 1e6, 2),
+                       "algbw_gbps": round(n * 4 / best / 1e9, 3)}
+                f.write(json.dumps(row) + "\n")
+                f.flush()
+                print(f"procs {n * 4:>11d} B  {best * 1e6:>10.1f} us  "
+                      f"{row['algbw_gbps']:>8.3f} GB/s", file=sys.stderr)
+    MPI.Finalize()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-bytes", type=int, default=1 << 30)
     ap.add_argument("--ranks", type=int, default=4)
     ap.add_argument("--lanes", default="host,psum,pallas")
+    ap.add_argument("--rows-out", default=None, help=argparse.SUPPRESS)
     ap.add_argument("-o", "--out", default="-")
     args = ap.parse_args()
+
+    if os.environ.get("TPU_MPI_PROC_RANK") is not None:
+        _procs_child(args.max_bytes, args.rows_out)
+        return
 
     plat = detect_platform()
     sizes = size_sweep(args.max_bytes)
@@ -176,6 +240,8 @@ def main() -> None:
         sub = sizes[:2] if interp else (
             sizes[::4] + ([sizes[-1]] if (len(sizes) - 1) % 4 else []))
         record["lanes"]["pallas"] = bench_pallas(sub)
+    if "procs" in lanes:
+        record["lanes"]["procs"] = bench_procs(args.ranks, args.max_bytes)
     emit(args.out, record)
 
 
