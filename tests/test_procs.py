@@ -447,3 +447,83 @@ def test_debug_sequence_check_across_processes():
     assert res.returncode == 0, res.stderr + res.stdout
     for r in range(3):
         assert f"SEQ-OK-{r}" in res.stdout
+
+
+def test_cross_process_send_backpressure():
+    """Cross-process flow control: once the receiver's unexpected queue
+    crosses the high-water mark it chokes the sender (observable sender-
+    side); the choked blocking Send completes only after the receiver
+    drains. Handshake-sequenced — no wall-clock assumptions."""
+    res = _run_procs("""
+        import os, time
+        os.environ["TPU_MPI_SEND_HIGHWATER_BYTES"] = str(4 * 1600)  # 4 msgs
+        import numpy as np
+        import tpu_mpi as MPI
+        from tpu_mpi._runtime import require_env
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank = comm.rank()
+        ctx, me = require_env()
+        if rank == 0:
+            # receiver consumes nothing until it gets the go message, so
+            # these 10 x 1600B pile up past high=6400 and MUST trigger
+            # choke; buffered Isends so the choke cannot stall THIS loop
+            # (blocking Sends here would deadlock against the handshake)
+            reqs = [MPI.Isend(np.full(200, float(i)), 1, 5, comm)
+                    for i in range(10)]     # buffered: exempt, never stall
+            MPI.Waitall(reqs)
+            deadline = time.monotonic() + 60
+            while 1 not in ctx.choked_by and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert 1 in ctx.choked_by, "sender never choked"
+            MPI.isend("go", 1, 9, comm)        # exempt from flow control
+            MPI.Send(np.full(200, 10.0), 1, 5, comm)   # waits for drain
+            print("SENDER-DONE", flush=True)
+        else:
+            obj, _ = MPI.recv(0, 9, comm)      # only unblocks after choke
+            assert obj == "go"
+            buf = np.zeros(200)
+            for i in range(11):
+                MPI.Recv(buf, 0, 5, comm)
+                assert buf[0] == i, (i, buf[0])   # FIFO under flow control
+            print("RECV-DONE", flush=True)
+        MPI.Finalize()
+    """, nprocs=2)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "SENDER-DONE" in res.stdout and "RECV-DONE" in res.stdout
+
+
+def test_sendrecv_deadlock_free_under_choke():
+    """The paired-Sendrecv-while-choked scenario: both ranks park unexpected
+    Isend traffic above the high-water mark (choking each other), then do a
+    paired Sendrecv. Posting the unmatched receive unchokes the peer (the
+    cross-process posted-receive admission bypass), so the exchange
+    completes instead of a double DeadlockError."""
+    res = _run_procs("""
+        import os
+        os.environ["TPU_MPI_SEND_HIGHWATER_BYTES"] = str(2 * 1600)
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank = comm.rank()
+        peer = 1 - rank
+        # park unconsumed traffic well above high-water on BOTH sides
+        reqs = [MPI.Isend(np.full(200, float(i)), peer, 77, comm)
+                for i in range(6)]
+        MPI.Waitall(reqs)
+        MPI.Barrier(comm)
+        # paired blocking exchange must still complete
+        rbuf = np.zeros(4)
+        MPI.Sendrecv(np.full(4, float(rank)), peer, 3, rbuf, peer, 3, comm)
+        assert rbuf[0] == peer, rbuf
+        # drain the parked traffic
+        buf = np.zeros(200)
+        for i in range(6):
+            MPI.Recv(buf, peer, 77, comm)
+            assert buf[0] == i
+        print(f"SRDF-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """, nprocs=2)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "SRDF-OK-0" in res.stdout and "SRDF-OK-1" in res.stdout

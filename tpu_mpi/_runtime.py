@@ -172,6 +172,15 @@ class Mailbox(_Waitable):
         self.recvs: list[PendingRecv] = []    # posted receives, FIFO
         self.queued_bytes = 0                 # unexpected-queue footprint
         self._seq_seen: dict = {}             # (src, cid) -> last debug seq
+        # called (lock held) with queued_bytes after a queue removal; the
+        # multi-process backend hangs its unchoke logic here — hooks must
+        # not perform I/O (the lock is the drainer's delivery path)
+        self.drain_hook: Optional[Callable[[int], None]] = None
+        # called (lock held) when a receive is posted with no queued match:
+        # the receiver is actively waiting, possibly for a choked sender's
+        # message it cannot see — the backend unchokes everyone (restores
+        # the posted-receive admission bypass across processes)
+        self.pending_recv_hook: Optional[Callable[[], None]] = None
 
     @staticmethod
     def _nbytes(msg: Message) -> int:
@@ -253,8 +262,12 @@ class Mailbox(_Waitable):
                     pr.msg = m
                     pr.done = True
                     self.cond.notify_all()   # senders blocked on capacity
+                    if self.drain_hook is not None:
+                        self.drain_hook(self.queued_bytes)
                     return pr
             self.recvs.append(pr)
+            if self.pending_recv_hook is not None:
+                self.pending_recv_hook()
         return pr
 
     def wait_recv(self, pr: PendingRecv) -> Optional[Message]:

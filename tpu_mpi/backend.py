@@ -253,11 +253,32 @@ def _unpack(obj: Any) -> Any:
 
 
 class _RemoteMailbox:
-    """Sender-side proxy: post() ships the Message to the owning process."""
+    """Sender-side proxy: post() ships the Message to the owning process.
+
+    Flow control (the cross-process half of the blocking-send backpressure):
+    a receiver whose unexpected queue crosses the high-water mark sends a
+    ``choke`` frame; ``post_blocking`` waits while this destination has us
+    choked, resuming on its ``unchoke``. Buffered Isend traffic is exempt,
+    mirroring the thread tier."""
 
     def __init__(self, ctx: "ProcContext", world_rank: int):
         self.ctx = ctx
         self.world_rank = world_rank
+
+    def post_blocking(self, msg: Message, what: str) -> None:
+        import time as _time
+        from ._runtime import deadlock_timeout
+        ctx = self.ctx
+        deadline = _time.monotonic() + deadlock_timeout()
+        with ctx._choke_cond:
+            while self.world_rank in ctx.choked_by:
+                ctx.check_failure()
+                if _time.monotonic() > deadline:
+                    raise DeadlockError(
+                        f"deadlock suspected: rank {self.world_rank} kept "
+                        f"this sender choked >{deadlock_timeout()}s in {what}")
+                ctx._choke_cond.wait(0.02)
+        self.post(msg)
 
     def post(self, msg: Message) -> None:
         if msg.kind == "objref":
@@ -638,6 +659,12 @@ class ProcContext(SpmdContext):
         # snapshot of the debug-sequence flag (read per message on the wire
         # path; a config.load() there would take the config lock per send)
         self.debug_seq = config.load().debug_sequence_check
+        # cross-process flow control: peers that told us to stop blocking-
+        # sending to them (choke/unchoke frames), and the peers WE choked
+        self.choked_by: set[int] = set()
+        self._choke_cond = threading.Condition()
+        self._choked_peers: set[int] = set()
+        self._choke_high = config.load().send_highwater_bytes
         self._grow_lock = threading.Lock()
         self._spawned_procs: list = []
         self._cid_counter = itertools.count(0)
@@ -645,10 +672,51 @@ class ProcContext(SpmdContext):
             Mailbox(self) if r == local_rank else _RemoteMailbox(self, r)
             for r in range(size)
         ]
+        self._choke_peers_lock = threading.Lock()
+        # unchoke decisions are made under the mailbox lock but SENT from
+        # the drainer loop (never I/O under the lock that delivers frames)
+        self._pending_unchokes: set[int] = set()
+        self.mailboxes[local_rank].drain_hook = self._maybe_unchoke
+        self.mailboxes[local_rank].pending_recv_hook = self._unchoke_all
         self._drainer = threading.Thread(target=self._drain, daemon=True,
                                          name="tpu-mpi-drainer")
         self._drainer_stop = threading.Event()
         self._drainer.start()
+
+    def _maybe_unchoke(self, queued_bytes: int) -> None:
+        """Mailbox drain hook (lock held — no I/O): once the unexpected
+        queue falls to the low-water mark, queue every choked sender for an
+        unchoke frame; the drainer loop ships them."""
+        if queued_bytes > self._choke_high // 2:
+            return
+        self._unchoke_all()
+
+    def _unchoke_all(self) -> None:
+        """Queue unchoke frames for every choked peer (also the
+        pending-recv hook: a receiver waiting on an unmatched recv may be
+        waiting for a choked sender's message — release them all, the
+        cross-process analog of the thread tier's posted-receive
+        admission bypass)."""
+        with self._choke_peers_lock:
+            if not self._choked_peers:
+                return
+            self._pending_unchokes |= self._choked_peers
+            self._choked_peers = set()
+
+    def _flush_unchokes(self) -> None:
+        """Drainer-loop tail: ship queued unchoke frames. A failed unchoke
+        fate-shares — the peer would otherwise hang choked until a
+        misleading DeadlockError."""
+        with self._choke_peers_lock:
+            if not self._pending_unchokes:
+                return
+            peers, self._pending_unchokes = self._pending_unchokes, set()
+        for p in peers:
+            try:
+                self.send_frame(p, ("unchoke",))
+            except Exception as e:
+                self.fail(MPIError(
+                    f"could not unchoke rank {p}: {type(e).__name__}: {e}"))
 
     # -- frame transmit -------------------------------------------------------
     def shm_ok(self, world_dst: int) -> bool:
@@ -663,6 +731,7 @@ class ProcContext(SpmdContext):
     # -- frame pump -----------------------------------------------------------
     def _drain(self) -> None:
         while not self._drainer_stop.is_set():
+            self._flush_unchokes()
             try:
                 got = self.transport.recv(_POLL_MS)
             except ConnectionResetError:
@@ -691,7 +760,23 @@ class ProcContext(SpmdContext):
             _, src, tag, cid, payload, count, dtype, mkind, seq = item
             msg = Message(src, tag, cid, _unpack(payload), count, dtype,
                           mkind, seq=seq)
-            self.mailboxes[self.local_rank].post(msg)
+            mb = self.mailboxes[self.local_rank]
+            mb.post(msg)
+            # cross-process flow control: over the mark, tell this sender to
+            # pause its BLOCKING sends until we drain (drain_hook unchokes)
+            if self._choke_high > 0 and src_world != self.local_rank:
+                with self._choke_peers_lock:
+                    if (mb.queued_bytes > self._choke_high
+                            and src_world not in self._choked_peers):
+                        self._choked_peers.add(src_world)
+                        self.send_frame(src_world, ("choke",))
+        elif kind == "choke":
+            with self._choke_cond:
+                self.choked_by.add(src_world)
+        elif kind == "unchoke":
+            with self._choke_cond:
+                self.choked_by.discard(src_world)
+                self._choke_cond.notify_all()
         elif kind == "coll":
             _, cid, rnd, src, opname, contrib = item
             self._proc_channel(cid).deliver_contrib(rnd, src, opname,

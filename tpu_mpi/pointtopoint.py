@@ -200,12 +200,10 @@ def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
     msg = Message(my_rank, int(tag), comm.cid, payload, count, dtype, kind)
     mb = ctx.mailboxes[_resolve(comm, dest)]
     if block and hasattr(mb, "post_blocking"):
-        # Flow control for blocking sends. Thread tier only: the
-        # multi-process proxy ships the frame and returns — the receiving
-        # drainer reads every frame into the unexpected queue unconditionally
-        # (it also carries collective/abort frames and must not stall), so
-        # cross-process blocking sends remain unbounded-buffered. A
-        # receiver-side credit protocol is the known fix if this bites.
+        # Flow control for blocking sends. Thread tier: admission-checked
+        # against the destination queue under its lock. Multi-process tier:
+        # choke/unchoke credit frames from the receiver pause this sender
+        # while its unexpected queue is over the high-water mark.
         mb.post_blocking(msg, "Send")
     else:
         mb.post(msg)
