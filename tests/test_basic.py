@@ -90,3 +90,19 @@ def test_abort_releases_blocked_ranks(nprocs):
 
     with pytest.raises(MPI.AbortError):
         run_spmd(body, nprocs)
+
+
+def test_profile_trace(tmp_path):
+    """profile_trace wraps the JAX profiler; a trace directory appears with
+    XPlane artifacts for work issued inside the block (SURVEY §5 tracing)."""
+    import jax.numpy as jnp
+    import tpu_mpi as MPI
+
+    import os
+    logdir = str(tmp_path / "trace")
+    with MPI.profile_trace(logdir):
+        (jnp.arange(128.0) * 2).block_until_ready()
+    import glob
+    found = glob.glob(logdir + "/**", recursive=True)
+    assert any("plugins" in f or "xplane" in f or "trace" in f.lower()
+               for f in found if os.path.isfile(f)), found

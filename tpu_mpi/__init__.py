@@ -26,7 +26,7 @@ from .environment import (Abort, Finalize, Finalized, Init, Init_thread,
                           Initialized, Is_thread_main, Query_thread,
                           THREAD_FUNNELED, THREAD_MULTIPLE, THREAD_SERIALIZED,
                           THREAD_SINGLE, ThreadLevel, Wtick, Wtime, has_tpu,
-                          universe_size)
+                          profile_trace, universe_size)
 
 # Communicators (src/comm.jl)
 from .comm import (COMM_NULL, COMM_SELF, COMM_TYPE_SHARED, COMM_WORLD,

@@ -150,6 +150,32 @@ def Wtick() -> float:
     return info.resolution
 
 
+class profile_trace:
+    """Context manager wrapping the JAX profiler: collectives issued inside
+    the block are visible in the XPlane trace (view with TensorBoard or
+    xprof). The concrete form of SURVEY.md §5's tracing subsystem — the
+    reference has only Wtime/Wtick and points users at external PMPI tools;
+    here the XLA profiler *is* the communication profiler, since every
+    in-graph collective is an XLA op.
+
+    >>> with MPI.profile_trace("/tmp/trace"):
+    ...     step(params, batch)
+    """
+
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+
+    def __enter__(self):
+        import jax
+        jax.profiler.start_trace(self.logdir)
+        return self
+
+    def __exit__(self, *exc):
+        import jax
+        jax.profiler.stop_trace()
+        return False
+
+
 def universe_size() -> Optional[int]:
     """Max processes the runtime can host (src/comm.jl:171-181 attribute)."""
     ctx, _ = require_env()
