@@ -158,21 +158,33 @@ class profile_trace:
     here the XLA profiler *is* the communication profiler, since every
     in-graph collective is an XLA op.
 
+    The JAX profiler is a process singleton, so under the thread-rank tier
+    only one rank may trace at a time: by default only world rank 0 (or a
+    caller outside SPMD) actually starts it and the rest no-op, matching
+    how every rank can execute the same ``with`` block in an SPMD script.
+
     >>> with MPI.profile_trace("/tmp/trace"):
     ...     step(params, batch)
     """
 
-    def __init__(self, logdir: str):
+    def __init__(self, logdir: str, rank: int = 0):
         self.logdir = logdir
+        self.rank = rank
+        self._active = False
 
     def __enter__(self):
-        import jax
-        jax.profiler.start_trace(self.logdir)
+        env = current_env()
+        if env is None or env[1] == self.rank:
+            import jax
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
         return self
 
     def __exit__(self, *exc):
-        import jax
-        jax.profiler.stop_trace()
+        if self._active:
+            import jax
+            jax.profiler.stop_trace()
+            self._active = False
         return False
 
 
