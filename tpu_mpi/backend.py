@@ -442,7 +442,14 @@ class ProcChannel(_Waitable):
         n = len(self.group)
         was_jax = _is_jax(contrib)
         arr = np.asarray(contrib)
-        work = np.ascontiguousarray(arr).reshape(-1).copy()
+        if (arr.flags.writeable and arr.flags.c_contiguous
+                and arr.base is None and arr.flags.owndata):
+            # the Allreduce path hands us a private to_wire snapshot (host
+            # inputs are always copied there) — mutate it in place instead
+            # of a second payload-sized copy
+            work = arr.reshape(-1)
+        else:
+            work = np.ascontiguousarray(arr).reshape(-1).copy()
         base, rem = divmod(len(work), n)
         sizes = [base + (1 if i < rem else 0) for i in range(n)]
         offs = np.concatenate([[0], np.cumsum(sizes)])

@@ -263,18 +263,28 @@ def to_wire(x: Any, count: Optional[int] = None) -> Any:
     Host arrays are copied (the sender may mutate after a buffered Isend);
     device arrays are immutable so the reference is the snapshot — the zero-copy
     win of device-native buffers (SURVEY.md L5).
+
+    With ``count``, host snapshots come back FLAT and OWNING (base None,
+    owndata) in a single copy — downstream in-place consumers (the
+    multi-process ring allreduce) key their no-second-copy fast path on
+    those flags, and a flat view of a private copy would defeat it.
     """
     if isinstance(x, DeviceBuffer):
         arr = x.value
     elif is_jax_array(x):
         arr = x
     else:
-        arr = np.ascontiguousarray(np.asarray(x))
-        arr = arr.copy() if arr is x else arr
+        src = np.asarray(x)
+        if count is None:
+            arr = np.ascontiguousarray(src)
+            return arr.copy() if arr is src else arr
+        out = np.ravel(src)           # view (contiguous) or owning copy
+        if out.size != count:
+            out = out[:count]
+        if out.base is not None or out is src:
+            out = out.copy()          # the single snapshot copy
+        return out
     if count is not None:
-        # Hand out a flat view: collectives slice wire buffers by flat
-        # element offset regardless of the operand's rank. A 1-d exact-size
-        # array IS its own flat view — skip the reshape dispatch (hot lane).
         shape = arr.shape
         if len(shape) == 1 and shape[0] == count:
             return arr

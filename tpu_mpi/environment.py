@@ -158,10 +158,12 @@ class profile_trace:
     here the XLA profiler *is* the communication profiler, since every
     in-graph collective is an XLA op.
 
-    The JAX profiler is a process singleton, so under the thread-rank tier
-    only one rank may trace at a time: by default only world rank 0 (or a
-    caller outside SPMD) actually starts it and the rest no-op, matching
-    how every rank can execute the same ``with`` block in an SPMD script.
+    The JAX profiler is a process singleton: under the thread-rank tier only
+    the designated rank (default world rank 0) starts it and the rest no-op,
+    so every rank can execute the same ``with`` block. Under the
+    multi-process tier each rank IS its own process with its own profiler,
+    so every rank traces (per-host xplane files land side by side in
+    logdir). Callers outside SPMD always trace.
 
     >>> with MPI.profile_trace("/tmp/trace"):
     ...     step(params, batch)
@@ -174,7 +176,8 @@ class profile_trace:
 
     def __enter__(self):
         env = current_env()
-        if env is None or env[1] == self.rank:
+        multiproc = env is not None and getattr(env[0], "local_rank", None) is not None
+        if env is None or multiproc or env[1] == self.rank:
             import jax
             jax.profiler.start_trace(self.logdir)
             self._active = True
