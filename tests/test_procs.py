@@ -527,3 +527,49 @@ def test_sendrecv_deadlock_free_under_choke():
     """, nprocs=2)
     assert res.returncode == 0, res.stderr + res.stdout
     assert "SRDF-OK-0" in res.stdout and "SRDF-OK-1" in res.stdout
+
+
+def test_pairwise_alltoall_tier():
+    """Large Alltoall across processes takes the direct pairwise algorithm
+    (one hop per segment) and matches the star tier's semantics exactly."""
+    res = _run_procs("""
+        import os
+        os.environ["TPU_MPI_RING_MIN_BYTES"] = "64"   # force the alg tier
+        import numpy as np
+        import tpu_mpi as MPI
+        from tpu_mpi import backend as B
+        hits = []
+        orig = B.ProcChannel._run_pairwise_alltoall
+        B.ProcChannel._run_pairwise_alltoall = (
+            lambda self, *a, **k: (hits.append(1), orig(self, *a, **k))[1])
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank, size = comm.rank(), comm.size()
+        count = 50
+        send = np.concatenate(
+            [1000 * rank + 10 * d + np.arange(count, dtype=np.float64)
+             for d in range(size)])
+        recv = np.zeros(size * count)
+        MPI.Alltoall(send, recv, count, comm)
+        for s in range(size):
+            expect = 1000 * s + 10 * rank + np.arange(count, dtype=np.float64)
+            assert np.array_equal(recv[s*count:(s+1)*count], expect), (rank, s)
+        # IN_PLACE variant rides the same tier
+        buf = np.concatenate(
+            [1000 * rank + 10 * d + np.arange(count, dtype=np.float64)
+             for d in range(size)])
+        MPI.Alltoall(MPI.IN_PLACE, buf, count, comm)
+        assert np.array_equal(buf, recv)
+        assert len(hits) == 2, hits       # the pairwise tier actually ran
+        # star tier must agree: raise the threshold and redo the exchange
+        B._RING_MIN_BYTES = 10**18
+        recv2 = np.zeros(size * count)
+        MPI.Alltoall(send, recv2, count, comm)
+        assert np.array_equal(recv2, recv)
+        assert len(hits) == 2             # and the star path ran this time
+        print(f"A2A-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """, nprocs=4)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(4):
+        assert f"A2A-OK-{r}" in res.stdout

@@ -440,7 +440,6 @@ class ProcChannel(_Waitable):
         2(P-1)/P of the payload total, versus the star's P·payload ingress at
         one process. Requires a commutative op (ring order ≠ rank order)."""
         n = len(self.group)
-        was_jax = _is_jax(contrib)
         arr = np.asarray(contrib)
         if (arr.flags.writeable and arr.flags.c_contiguous
                 and arr.base is None and arr.flags.owndata):
@@ -474,11 +473,49 @@ class ProcChannel(_Waitable):
             incoming = self._wait_alg(rnd, ("rga", step), opname)
             wi = (rank - step) % n
             seg(wi)[...] = incoming
-        result = work.reshape(arr.shape)
-        if was_jax:
+        return self._from_host(work.reshape(arr.shape), contrib)
+
+    @staticmethod
+    def _alg_array(contrib: Any, n: int) -> Optional[np.ndarray]:
+        """The payload as a host array IF it is eligible for an algorithm
+        tier (big enough, numeric, splittable n ways); None → use the star.
+        One rule shared by every chooser branch so the tiers cannot drift."""
+        try:
+            arr = np.asarray(contrib)
+        except Exception:
+            return None
+        if (arr.dtype == object or arr.nbytes < _RING_MIN_BYTES
+                or arr.size % n):
+            return None
+        return arr
+
+    @staticmethod
+    def _from_host(result: np.ndarray, like: Any):
+        """Re-wrap an algorithm-tier result to match the contrib's kind."""
+        if _is_jax(like):
             import jax.numpy as jnp
-            result = jnp.asarray(result)
+            return jnp.asarray(result)
         return result
+
+    def _run_pairwise_alltoall(self, rank: int, rnd: int, contrib: Any,
+                               opname: str) -> Any:
+        """Direct pairwise exchange (MPI_Alltoall's large-message algorithm):
+        each of my P-1 foreign segments travels ONE hop to its owner, versus
+        the star's P·payload ingress at the root. Result for slot s = rank
+        s's segment for me, matching the star combine exactly."""
+        n = len(self.group)
+        arr = np.asarray(contrib)
+        segs = arr.reshape(n, arr.size // n)
+        for k in range(1, n):
+            dst = (rank + k) % n
+            self._send_alg(self.group[dst], rnd, ("a2a", rank), rank, opname,
+                           segs[dst])
+        out = np.empty_like(segs)
+        out[rank] = segs[rank]
+        for k in range(1, n):
+            src = (rank - k) % n
+            out[src] = self._wait_alg(rnd, ("a2a", src), opname)
+        return self._from_host(out.reshape(-1), contrib)
 
     def _choose_algorithm(self, contrib: Any, plan) -> Optional[Callable]:
         """Pick the algorithm-tier runner for a plan, or None for the star.
@@ -489,18 +526,19 @@ class ProcChannel(_Waitable):
             return self._run_barrier
         if kind == "bcast":
             return self._run_tree_bcast
+        n = len(self.group)
         if kind == "allreduce":
             op = plan[1]
             if not getattr(op, "commutative", False):
                 return None
-            try:
-                arr = np.asarray(contrib)
-            except Exception:
-                return None
-            if arr.dtype == object or arr.nbytes < _RING_MIN_BYTES:
+            if self._alg_array(contrib, 1) is None:
                 return None
             return lambda rank, rnd, contrib, opname: \
                 self._run_ring_allreduce(rank, rnd, contrib, op, opname)
+        if kind == "alltoall":
+            if self._alg_array(contrib, n) is None:
+                return None
+            return self._run_pairwise_alltoall
         return None
 
     # -- the collective contract ---------------------------------------------

@@ -503,7 +503,10 @@ def Alltoall(*args) -> Any:
         mats = [xp.asarray(c).reshape(len(cs), count) for c in cs]
         return [xp.concatenate([m[r] for m in mats]) for r in range(len(cs))]
 
-    mine = _run(comm, payload, combine, f"Alltoall@{comm.cid}")
+    # multi-process tier: large exchanges go direct pairwise (each segment
+    # one hop) instead of O(P²·seg) through the star root
+    mine = _run(comm, payload, combine, f"Alltoall@{comm.cid}",
+                plan=("alltoall",))
     if alloc:
         return clone_like(src, mine)
     write_flat(recvbuf, mine, count * size)
