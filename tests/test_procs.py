@@ -573,3 +573,54 @@ def test_pairwise_alltoall_tier():
     assert res.returncode == 0, res.stderr + res.stdout
     for r in range(4):
         assert f"A2A-OK-{r}" in res.stdout
+
+
+def test_thread_multiple_storm_across_processes():
+    """THREAD_MULTIPLE across the wire: many threads per process fire
+    tagged Isends at peers while others Recv — the matching engine, the
+    transport's per-destination locking, and the drainer must hold up
+    (the cross-process version of test_threads.py's in-process storm)."""
+    res = _run_procs("""
+        import threading
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init_thread(MPI.THREAD_MULTIPLE)
+        comm = MPI.COMM_WORLD
+        rank, size = comm.rank(), comm.size()
+        NT, NM = 4, 8
+        errs = []
+
+        def sender(t):
+            try:
+                for m in range(NM):
+                    for dst in range(size):
+                        if dst != rank:
+                            MPI.Send(np.array([float(rank * 1000 + t * 100 + m)]),
+                                     dst, t * 100 + m, comm)
+            except BaseException as e:
+                errs.append(e)
+
+        def receiver(t):
+            try:
+                buf = np.zeros(1)
+                for m in range(NM):
+                    for src in range(size):
+                        if src != rank:
+                            MPI.Recv(buf, src, t * 100 + m, comm)
+                            assert buf[0] == src * 1000 + t * 100 + m
+            except BaseException as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=sender, args=(t,)) for t in range(NT)]
+        threads += [threading.Thread(target=receiver, args=(t,)) for t in range(NT)]
+        for th in threads: th.start()
+        for th in threads: th.join(120)
+        assert not any(th.is_alive() for th in threads), "storm thread hung"
+        assert not errs, errs
+        MPI.Barrier(comm)
+        print(f"STORM-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """, nprocs=3, timeout=200)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(3):
+        assert f"STORM-OK-{r}" in res.stdout

@@ -55,13 +55,28 @@ _POLL = 0.02
 _tls = threading.local()
 
 
+_process_env: Optional[tuple["SpmdContext", int]] = None
+
+
 def current_env() -> Optional[tuple["SpmdContext", int]]:
-    """Return (context, rank) for the calling thread, or None outside SPMD."""
-    return getattr(_tls, "env", None)
+    """Return (context, rank) for the calling thread, or None outside SPMD.
+
+    Falls back to the process-global binding set by the multi-process tier:
+    there a process IS one rank, so every thread of it may call MPI
+    (THREAD_MULTIPLE semantics) without the explicit set_env attachment the
+    thread-rank tier needs (where several ranks share one process)."""
+    env = getattr(_tls, "env", None)
+    return env if env is not None else _process_env
 
 
 def set_env(env: Optional[tuple["SpmdContext", int]]) -> None:
     _tls.env = env
+
+
+def set_process_env(env: Optional[tuple["SpmdContext", int]]) -> None:
+    """Bind the whole process to one rank (multi-process tier only)."""
+    global _process_env
+    _process_env = env
 
 
 def require_env() -> tuple["SpmdContext", int]:

@@ -39,7 +39,7 @@ import numpy as np
 
 from . import config
 from ._runtime import (ANY_SOURCE, Mailbox, Message, SpmdContext, _Waitable,
-                       collective_wait_limit, set_env)
+                       collective_wait_limit, set_env, set_process_env)
 from .error import (AbortError, CollectiveMismatchError, DeadlockError,
                     MPIError)
 
@@ -1094,6 +1094,9 @@ def proc_attach() -> tuple[ProcContext, int]:
     sweep_segments(shm_job_tag(), only_dead_creators=True)
     ctx = ProcContext(rank, size, transport, same_host=same_host, addrs=addrs)
     set_env((ctx, rank))
+    # one rank per process: let every thread of it call MPI without the
+    # thread-tier's explicit set_env attachment (THREAD_MULTIPLE semantics)
+    set_process_env((ctx, rank))
     # Deterministic teardown: stop the drainer + native progress thread at
     # interpreter exit rather than relying on GC-order __del__.
     import atexit
