@@ -33,10 +33,10 @@ if rank == 0:
 sweeps = 0
 while sweeps < MAX_SWEEPS:
     # halo exchange: my first real row goes up, my last real row goes down
+    # a PROC_NULL partner skips that direction entirely (buffer untouched),
+    # so rank 0's fixed top edge survives the exchange as-is
     MPI.Sendrecv(u[1], up, 0, u[rows + 1], down, 0, cart)
     MPI.Sendrecv(u[rows], down, 1, u[0], up, 1, cart)
-    if rank == 0:
-        u[0, :] = 1.0                       # PROC_NULL recv zeroed the edge
 
     new = u[1:rows + 1].copy()
     new[:, 1:-1] = 0.25 * (u[:rows, 1:-1] + u[2:, 1:-1]
