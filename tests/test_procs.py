@@ -624,3 +624,83 @@ def test_thread_multiple_storm_across_processes():
     assert res.returncode == 0, res.stderr + res.stdout
     for r in range(3):
         assert f"STORM-OK-{r}" in res.stdout
+
+
+def test_ring_allgather_and_pairwise_alltoallv_tiers():
+    """Large Allgather rides the ring tier and Alltoallv the pairwise tier
+    across processes; both engage (invocation-counted) and match the star
+    tier's results in the same run."""
+    res = _run_procs("""
+        import os
+        os.environ["TPU_MPI_RING_MIN_BYTES"] = "64"   # force the alg tiers
+        import numpy as np
+        import tpu_mpi as MPI
+        from tpu_mpi import backend as B
+        hits = {"rag": 0, "a2av": 0}
+        orig_rag = B.ProcChannel._run_ring_allgather
+        orig_a2av = B.ProcChannel._run_pairwise_alltoallv
+        B.ProcChannel._run_ring_allgather = (
+            lambda self, *a, **k: (hits.__setitem__("rag", hits["rag"] + 1),
+                                   orig_rag(self, *a, **k))[1])
+        B.ProcChannel._run_pairwise_alltoallv = (
+            lambda self, *a, **k: (hits.__setitem__("a2av", hits["a2av"] + 1),
+                                   orig_a2av(self, *a, **k))[1])
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank, size = comm.rank(), comm.size()
+
+        # Allgather: 100-element blocks, rank-stamped
+        block = 100.0 * rank + np.arange(100, dtype=np.float64)
+        got = MPI.Allgather(block, comm)
+        expect = np.concatenate(
+            [100.0 * r + np.arange(100, dtype=np.float64) for r in range(size)])
+        assert np.array_equal(got, expect), rank
+        assert hits["rag"] == 1, hits
+
+        # Alltoallv: ragged sends, value-stamped per (src, dst)
+        scounts = [(rank + d) % 3 + 1 for d in range(size)]
+        rcounts = [(s + rank) % 3 + 1 for s in range(size)]
+        send = np.concatenate(
+            [1000 * rank + 10 * d + np.arange(scounts[d], dtype=np.float64)
+             for d in range(size)])
+        out = MPI.Alltoallv(send, scounts, rcounts, comm)
+        expect = np.concatenate(
+            [1000 * s + 10 * rank + np.arange(rcounts[s], dtype=np.float64)
+             for s in range(size)])
+        assert np.array_equal(out, expect), (rank, out, expect)
+        assert hits["a2av"] == 1, hits
+
+        # star tier agreement for Allgather (alltoallv gates on dtype, so
+        # it stays pairwise for numeric payloads by design)
+        B._RING_MIN_BYTES = 10**18
+        got2 = MPI.Allgather(block, comm)
+        assert np.array_equal(got2, got)
+        assert hits["rag"] == 1          # star ran this time, not the ring
+        print(f"TIERS-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """, nprocs=4)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(4):
+        assert f"TIERS-OK-{r}" in res.stdout
+
+
+def test_tier_divergence_fails_loudly():
+    """Illegal ragged Allgather whose per-rank sizes straddle the algorithm
+    threshold makes ranks pick different tiers; that must abort with a
+    clear mismatch error (not hang until DeadlockError)."""
+    res = _run_procs("""
+        import os
+        os.environ["TPU_MPI_RING_MIN_BYTES"] = "800"
+        os.environ["TPU_MPI_DEADLOCK_TIMEOUT"] = "30"
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank = comm.rank()
+        n = 90 if rank == 0 else 110     # 720B star vs 880B ring
+        MPI.Allgather(np.full(n, float(rank)), comm)
+        MPI.Finalize()
+    """, nprocs=2, timeout=120)
+    assert res.returncode != 0
+    assert ("algorithm tier" in res.stderr or "Allgather blocks disagree"
+            in res.stderr or "aborted" in res.stderr), res.stderr

@@ -360,8 +360,18 @@ class ProcChannel(_Waitable):
             cur = self.inflight.get(rnd)
             self.inbox[(rnd, src)] = (opname, contrib)
             self.cond.notify_all()
-        if cur is not None and cur[1] == "alg" and cur[0] != opname:
-            self._mismatch(opname, cur[0])
+        if cur is not None and cur[1] == "alg":
+            # a star contribution while this rank runs the algorithm tier:
+            # either a different collective (opname) or — same opname — a
+            # TIER divergence (e.g. non-uniform Allgather counts making the
+            # eligibility gate disagree); both would hang, fail loudly
+            if cur[0] != opname:
+                self._mismatch(opname, cur[0])
+            else:
+                self.ctx.fail(CollectiveMismatchError(
+                    f"ranks disagree on the algorithm tier for {opname!r} "
+                    f"(rank {src} entered the star path; this rank the "
+                    f"algorithm path — non-uniform counts?)"))
 
     def deliver_result(self, rnd: int, result: Any) -> None:
         with self.cond:
@@ -376,6 +386,11 @@ class ProcChannel(_Waitable):
             self.cond.notify_all()
         if cur is not None and cur[0] != opname:
             self._mismatch(opname, cur[0])
+        elif cur is not None and cur[1] == "star":
+            self.ctx.fail(CollectiveMismatchError(
+                f"ranks disagree on the algorithm tier for {opname!r} "
+                f"(rank {src} entered the algorithm path; this rank the "
+                f"star path — non-uniform counts?)"))
 
     # -- algorithm tier -------------------------------------------------------
     def _send_alg(self, world_dst: int, rnd: int, tag: tuple, rank: int,
@@ -497,6 +512,59 @@ class ProcChannel(_Waitable):
             return jnp.asarray(result)
         return result
 
+    def _run_ring_allgather(self, rank: int, rnd: int, contrib: Any,
+                            opname: str) -> Any:
+        """Ring allgather (each block travels n-1 single hops): every rank
+        forwards the newest block to its right neighbor, so total wire
+        traffic is (n-1)·block per rank versus the star root's P·block
+        ingress plus P²·block egress. Result = rank-ordered concatenation,
+        matching the star combine."""
+        n = len(self.group)
+        arr = np.asarray(contrib).reshape(-1)
+        per = arr.size
+        out = np.empty(n * per, arr.dtype)
+        blocks = out.reshape(n, per)
+        blocks[rank] = arr
+        right = self.group[(rank + 1) % n]
+        cur = rank
+        for step in range(n - 1):
+            self._send_alg(right, rnd, ("rag", step), rank, opname,
+                           blocks[cur])
+            cur = (rank - step - 1) % n
+            incoming = np.asarray(self._wait_alg(rnd, ("rag", step), opname))
+            if incoming.size != per or incoming.dtype != arr.dtype:
+                err = MPIError(
+                    f"Allgather blocks disagree across ranks "
+                    f"(got {incoming.size} x {incoming.dtype}, expected "
+                    f"{per} x {arr.dtype}); Allgather requires uniform "
+                    f"counts — use Allgatherv for ragged blocks")
+                self.ctx.fail(err)
+                raise err
+            blocks[cur] = incoming.reshape(-1)
+        return self._from_host(out, contrib)
+
+    def _run_pairwise_alltoallv(self, rank: int, rnd: int, contrib: Any,
+                                opname: str) -> Any:
+        """Variable-count pairwise exchange: like the Alltoall tier but each
+        (src, dst) segment has its own length, carried by the frame itself
+        (the star combine also slices by the SENDER's counts, so semantics
+        agree even if a buggy caller's rcounts disagree)."""
+        n = len(self.group)
+        wire, scounts = contrib
+        arr = np.asarray(wire).reshape(-1)
+        sd = np.concatenate([[0], np.cumsum(scounts)]).astype(np.int64)
+        for k in range(1, n):
+            dst = (rank + k) % n
+            self._send_alg(self.group[dst], rnd, ("a2av", rank), rank,
+                           opname, arr[sd[dst]:sd[dst + 1]])
+        parts: list = [None] * n
+        parts[rank] = arr[sd[rank]:sd[rank + 1]]
+        for k in range(1, n):
+            src = (rank - k) % n
+            parts[src] = self._wait_alg(rnd, ("a2av", src), opname)
+        out = np.concatenate([np.asarray(p).reshape(-1) for p in parts])
+        return self._from_host(out, wire)
+
     def _run_pairwise_alltoall(self, rank: int, rnd: int, contrib: Any,
                                opname: str) -> Any:
         """Direct pairwise exchange (MPI_Alltoall's large-message algorithm):
@@ -539,6 +607,21 @@ class ProcChannel(_Waitable):
             if self._alg_array(contrib, n) is None:
                 return None
             return self._run_pairwise_alltoall
+        if kind == "allgather":
+            if self._alg_array(contrib, 1) is None:
+                return None
+            return self._run_ring_allgather
+        if kind == "alltoallv":
+            # counts differ per rank, so a SIZE-based gate would let ranks
+            # disagree on the tier (protocol divergence); gate on the dtype
+            # only, which the MPI datatype contract makes uniform. Read it
+            # via the attribute — np.asarray here would pull a jax payload
+            # to host just to inspect its dtype.
+            dt = getattr(contrib[0], "dtype", None) \
+                if isinstance(contrib, tuple) and contrib else None
+            if dt is None or dt == object:
+                return None
+            return self._run_pairwise_alltoallv
         return None
 
     # -- the collective contract ---------------------------------------------
@@ -555,17 +638,27 @@ class ProcChannel(_Waitable):
             self.inflight[rnd] = (opname, mode)
             # Frames of this round may have arrived before we entered: sweep
             # them for cross-tier mismatches the delivery check couldn't see.
-            stale = None
+            stale = tier_diverged = None
             for key, val in self.inbox.items():
-                if (mode == "star" and key[0] == "alg" and key[1] == rnd
-                        and val[1] != opname):
-                    stale = val[1]
+                if mode == "star" and key[0] == "alg" and key[1] == rnd:
+                    if val[1] != opname:
+                        stale = val[1]
+                    else:
+                        tier_diverged = val[0]   # same op, other tier
                 elif (mode == "alg" and isinstance(key[0], int)
-                      and key[0] == rnd and len(key) == 2
-                      and val[0] != opname):
-                    stale = val[0]
+                      and key[0] == rnd and len(key) == 2):
+                    if val[0] != opname:
+                        stale = val[0]
+                    else:
+                        tier_diverged = key[1]
         if stale is not None:
             self._mismatch(stale, opname)
+            ctx.check_failure()
+        if tier_diverged is not None:
+            ctx.fail(CollectiveMismatchError(
+                f"ranks disagree on the algorithm tier for {opname!r} "
+                f"(rank {tier_diverged} took the other path — non-uniform "
+                f"counts?)"))
             ctx.check_failure()
         try:
             if alg is not None:

@@ -393,7 +393,10 @@ def _gather_impl(sendbuf, recvbuf, count, root, comm, alloc, all_ranks):
         return [full] * len(cs)
 
     if all_ranks:
-        full = _run(comm, payload, combine, f"Allgather@{comm.cid}")
+        # multi-process tier: big uniform blocks travel a ring (one hop per
+        # block per step) instead of star ingress + P x egress at the root
+        full = _run(comm, payload, combine, f"Allgather@{comm.cid}",
+                    plan=("allgather",))
     else:
         full = _run_rooted(comm, root, payload, combine, f"Gather@{comm.cid}")
     if not isroot:
@@ -546,7 +549,8 @@ def Alltoallv(*args) -> Any:
             outs.append(xp.concatenate(parts) if parts else xp.zeros(0))
         return outs
 
-    mine = _run(comm, payload, combine, f"Alltoallv@{comm.cid}")
+    mine = _run(comm, payload, combine, f"Alltoallv@{comm.cid}",
+                plan=("alltoallv",))
     if alloc:
         return clone_like(sendbuf, mine)
     write_flat(recvbuf, mine, sum(rcounts))
