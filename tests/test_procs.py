@@ -704,3 +704,35 @@ def test_tier_divergence_fails_loudly():
     assert res.returncode != 0
     assert ("algorithm tier" in res.stderr or "Allgather blocks disagree"
             in res.stderr or "aborted" in res.stderr), res.stderr
+
+
+def test_ring_allgatherv_tier():
+    """Ragged Allgatherv rides the ring tier across processes and matches
+    the star result."""
+    res = _run_procs("""
+        import os
+        os.environ["TPU_MPI_RING_MIN_BYTES"] = "64"
+        import numpy as np
+        import tpu_mpi as MPI
+        from tpu_mpi import backend as B
+        hits = []
+        orig = B.ProcChannel._run_ring_allgatherv
+        B.ProcChannel._run_ring_allgatherv = (
+            lambda self, *a, **k: (hits.append(1), orig(self, *a, **k))[1])
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank, size = comm.rank(), comm.size()
+        counts = [10 * (r % 3 + 1) for r in range(size)]
+        mine = 100.0 * rank + np.arange(counts[rank], dtype=np.float64)
+        got = MPI.Allgatherv(mine, counts, comm)
+        expect = np.concatenate(
+            [100.0 * r + np.arange(counts[r], dtype=np.float64)
+             for r in range(size)])
+        assert np.array_equal(got, expect), rank
+        assert hits == [1], hits
+        print(f"AGV-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """, nprocs=4)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(4):
+        assert f"AGV-OK-{r}" in res.stdout

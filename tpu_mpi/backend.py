@@ -354,6 +354,13 @@ class ProcChannel(_Waitable):
             f"ranks disagree on the collective for cid {self.cid}: "
             f"{sorted({theirs, mine})}"))
 
+    def _tier_mismatch(self, opname: str, who: Any) -> None:
+        """Same collective, different algorithm tier — would hang silently
+        (frames land in keys the other tier never waits on); fail loudly."""
+        self.ctx.fail(CollectiveMismatchError(
+            f"ranks disagree on the algorithm tier for {opname!r} "
+            f"(rank {who} took the other path — non-uniform counts?)"))
+
     # -- drainer entry points -------------------------------------------------
     def deliver_contrib(self, rnd: int, src: int, opname: str, contrib: Any) -> None:
         with self.cond:
@@ -368,10 +375,7 @@ class ProcChannel(_Waitable):
             if cur[0] != opname:
                 self._mismatch(opname, cur[0])
             else:
-                self.ctx.fail(CollectiveMismatchError(
-                    f"ranks disagree on the algorithm tier for {opname!r} "
-                    f"(rank {src} entered the star path; this rank the "
-                    f"algorithm path — non-uniform counts?)"))
+                self._tier_mismatch(opname, src)
 
     def deliver_result(self, rnd: int, result: Any) -> None:
         with self.cond:
@@ -387,10 +391,7 @@ class ProcChannel(_Waitable):
         if cur is not None and cur[0] != opname:
             self._mismatch(opname, cur[0])
         elif cur is not None and cur[1] == "star":
-            self.ctx.fail(CollectiveMismatchError(
-                f"ranks disagree on the algorithm tier for {opname!r} "
-                f"(rank {src} entered the algorithm path; this rank the "
-                f"star path — non-uniform counts?)"))
+            self._tier_mismatch(opname, src)
 
     # -- algorithm tier -------------------------------------------------------
     def _send_alg(self, world_dst: int, rnd: int, tag: tuple, rank: int,
@@ -543,6 +544,25 @@ class ProcChannel(_Waitable):
             blocks[cur] = incoming.reshape(-1)
         return self._from_host(out, contrib)
 
+    def _run_ring_allgatherv(self, rank: int, rnd: int, contrib: Any,
+                             opname: str) -> Any:
+        """Ragged ring allgather: blocks of differing sizes forward around
+        the ring (each carries its own length); assembled in rank order at
+        the end, matching the star combine."""
+        n = len(self.group)
+        arr = np.asarray(contrib).reshape(-1)
+        blocks: list = [None] * n
+        blocks[rank] = arr
+        right = self.group[(rank + 1) % n]
+        cur = rank
+        for step in range(n - 1):
+            self._send_alg(right, rnd, ("ragv", step), rank, opname,
+                           blocks[cur])
+            cur = (rank - step - 1) % n
+            blocks[cur] = np.asarray(
+                self._wait_alg(rnd, ("ragv", step), opname)).reshape(-1)
+        return self._from_host(np.concatenate(blocks), contrib)
+
     def _run_pairwise_alltoallv(self, rank: int, rnd: int, contrib: Any,
                                 opname: str) -> Any:
         """Variable-count pairwise exchange: like the Alltoall tier but each
@@ -611,6 +631,12 @@ class ProcChannel(_Waitable):
             if self._alg_array(contrib, 1) is None:
                 return None
             return self._run_ring_allgather
+        if kind == "allgatherv":
+            dt = getattr(contrib, "dtype", None)
+            if (dt is None or dt == object
+                    or plan[1] < _RING_MIN_BYTES):   # replicated total size
+                return None
+            return self._run_ring_allgatherv
         if kind == "alltoallv":
             # counts differ per rank, so a SIZE-based gate would let ranks
             # disagree on the tier (protocol divergence); gate on the dtype
@@ -655,10 +681,7 @@ class ProcChannel(_Waitable):
             self._mismatch(stale, opname)
             ctx.check_failure()
         if tier_diverged is not None:
-            ctx.fail(CollectiveMismatchError(
-                f"ranks disagree on the algorithm tier for {opname!r} "
-                f"(rank {tier_diverged} took the other path — non-uniform "
-                f"counts?)"))
+            self._tier_mismatch(opname, tier_diverged)
             ctx.check_failure()
         try:
             if alg is not None:

@@ -462,7 +462,13 @@ def _gatherv_impl(sendbuf, recvbuf, counts, root, comm, alloc, all_ranks):
         return [full] * len(cs)
 
     if all_ranks:
-        full = _run(comm, payload, combine, f"Allgatherv@{comm.cid}")
+        # ragged ring tier (multi-process): the counts list is replicated by
+        # the API contract, so a size gate on the TOTAL is deterministic
+        # across ranks even though per-rank blocks differ
+        total_bytes = int(sum(counts)) * getattr(
+            getattr(payload, "dtype", None), "itemsize", 0)
+        full = _run(comm, payload, combine, f"Allgatherv@{comm.cid}",
+                    plan=("allgatherv", total_bytes))
     else:
         full = _run_rooted(comm, root, payload, combine, f"Gatherv@{comm.cid}")
     if not isroot:
