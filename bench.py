@@ -25,7 +25,7 @@ import time
 N_ELEMS = 1 << 26            # Float32[2^26] = 256 MiB
 WARMUP = 5
 ITERS = 20
-REPEATS = 3                  # timed blocks; report the best (OSU convention —
+REPEATS = 6                  # timed blocks; report the best (OSU convention —
                              # the tunnel's latency spikes otherwise dominate)
 
 _REPO_DIR = os.path.dirname(os.path.abspath(__file__))

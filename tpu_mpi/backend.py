@@ -545,23 +545,37 @@ class ProcChannel(_Waitable):
         return self._from_host(out, contrib)
 
     def _run_ring_allgatherv(self, rank: int, rnd: int, contrib: Any,
-                             opname: str) -> Any:
-        """Ragged ring allgather: blocks of differing sizes forward around
-        the ring (each carries its own length); assembled in rank order at
-        the end, matching the star combine."""
+                             opname: str, counts: Sequence[int]) -> Any:
+        """Ragged ring allgather: blocks of differing (replicated-counts)
+        sizes forward around the ring; written straight into a preallocated
+        rank-ordered output, each incoming block validated against the
+        counts contract like the uniform ring tier."""
         n = len(self.group)
         arr = np.asarray(contrib).reshape(-1)
-        blocks: list = [None] * n
-        blocks[rank] = arr
+        displs = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        out = np.empty(int(displs[-1]), arr.dtype)
+
+        def blk(i: int):
+            return out[displs[i]:displs[i + 1]]
+
+        blk(rank)[...] = arr
         right = self.group[(rank + 1) % n]
         cur = rank
         for step in range(n - 1):
             self._send_alg(right, rnd, ("ragv", step), rank, opname,
-                           blocks[cur])
+                           blk(cur))
             cur = (rank - step - 1) % n
-            blocks[cur] = np.asarray(
+            incoming = np.asarray(
                 self._wait_alg(rnd, ("ragv", step), opname)).reshape(-1)
-        return self._from_host(np.concatenate(blocks), contrib)
+            if incoming.size != counts[cur] or incoming.dtype != arr.dtype:
+                err = MPIError(
+                    f"Allgatherv block from rank {cur} is "
+                    f"{incoming.size} x {incoming.dtype}, but the replicated "
+                    f"counts promise {counts[cur]} x {arr.dtype}")
+                self.ctx.fail(err)
+                raise err
+            blk(cur)[...] = incoming
+        return self._from_host(out, contrib)
 
     def _run_pairwise_alltoallv(self, rank: int, rnd: int, contrib: Any,
                                 opname: str) -> Any:
@@ -636,7 +650,9 @@ class ProcChannel(_Waitable):
             if (dt is None or dt == object
                     or plan[1] < _RING_MIN_BYTES):   # replicated total size
                 return None
-            return self._run_ring_allgatherv
+            counts = plan[2]
+            return lambda rank, rnd, contrib, opname: \
+                self._run_ring_allgatherv(rank, rnd, contrib, opname, counts)
         if kind == "alltoallv":
             # counts differ per rank, so a SIZE-based gate would let ranks
             # disagree on the tier (protocol divergence); gate on the dtype

@@ -468,7 +468,7 @@ def _gatherv_impl(sendbuf, recvbuf, counts, root, comm, alloc, all_ranks):
         total_bytes = int(sum(counts)) * getattr(
             getattr(payload, "dtype", None), "itemsize", 0)
         full = _run(comm, payload, combine, f"Allgatherv@{comm.cid}",
-                    plan=("allgatherv", total_bytes))
+                    plan=("allgatherv", total_bytes, tuple(counts)))
     else:
         full = _run_rooted(comm, root, payload, combine, f"Gatherv@{comm.cid}")
     if not isroot:
