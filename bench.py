@@ -9,10 +9,22 @@ Adaptive to the hardware the driver gives us:
   ICI bandwidth (the BASELINE.json target).
 - 1 device (the tunnel setup): the ICI sweep is not measurable, so the
   framework's host-path Allreduce runs 4 rank-threads against the real chip
-  and reports effective algorithm bandwidth as a fraction of the chip's HBM
-  bandwidth — the bound that path is up against.
+  and reports algorithm bandwidth against the HBM **roofline for the path's
+  actual traffic**: the fused fold reads nranks operands and writes one
+  result, so each op moves (nranks+1)*payload through HBM and the best
+  achievable algbw is HBM_bw/(nranks+1).
+
+  Measurement protocol (VERDICT r2 weak #1 — the round-2 number measured
+  async dispatch and exceeded HBM peak): iterations are chained
+  **data-dependently** — rank 0 feeds the combined result back as its next
+  contribution, so op k+1 cannot start before op k's output exists — and
+  each timed block ends with a one-element host readback, the only true
+  completion barrier through the device tunnel (``block_until_ready``
+  returns before execution completes there; verified empirically). The
+  chain grows linearly (out_{k+1} = out_k + (nranks-1)), so no overflow
+  and the readback doubles as a correctness check.
 - CPU fallback (no TPU visible): same host-path measurement, vs_baseline
-  computed against the TPU target anyway (informational only).
+  computed against the TPU roofline anyway (informational only).
 """
 
 from __future__ import annotations
@@ -87,53 +99,36 @@ def _bench_in_graph(jax, devices, n_elems: int = N_ELEMS) -> dict:
 
 def _bench_host_path(device_kind: str, use_device: bool,
                      n_elems: int = N_ELEMS) -> dict:
-    import numpy as np
-    import tpu_mpi as MPI
-    from tpu_mpi import spmd_run
+    # the chained-execution protocol + aggregation live in benchmarks/common
+    # (shared with allreduce_sweep.py so the two benches cannot drift)
+    sys.path.insert(0, os.path.join(_REPO_DIR, "benchmarks"))
+    from common import best_block, host_allreduce_times
 
     nranks = 4
     nbytes = n_elems * 4
-
-    def body():
-        MPI.Init()
-        comm = MPI.COMM_WORLD
-        if use_device:
-            import jax.numpy as jnp
-            from tpu_mpi.buffers import DeviceBuffer
-            buf = DeviceBuffer(jnp.ones(n_elems, jnp.float32))
-            out = DeviceBuffer(jnp.zeros(n_elems, jnp.float32))
-        else:
-            buf = np.ones(n_elems, np.float32)
-            out = np.zeros(n_elems, np.float32)
-        for _ in range(WARMUP):
-            MPI.Allreduce(buf, out, MPI.SUM, comm)
-        reps = []
-        for _ in range(REPEATS):
-            MPI.Barrier(comm)
-            t0 = time.perf_counter()
-            for _ in range(ITERS):
-                MPI.Allreduce(buf, out, MPI.SUM, comm)
-            MPI.Barrier(comm)
-            reps.append((time.perf_counter() - t0) / ITERS)
-        MPI.Finalize()
-        return reps
-
-    times = spmd_run(body, nranks)
+    times = host_allreduce_times(n_elems, nranks, use_device,
+                                 WARMUP, ITERS, REPEATS)
     # per-repeat max across ranks (a repeat is as slow as its slowest rank),
     # then best repeat — never mixes times from different repeats.
-    dt = min(max(per_rank[i] for per_rank in times) for i in range(REPEATS))
+    dt = best_block(times)
     algbw = nbytes / dt / 1e9
     caps = _caps()
     gen = device_kind if device_kind in caps else "v5e"
-    ref = caps.get(gen, {}).get("hbm_gbps", 819.0)
+    hbm = caps.get(gen, {}).get("hbm_gbps", 819.0)
+    # Traffic model (BASELINE.md "Measured"): the rendezvous runs ONE fused
+    # fold per op — nranks operand reads + 1 result write — so the op moves
+    # (nranks+1)*payload through HBM and the roofline algbw is
+    # hbm/(nranks+1). vs_baseline = fraction of that roofline achieved.
+    roofline = hbm / (nranks + 1)
     where = f"1x {gen} chip" if use_device else "cpu"
     log2 = n_elems.bit_length() - 1
     return {
         "metric": f"Allreduce Float32[2^{log2}] algorithm bandwidth, host path, "
-                  f"4 ranks, {where} (vs HBM peak)",
+                  f"{nranks} ranks, {where} (vs HBM roofline "
+                  f"{roofline:.0f} GB/s = {hbm:.0f}/{nranks + 1})",
         "value": round(algbw, 3),
         "unit": "GB/s",
-        "vs_baseline": round(algbw / ref, 4),
+        "vs_baseline": round(algbw / roofline, 4),
     }
 
 
