@@ -446,6 +446,15 @@ class SpmdContext:
         self.spawned_threads: list[threading.Thread] = []
         self._spawn_lock = threading.Lock()
 
+    @property
+    def host_token(self) -> str:
+        """Identity of the shared-memory domain this rank lives in
+        (src/comm.jl:107-115 MPI_COMM_TYPE_SHARED semantics). All
+        rank-threads of one controller process trivially share memory; the
+        multi-process context overrides this with the rank's transport
+        address host (or the TPU_MPI_HOST_ID override)."""
+        return "local"
+
     # -- failure fate-sharing ------------------------------------------------
     def fail(self, exc: BaseException, rank: Optional[int] = None) -> None:
         with self._failure_lock:
