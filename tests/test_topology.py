@@ -116,3 +116,116 @@ def test_cart_halo_allreduce_combo(nprocs):
         assert total == pytest.approx(expect)
 
     run_spmd(body, nprocs)
+
+
+# ---------------------------------------------------------------------------
+# physical-torus-aware reordering (VERDICT r2 missing #1; SURVEY.md §2.3:
+# "map ranks to physical torus coordinates for bandwidth")
+# ---------------------------------------------------------------------------
+
+class _FakeDev:
+    """Stand-in for a TPU device: id + physical torus coords."""
+
+    def __init__(self, id, coords):
+        self.id = id
+        self.coords = tuple(coords)
+
+    def __repr__(self):
+        return f"FakeDev({self.id}, {self.coords})"
+
+
+def _fake_torus(*bounds):
+    import itertools
+    return [_FakeDev(i, c) for i, c in
+            enumerate(itertools.product(*[range(b) for b in bounds]))]
+
+
+def _is_ici_neighbor(ca, cb, bounds):
+    """±1 along exactly one torus axis (with wraparound) == one ICI hop."""
+    diffs = [min((a - b) % n, (b - a) % n)
+             for a, b, n in zip(ca, cb, bounds) if n > 1]
+    return sorted(diffs) == [0] * (len(diffs) - 1) + [1]
+
+
+def test_arrange_devices_axis_match():
+    from tpu_mpi.topology import _arrange_devices
+    bounds = (2, 4)
+    devs = _fake_torus(*bounds)
+    arranged = _arrange_devices([4, 2], devs)
+    assert arranged is not None and len(arranged) == 8
+    assert {d.id for d in arranged} == {d.id for d in devs}
+    # row-major grid neighbors must be one ICI hop apart
+    for p, d in enumerate(arranged):
+        i, j = divmod(p, 2)
+        right = arranged[i * 2 + (j + 1) % 2]
+        down = arranged[((i + 1) % 4) * 2 + j]
+        assert _is_ici_neighbor(d.coords, right.coords, bounds), (d, right)
+        assert _is_ici_neighbor(d.coords, down.coords, bounds), (d, down)
+    # trivial axes in the physical coords are tolerated (v5e coords are 3-d)
+    devs3 = _fake_torus(2, 4, 1)
+    assert _arrange_devices([2, 4], devs3) is not None
+    # impossible matches return None instead of lying (mesh_utils cannot
+    # help either: fake devices don't survive its platform checks)
+    assert _arrange_devices([8, 1], _fake_torus(2, 4)) is None
+
+
+def test_dims_create_torus_aware(monkeypatch):
+    from tpu_mpi import implementations
+    monkeypatch.setattr(implementations, "ici_topology", lambda: (2, 4, 1))
+    assert MPI.Dims_create(8, [0, 0]) == [4, 2]
+    # constraints still win over the torus
+    assert MPI.Dims_create(8, [2, 0]) == [2, 4]
+    # mismatched product falls back to arithmetic
+    monkeypatch.setattr(implementations, "ici_topology", lambda: (3, 3))
+    assert sorted(MPI.Dims_create(8, [0, 0]), reverse=True) == [4, 2]
+
+
+def test_cart_create_reorder_honors_torus(monkeypatch):
+    """Cart_shift neighbors of a reorder=True grid map to adjacent physical
+    device coords on a simulated 2x4 torus (VERDICT r2 item 3 'Done' bar)."""
+    from tpu_mpi import topology
+
+    bounds = (2, 4)
+    devs = _fake_torus(*bounds)
+    monkeypatch.setattr(topology, "_mapping_devices", lambda: list(devs))
+
+    def body():
+        comm = MPI.COMM_WORLD
+        cart = MPI.Cart_create(comm, [4, 2], [1, 1], True)
+        assert cart._devices is not None, "reorder should attach devices"
+        me = cart._devices[MPI.Comm_rank(cart)]
+        for d in range(2):
+            for disp in (1, -1):
+                src, dest = MPI.Cart_shift(cart, d, disp)
+                for nb in (src, dest):
+                    other = cart._devices[nb]
+                    assert _is_ici_neighbor(me.coords, other.coords, bounds), \
+                        (me, other, d, disp)
+        # Cart_sub keeps the attachment
+        sub = MPI.Cart_sub(cart, [True, False])
+        assert sub._devices is not None
+        assert sub._devices[MPI.Comm_rank(sub)].id == me.id
+        # mesh_axes still reports the grid shape
+        assert cart.mesh_axes() == {"cart0": 4, "cart1": 2}
+
+    run_spmd(body, 8)
+
+
+def test_cart_device_mesh_cpu():
+    """device_mesh() builds a jax.sharding.Mesh of the grid's shape over the
+    real (CPU-sim) device inventory when the rank<->device contract holds."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 sim devices")
+
+    def body():
+        comm = MPI.COMM_WORLD
+        cart = MPI.Cart_create(comm, [4, 2], [1, 1], True)
+        mesh = cart.device_mesh()
+        assert mesh.devices.shape == (4, 2)
+        assert mesh.axis_names == ("cart0", "cart1")
+        mesh2 = cart.device_mesh(axis_names=("x", "y"))
+        assert mesh2.axis_names == ("x", "y")
+
+    run_spmd(body, 8)

@@ -24,14 +24,99 @@ from .comm import COMM_NULL, Comm, Comm_split
 from .error import MPIError
 
 
+def _mapping_devices() -> list:
+    """Device list used for torus-aware rank mapping (monkeypatchable in
+    tests to simulate a multi-chip torus on the CPU substrate)."""
+    try:
+        import jax
+        return list(jax.devices())
+    except Exception:
+        return []
+
+
+def _arrange_devices(dims: Sequence[int], devices: Sequence) -> Optional[list]:
+    """Arrange ``devices`` into a row-major grid of shape ``dims`` such that
+    grid neighbors are physical ICI neighbors, or None when no such
+    arrangement is derivable (SURVEY.md §2.3: "map ranks to physical torus
+    coordinates for bandwidth"; reference substrate src/topology.jl:30-49).
+
+    Strategy: match each non-trivial grid dimension to a distinct physical
+    torus axis of equal size (``device.coords``); a device's grid position is
+    then its physical coordinate along the matched axes, so a ±1 grid shift
+    is a ±1 move on the physical torus — exactly an ICI link. Falls back to
+    ``mesh_utils.create_device_mesh`` (which optimizes harder shapes) when
+    exact axis matching fails."""
+    dims = [int(d) for d in dims]
+    n = math.prod(dims)
+    if len(devices) != n or n <= 1:
+        return None
+    coords = [tuple(getattr(d, "coords", None) or ()) for d in devices]
+    ndim_phys = len(coords[0]) if coords[0] else 0
+    if ndim_phys and all(len(c) == ndim_phys for c in coords):
+        bounds = [max(c[j] for c in coords) + 1 for j in range(ndim_phys)]
+        # greedily bind each non-trivial grid axis to an unused physical
+        # axis of the same size (largest first, so equal sizes pair up)
+        phys_axis: dict[int, int] = {}
+        free = [j for j in range(ndim_phys) if bounds[j] > 1]
+        ok = True
+        for i in sorted((i for i, d in enumerate(dims) if d > 1),
+                        key=lambda i: -dims[i]):
+            for j in free:
+                if bounds[j] == dims[i]:
+                    phys_axis[i] = j
+                    free.remove(j)
+                    break
+            else:
+                ok = False
+                break
+        if ok and not free:        # every non-trivial physical axis consumed
+            pos: dict[tuple, object] = {}
+            for dev, c in zip(devices, coords):
+                gc = tuple(c[phys_axis[i]] if i in phys_axis else 0
+                           for i in range(len(dims)))
+                if gc in pos:      # >1 device per chip coord (multi-core)
+                    pos = {}
+                    break
+                pos[gc] = dev
+            if len(pos) == n:
+                out = []
+                for p in range(n):
+                    gc, r = [], p
+                    for d in reversed(dims):
+                        gc.append(r % d)
+                        r //= d
+                    out.append(pos[tuple(reversed(gc))])
+                return out
+    try:
+        from jax.experimental import mesh_utils
+        mesh = mesh_utils.create_device_mesh(tuple(dims), devices=list(devices))
+        return list(mesh.flat)
+    except Exception:
+        return None
+
+
 def Dims_create(nnodes: int, dims: Sequence[int]) -> list[int]:
     """Balanced factorization of nnodes over len(dims) dimensions
     (ref ``Dims_create!`` :9-20). Nonzero entries are constraints; zero
     entries are filled so the dims are as close to each other as possible
-    (larger dims first), and prod(dims) == nnodes."""
+    (larger dims first), and prod(dims) == nnodes.
+
+    Torus-aware: when every entry is free and the job spans a physical ICI
+    torus of the same dimensionality and size
+    (:func:`tpu_mpi.implementations.ici_topology`), the fill is the torus
+    bounds themselves (in MPI's non-increasing order) — so a subsequent
+    ``Cart_create(..., reorder=True)`` can bind every grid axis to a
+    physical axis exactly and grid neighbors ride single ICI links."""
     dims = [int(d) for d in dims]
     if any(d < 0 for d in dims):
         raise MPIError(f"negative entry in dims {dims}")
+    if dims and all(d == 0 for d in dims):
+        from .implementations import ici_topology
+        torus = ici_topology()
+        if torus:
+            bounds = sorted((b for b in torus if b > 1), reverse=True)
+            if len(bounds) == len(dims) and math.prod(bounds) == nnodes:
+                return bounds
     fixed = math.prod(d for d in dims if d > 0) if any(d > 0 for d in dims) else 1
     free = [i for i, d in enumerate(dims) if d == 0]
     if fixed <= 0 or nnodes % fixed != 0:
@@ -71,10 +156,13 @@ class CartComm(Comm):
     """A communicator with an attached N-d grid (ref Cart_create :30-49)."""
 
     def __init__(self, group, cid, dims: Sequence[int], periods: Sequence[bool],
-                 name: str = "cart"):
+                 name: str = "cart", devices: Optional[list] = None):
         super().__init__(group, cid, name=name)
         self.dims = tuple(int(d) for d in dims)
         self.periods = tuple(bool(p) for p in periods)
+        # grid-ordered device list (cart rank r owns _devices[r]) when the
+        # rank<->device contract holds; basis of device_mesh()
+        self._devices = devices
 
     # -- rank <-> coords (row-major, last dim fastest: MPI order) ------------
     def rank_of_coords(self, coords: Sequence[int]) -> int:
@@ -102,12 +190,45 @@ class CartComm(Comm):
         same shape as this grid (the TPU-native face of Cart topology)."""
         return {f"cart{i}": d for i, d in enumerate(self.dims)}
 
+    def device_mesh(self, axis_names: Optional[Sequence[str]] = None):
+        """The ``jax.sharding.Mesh`` whose axis layout honors this grid:
+        position ``coords`` of the mesh holds cart rank
+        ``rank_of_coords(coords)``'s device, so with ``reorder=True`` mesh
+        neighbors are physical ICI neighbors. This is the bridge from MPI
+        Cart topology to the in-graph tier (``tpu_mpi.xla`` collectives run
+        inside ``shard_map`` over this mesh)."""
+        from jax.sharding import Mesh
+        devs = self._devices
+        if devs is None:
+            devices = _mapping_devices()
+            if len(devices) < self.size() or not all(
+                    w < len(devices) for w in self.group):
+                raise MPIError(
+                    "no rank<->device mapping for this communicator: the "
+                    "grid has no attached devices and world ranks exceed "
+                    "the device inventory")
+            devs = [devices[w] for w in self.group]
+        arr = np.empty(len(devs), dtype=object)
+        for i, d in enumerate(devs):
+            arr[i] = d
+        return Mesh(arr.reshape(self.dims),
+                    tuple(axis_names) if axis_names is not None
+                    else tuple(f"cart{i}" for i in range(len(self.dims))))
+
 
 def Cart_create(comm: Comm, *args) -> Comm:
     """``Cart_create(comm, [ndims,] dims, periods, reorder)`` — collective;
-    ranks beyond prod(dims) get COMM_NULL (ref :30-49). ``reorder`` is
-    accepted for API parity; rank order is preserved (the TPU backend instead
-    exposes physical-torus-aware ordering via the mesh layer)."""
+    ranks beyond prod(dims) get COMM_NULL (ref :30-49).
+
+    ``reorder=True`` honors the physical ICI torus: when the job's ranks map
+    1:1 onto the device inventory (the SPMD rank<->device-index contract)
+    and an arrangement exists that makes grid neighbors physical neighbors
+    (:func:`_arrange_devices`), each rank's new cart rank is its device's
+    grid position — so ``Cart_shift`` neighbors are one ICI hop apart and
+    halo exchanges never cross the torus diagonally. Without a derivable
+    arrangement (CPU sim, thread tier over one chip, rank/device mismatch)
+    rank order is preserved, matching the reference's freedom to ignore
+    reorder (src/topology.jl:30-49)."""
     if len(args) == 4:
         ndims, dims, periods, reorder = args
         dims = list(dims)[:int(ndims)]
@@ -124,12 +245,26 @@ def Cart_create(comm: Comm, *args) -> Comm:
     if n > comm.size():
         raise MPIError(f"grid {dims} needs {n} ranks, comm has {comm.size()}")
     rank = comm.rank()
+    key = rank
+    grid_devices = None
+    if reorder and n == comm.size():
+        devices = _mapping_devices()
+        if len(devices) == n and all(w < n for w in comm.group):
+            arranged = _arrange_devices(dims, devices)
+            if arranged is not None:
+                # cart rank of a member = grid position of its device; the
+                # split's (key, rank) sort realizes the permutation. Every
+                # rank computes the same arrangement deterministically.
+                pos_of_id = {d.id: p for p, d in enumerate(arranged)}
+                key = pos_of_id[devices[comm.group[rank]].id]
+                grid_devices = arranged
     color = 0 if rank < n else None
-    sub = Comm_split(comm, color, rank)
+    sub = Comm_split(comm, color, key if rank < n else rank)
     if sub is COMM_NULL:
         return COMM_NULL
     return CartComm(sub.group, sub.cid, dims, periods,
-                    name=f"{comm.name}.cart{tuple(dims)}")
+                    name=f"{comm.name}.cart{tuple(dims)}",
+                    devices=grid_devices)
 
 
 def Cart_rank(comm: CartComm, coords: Sequence[int]) -> int:
@@ -192,5 +327,11 @@ def Cart_sub(comm: CartComm, remain_dims: Sequence) -> Comm:
     sub = Comm_split(comm, color, key)
     sub_dims = [d for d, r in zip(comm.dims, remain) if r]
     sub_periods = [p for p, r in zip(comm.periods, remain) if r]
+    sub_devices = None
+    if comm._devices is not None:
+        # keep the torus-honoring device attachment: a member's device is
+        # its slot in the parent grid, re-indexed into the sub-grid order
+        parent_rank = {w: r for r, w in enumerate(comm.group)}
+        sub_devices = [comm._devices[parent_rank[w]] for w in sub.group]
     return CartComm(sub.group, sub.cid, sub_dims or [1], sub_periods or [False],
-                    name=f"{comm.name}.sub")
+                    name=f"{comm.name}.sub", devices=sub_devices)
