@@ -232,10 +232,18 @@ class Mailbox(_Waitable):
                         return True
                     return not self.queue or self.queued_bytes + nb <= high
 
-                self._wait_for(
-                    admissible,
-                    f"{what} (destination unexpected-queue over "
-                    f"high-water mark)")
+                # Progress-aware deadlock budget (ADVICE r2): a receiver
+                # that drains slowly-but-steadily is making progress, not
+                # deadlocking — each observed shrink of the unexpected
+                # queue restarts the budget (each _wait_for call takes a
+                # fresh deadline). Only a genuinely stuck queue raises.
+                floor = self.queued_bytes
+                while not admissible():
+                    self._wait_for(
+                        lambda: admissible() or self.queued_bytes < floor,
+                        f"{what} (destination unexpected-queue over "
+                        f"high-water mark)")
+                    floor = min(floor, self.queued_bytes)
             self._post_locked(msg)
 
     def _post_locked(self, msg: Message) -> None:

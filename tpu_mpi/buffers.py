@@ -18,12 +18,34 @@ registered by default.
 
 from __future__ import annotations
 
+import weakref
 from typing import Any, Optional
 
 import numpy as np
 
 from .datatypes import Datatype, to_datatype
 from .error import MPIError
+
+# Host arrays created by to_wire as private snapshots — explicitly marked so
+# in-place consumers (the multi-process ring allreduce) key their
+# no-second-copy fast path on provenance, not on inferred numpy flags that a
+# future caller's owning-but-shared array could also satisfy (ADVICE r2).
+# Keyed by id with weakly-referenced values (ndarrays are weakref-able but
+# not hashable): an entry dies with its array, so marking never extends a
+# snapshot's lifetime and a recycled id can never alias a live entry.
+_wire_snapshots: "weakref.WeakValueDictionary[int, np.ndarray]" = \
+    weakref.WeakValueDictionary()
+
+
+def _mark_wire_snapshot(arr: np.ndarray) -> np.ndarray:
+    _wire_snapshots[id(arr)] = arr
+    return arr
+
+
+def is_wire_snapshot(arr: Any) -> bool:
+    """True iff ``arr`` is a private host copy minted by :func:`to_wire`
+    (safe to mutate in place: no user alias can exist)."""
+    return _wire_snapshots.get(id(arr)) is arr
 
 
 class _InPlace:
@@ -277,13 +299,13 @@ def to_wire(x: Any, count: Optional[int] = None) -> Any:
         src = np.asarray(x)
         if count is None:
             arr = np.ascontiguousarray(src)
-            return arr.copy() if arr is src else arr
+            return _mark_wire_snapshot(arr.copy() if arr is src else arr)
         out = np.ravel(src)           # view (contiguous) or owning copy
         if out.size != count:
             out = out[:count]
         if out.base is not None or out is src:
             out = out.copy()          # the single snapshot copy
-        return out
+        return _mark_wire_snapshot(out)
     if count is not None:
         shape = arr.shape
         if len(shape) == 1 and shape[0] == count:
