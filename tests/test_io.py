@@ -58,7 +58,7 @@ def test_io_interleaved(AT, nprocs):
     run_spmd(body, nprocs)
 
 
-def test_io_byte_default_view(nprocs):
+def test_io_byte_default_view(AT, nprocs):
     """Without set_view, offsets are byte offsets (etype = BYTE)."""
     def body():
         comm = MPI.COMM_WORLD
@@ -66,10 +66,10 @@ def test_io_byte_default_view(nprocs):
         filename = _tmpname(comm)
         fh = MPI.File.open(comm, filename, read=True, write=True, create=True)
         try:
-            payload = np.full(4, rank, dtype=np.uint8)
+            payload = AT.full(4, rank, dtype=np.uint8)
             MPI.File.write_at_all(fh, rank * 4, payload)
             MPI.File.sync(fh)
-            everything = np.zeros(4 * sz, dtype=np.uint8)
+            everything = AT.zeros(4 * sz, dtype=np.uint8)
             MPI.File.read_at_all(fh, 0, everything)
             assert aeq(everything, np.repeat(np.arange(sz, dtype=np.uint8), 4))
             assert MPI.File.get_size(fh) == 4 * sz
@@ -82,7 +82,7 @@ def test_io_byte_default_view(nprocs):
     run_spmd(body, nprocs)
 
 
-def test_io_strided_filetype(nprocs):
+def test_io_strided_filetype(AT, nprocs):
     """A vector filetype interleaves ranks' elements — the datatype-view
     offset arithmetic (SURVEY.md §2.3 'file views = offset arithmetic')."""
     def body():
@@ -95,7 +95,7 @@ def test_io_strided_filetype(nprocs):
             ft = Types.create_vector(1, 1, sz, MPI.INT64)
             ft = Types.create_resized(ft, 0, sz * 8)
             MPI.File.set_view(fh, rank * 8, MPI.INT64, ft)
-            mine = np.full(3, rank, dtype=np.int64)   # 3 tiles
+            mine = AT.full(3, rank, dtype=np.int64)   # 3 tiles
             MPI.File.write_at_all(fh, 0, mine)
             MPI.File.sync(fh)
 
@@ -106,7 +106,7 @@ def test_io_strided_filetype(nprocs):
                 assert aeq(raw, np.tile(np.arange(sz), 3))
 
             # Read back through the same view.
-            back = np.zeros(3, dtype=np.int64)
+            back = AT.zeros(3, dtype=np.int64)
             MPI.File.read_at_all(fh, 0, back)
             assert aeq(back, mine)
         finally:
@@ -118,14 +118,15 @@ def test_io_strided_filetype(nprocs):
     run_spmd(body, nprocs)
 
 
-def test_io_checkpoint_roundtrip(nprocs):
+def test_io_checkpoint_roundtrip(AT, nprocs):
     """Checkpoint/restore a sharded model state through the File layer
-    (SURVEY.md §5: checkpoint parity = the File layer)."""
+    (SURVEY.md §5: checkpoint parity = the File layer) — with device
+    operands this is exactly 'checkpoint device state to disk'."""
     def body():
         comm = MPI.COMM_WORLD
         rank, sz = MPI.Comm_rank(comm), MPI.Comm_size(comm)
         filename = _tmpname(comm)
-        shard = np.arange(16, dtype=np.float32) + 100 * rank
+        shard = AT.array(np.arange(16, dtype=np.float32) + 100 * rank)
         fh = MPI.File.open(comm, filename, write=True, create=True)
         try:
             MPI.File.set_view(fh, 0, MPI.FLOAT32, MPI.FLOAT32)
@@ -138,7 +139,7 @@ def test_io_checkpoint_roundtrip(nprocs):
         fh = MPI.File.open(comm, filename, read=True)
         try:
             MPI.File.set_view(fh, 0, MPI.FLOAT32, MPI.FLOAT32)
-            restored = np.zeros(16, dtype=np.float32)
+            restored = AT.zeros(16, dtype=np.float32)
             MPI.File.read_at_all(fh, rank * 16, restored)
             assert aeq(restored, shard)
         finally:

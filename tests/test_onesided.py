@@ -156,25 +156,25 @@ def test_shared_window(nprocs):
     run_spmd(body, nprocs)
 
 
-def test_lock_mutual_exclusion(nprocs):
+def test_lock_mutual_exclusion(AT, nprocs):
     """Exclusive locks serialize concurrent read-modify-write (the passive-
     target guarantee SURVEY.md §2.3 asks the emulation to provide)."""
     def body():
         comm = MPI.COMM_WORLD
         rank, N = MPI.Comm_rank(comm), MPI.Comm_size(comm)
-        buf = np.zeros(1, dtype=np.int64)
+        buf = AT.zeros(1, dtype=np.int64)
         win = MPI.Win_create(buf, comm)
         MPI.Win_fence(0, win)
         for _ in range(25):
             MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 0, 0, win)
-            tmp = np.zeros(1, dtype=np.int64)
+            tmp = AT.zeros(1, dtype=np.int64)
             MPI.Get(tmp, 1, 0, 0, win)
             tmp[0] += 1
             MPI.Put(tmp, 1, 0, 0, win)
             MPI.Win_unlock(0, win)
         MPI.Barrier(comm)
         if rank == 0:
-            assert buf[0] == 25 * N
+            assert np.asarray(buf)[0] == 25 * N
         MPI.Barrier(comm)
 
     run_spmd(body, nprocs)

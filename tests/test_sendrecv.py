@@ -124,12 +124,12 @@ def test_waitany_deactivates_requests(nprocs):
     run_spmd(body, nprocs)
 
 
-def test_proc_null_everywhere(nprocs):
+def test_proc_null_everywhere(AT, nprocs):
     # PROC_NULL short-circuits every receive/probe flavor (MPI semantics;
     # needed by non-periodic Cart_shift boundaries).
     def body():
         comm = MPI.COMM_WORLD
-        buf = np.zeros(2)
+        buf = AT.zeros(2)
         st = MPI.Recv(buf, MPI.PROC_NULL, 0, comm)
         assert st.source == MPI.PROC_NULL
         obj, st = MPI.recv(MPI.PROC_NULL, 0, comm)
@@ -193,7 +193,7 @@ def test_sendrecv_cart_shift(nprocs):
     run_spmd(body, nprocs)
 
 
-def test_any_source_any_tag_probe(nprocs):
+def test_any_source_any_tag_probe(AT, nprocs):
     def body():
         comm = MPI.COMM_WORLD
         rank = MPI.Comm_rank(comm)
@@ -203,43 +203,44 @@ def test_any_source_any_tag_probe(nprocs):
             for _ in range(size - 1):
                 st = MPI.Probe(MPI.ANY_SOURCE, MPI.ANY_TAG, comm)
                 n = MPI.Get_count(st, np.int64)
-                buf = np.zeros(n, dtype=np.int64)
+                buf = AT.zeros(n, dtype=np.int64)
                 st2 = MPI.Recv(buf, st.source, st.tag, comm)
                 assert st2.source == st.source
-                got.add((st2.source, st2.tag, int(buf[0])))
+                got.add((st2.source, st2.tag, int(np.asarray(buf)[0])))
             assert got == {(r, 100 + r, r * 10) for r in range(1, size)}
         else:
-            MPI.Send(np.full(rank, rank * 10, dtype=np.int64), 0, 100 + rank, comm)
+            MPI.Send(AT.full(rank, rank * 10, dtype=np.int64), 0,
+                     100 + rank, comm)
 
     run_spmd(body, nprocs)
 
 
-def test_nonovertaking_order(nprocs):
+def test_nonovertaking_order(AT, nprocs):
     # Messages from one source with the same tag arrive in order.
     def body():
         comm = MPI.COMM_WORLD
         rank = MPI.Comm_rank(comm)
         if rank == 1:
             for i in range(10):
-                MPI.Send(np.array([i]), 0, 7, comm)
+                MPI.Send(AT.array([i]), 0, 7, comm)
         elif rank == 0:
             for i in range(10):
-                buf = np.zeros(1, dtype=np.int64)
+                buf = AT.zeros(1, dtype=np.int64)
                 MPI.Recv(buf, 1, 7, comm)
-                assert buf[0] == i
+                assert np.asarray(buf)[0] == i
         MPI.Barrier(comm)
 
     run_spmd(body, nprocs)
 
 
-def test_truncation_error(nprocs):
+def test_truncation_error(AT, nprocs):
     def body():
         comm = MPI.COMM_WORLD
         rank = MPI.Comm_rank(comm)
         if rank == 0:
-            MPI.Send(np.arange(8, dtype=np.float64), 1, 3, comm)
+            MPI.Send(AT.array(np.arange(8, dtype=np.float64)), 1, 3, comm)
         elif rank == 1:
-            small = np.zeros(4)
+            small = AT.zeros(4)
             with pytest.raises(MPI.TruncationError):
                 MPI.Recv(small, 0, 3, comm)
         MPI.Barrier(comm)
