@@ -48,6 +48,17 @@ def _interpret(interpret: Optional[bool]):
     return pltpu.InterpretParams() if interpret else False
 
 
+def _compiler_params(collective_id: Optional[int]):
+    """Mosaic accepts a collective_id ONLY when the kernel actually uses the
+    barrier semaphore — at n=1 the ring loops never trace a barrier, so the
+    id must be omitted or compilation fails (found by the real-chip Mosaic
+    smoke, benchmarks/pallas_mosaic_smoke.py; interpret mode accepts both)."""
+    pltpu = _pltpu()
+    if collective_id is None:
+        return pltpu.CompilerParams()
+    return pltpu.CompilerParams(collective_id=collective_id)
+
+
 # ---------------------------------------------------------------------------
 # layout: arbitrary array <-> (rows, LANE) tile padded for n ring chunks
 # ---------------------------------------------------------------------------
@@ -159,7 +170,7 @@ def ring_allgather(x, *, axis: str = "x", interpret: Optional[bool] = None):
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=_interpret(interpret),
-        compiler_params=pltpu.CompilerParams(collective_id=0),
+        compiler_params=_compiler_params(0 if n > 1 else None),
     )(tile)
     per = out.reshape(n, rows * LANE)[:, : x.size]
     return per.reshape((n,) + tuple(x.shape))
@@ -250,7 +261,7 @@ def ring_allreduce(x, op: Any = "sum", *, axis: str = "x",
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=_interpret(interpret),
-        compiler_params=pltpu.CompilerParams(collective_id=1),
+        compiler_params=_compiler_params(1),   # n>1 guaranteed (early return)
     )(tile)
     return _from_tile(out, x.shape, x.size)
 
@@ -317,7 +328,7 @@ def ring_reduce_scatter(x, op: Any = "sum", *, axis: str = "x",
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=_interpret(interpret),
-        compiler_params=pltpu.CompilerParams(collective_id=4),
+        compiler_params=_compiler_params(4),   # n>1 guaranteed (early return)
     )(tile)
     return out.reshape(-1)[:per]
 
@@ -381,7 +392,7 @@ def pairwise_alltoall(x, *, axis: str = "x", interpret: Optional[bool] = None):
             pltpu.SemaphoreType.DMA((n - 1,)),
         ],
         interpret=_interpret(interpret),
-        compiler_params=pltpu.CompilerParams(collective_id=5),
+        compiler_params=_compiler_params(5),   # n>1 guaranteed (early return)
     )(tile)
     blocks = out.reshape(n, rows_b * LANE)[:, :per]
     return blocks.reshape(-1)
@@ -397,11 +408,28 @@ def _permute_kernel(perm_table, axis: str, local_ref, out_ref, comm_ref,
     import jax.numpy as jnp
     pltpu = _pltpu()
     my = jax.lax.axis_index(axis)
-    # static table -> scalar select chain (a captured constant array would
-    # need to be a kernel input)
-    dst = jnp.int32(perm_table[0])
-    for r in range(1, len(perm_table)):
-        dst = jnp.where(my == r, jnp.int32(perm_table[r]), dst)
+    n = len(perm_table)
+
+    def select(table):
+        # static table -> scalar select chain (a captured constant array
+        # would need to be a kernel input)
+        v = jnp.int32(table[0])
+        for r in range(1, n):
+            v = jnp.where(my == r, jnp.int32(table[r]), v)
+        return v
+
+    dst = select(perm_table)
+    if n > 1:
+        # entry handshake: tell my SOURCE (inverse permutation) that this
+        # rank's comm_ref is live, and wait for my DESTINATION's signal
+        # before the Put — a fast sender must not land a DMA in a peer that
+        # has not entered the kernel (same hazard as _alltoall's barrier)
+        inv = [perm_table.index(r) for r in range(n)]
+        src = select(inv)
+        bar = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(bar, inc=1, device_id=src,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(bar, 1)
     rdma = pltpu.make_async_remote_copy(
         src_ref=local_ref,
         dst_ref=comm_ref,
@@ -441,7 +469,7 @@ def collective_permute(x, perm: Sequence[int], *, axis: str = "x",
             pltpu.SemaphoreType.DMA(()),
         ],
         interpret=_interpret(interpret),
-        compiler_params=pltpu.CompilerParams(collective_id=2),
+        compiler_params=_compiler_params(2 if n > 1 else None),
     )(tile)
     return _from_tile(out, x.shape, x.size)
 
@@ -543,6 +571,6 @@ def ring_attention(q, k, v, *, axis: str = "x", causal: bool = False,
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=_interpret(interpret),
-        compiler_params=pltpu.CompilerParams(collective_id=3),
+        compiler_params=_compiler_params(3 if n > 1 else None),
     )(q, k, v)
     return out[:, :d] if pad else out
