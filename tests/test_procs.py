@@ -867,3 +867,34 @@ def test_rooted_reduce_gather_egress_is_tiny():
     assert "EGRESS-OK" in res.stdout
     for r in (1, 2, 3):
         assert f"INGRESS-OK-{r}" in res.stdout
+
+
+def test_p2p_on_split_comm_across_processes():
+    """P2P on a SUB-communicator in --procs mode: sub-comm context ids are
+    process-namespaced tuples, which the binary fast-lane header must carry
+    (regression: round-3's first fast-lane cut only encoded int cids and
+    poisoned any Send on a split comm)."""
+    res = _run_procs("""
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank, size = comm.rank(), comm.size()
+        half = MPI.Comm_split(comm, rank % 2, rank)
+        r, n = half.rank(), half.size()
+        nxt, prv = (r + 1) % n, (r - 1) % n
+        buf = np.zeros(3)
+        MPI.Sendrecv(np.full(3, float(r)), nxt, 4, buf, prv, 4, half)
+        assert np.all(buf == prv), (rank, buf)
+        # tags/matching stay per-communicator: same tag on WORLD must not
+        # cross-match the sub-comm traffic
+        MPI.Send(np.full(2, 10.0 + rank), (rank + 1) % size, 4, comm)
+        wbuf = np.zeros(2)
+        MPI.Recv(wbuf, (rank - 1) % size, 4, comm)
+        assert wbuf[0] == 10.0 + (rank - 1) % size, (rank, wbuf)
+        print(f"SPLIT-P2P-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    for r in range(4):
+        assert f"SPLIT-P2P-OK-{r}" in res.stdout

@@ -161,10 +161,23 @@ except TypeError:
 _PY_MAP = {int: INT64, float: FLOAT64, complex: COMPLEX128, bool: BOOL}
 
 
+_DTYPE_CACHE: dict = {}      # plain np.dtype -> Datatype (per-message hot path)
+
+
 def to_datatype(T: Any) -> Datatype:
     """``Datatype(T)`` for a Python/numpy/dataclass type (src/datatypes.jl:269-316)."""
     if isinstance(T, Datatype):
         return T
+    if isinstance(T, np.dtype):
+        # every typed send resolves its array's dtype here — memoize the
+        # handful of plain dtypes (structured dtypes skip the cache: their
+        # identity can embed mutable field metadata)
+        if T.names is None:
+            dt = _DTYPE_CACHE.get(T)
+            if dt is None:
+                dt = Datatype(T, name=str(T))
+                _DTYPE_CACHE[T] = dt
+            return dt
     if T in _PY_MAP:
         return _PY_MAP[T]
     if dataclasses.is_dataclass(T) or (isinstance(T, type) and issubclass(T, tuple)
