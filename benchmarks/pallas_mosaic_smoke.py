@@ -48,8 +48,7 @@ def main() -> None:
         emit(args.out, record)
         return
 
-    sys.path.insert(0, __file__.rsplit("/", 2)[0])
-    from tpu_mpi.xla import make_mesh, pallas_kernels as pk
+    from tpu_mpi.xla import make_mesh, pallas_kernels as pk  # via common's path
 
     dev = [d for d in jax.devices() if d.platform == "tpu"][:1]
     mesh = make_mesh({"x": 1}, devices=dev)
