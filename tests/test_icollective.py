@@ -1,0 +1,163 @@
+"""Nonblocking collectives (MPI-3 Ibarrier/Ibcast/Iallreduce/... — absent
+from the reference v0.14.2; provided beyond parity). Completion integrates
+with the Wait/Test family; per-rank initiation order is preserved by the
+per-comm collective worker."""
+
+import numpy as np
+import pytest
+
+import tpu_mpi as MPI
+from tpu_mpi.testing import aeq, run_spmd
+
+
+def test_ibarrier_overlaps_and_waits(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        req = MPI.Ibarrier(comm)
+        # overlap arbitrary local work before completing
+        local = float(MPI.Comm_rank(comm)) ** 2
+        st = MPI.Wait(req)
+        assert st is not None
+        assert not req.active            # consumed -> inactive
+        return local
+
+    run_spmd(body, nprocs)
+
+
+def test_iallreduce_mutating_and_allocating(AT, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        # mutating: buffers untouched until Wait
+        send = AT.full(4, rank + 1.0)
+        recv = AT.zeros(4)
+        r1 = MPI.Iallreduce(send, recv, MPI.SUM, comm)
+        # allocating: result lands on the request
+        r2 = MPI.Iallreduce(AT.full(2, float(rank)), MPI.MAX, comm)
+        MPI.Waitall([r1, r2])
+        assert aeq(recv, np.full(4, sum(range(1, size + 1))))
+        assert aeq(r2.result, np.full(2, float(size - 1)))
+
+    run_spmd(body, nprocs)
+
+
+def test_ibcast_igather_ordering(nprocs):
+    # two outstanding collectives initiated in the same order on all ranks
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        buf = np.full(3, float(rank))
+        rb = MPI.Ibcast(buf, 1, comm)
+        rg = MPI.Igather(np.full(2, float(rank)), 0, comm)
+        # complete out of initiation order: allowed (completion is local)
+        MPI.Wait(rg)
+        MPI.Wait(rb)
+        assert aeq(buf, np.full(3, 1.0))
+        if rank == 0:
+            assert aeq(rg.result,
+                       np.concatenate([np.full(2, float(r))
+                                       for r in range(size)]))
+        else:
+            assert rg.result is None     # rooted: non-roots get None
+
+    run_spmd(body, nprocs)
+
+
+def test_icollective_mixed_with_p2p_requests(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        nxt, prv = (rank + 1) % size, (rank - 1) % size
+        pbuf = np.zeros(2)
+        reqs = [MPI.Irecv(pbuf, prv, 7, comm),
+                MPI.Ibarrier(comm),
+                MPI.Isend(np.full(2, float(rank)), nxt, 7, comm)]
+        MPI.Waitall(reqs)
+        assert pbuf[0] == prv
+
+    run_spmd(body, nprocs)
+
+
+def test_icollective_error_surfaces_on_wait(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        req = MPI.Ibcast(np.zeros(2), rank % 2, comm)   # divergent roots
+        with pytest.raises(MPI.MPIError):
+            MPI.Wait(req)
+
+    # divergent roots poison the job: every rank sees an error (the
+    # originating CollectiveMismatchError or the fate-shared AbortError)
+    with pytest.raises(Exception):
+        run_spmd(body, nprocs)
+
+
+def test_icollective_cancel_refused(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        req = MPI.Ibarrier(comm)
+        with pytest.raises(MPI.MPIError):
+            MPI.Cancel(req)
+        MPI.Wait(req)
+
+    run_spmd(body, nprocs)
+
+
+def test_iscan_iexscan_ialltoall(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        r1 = MPI.Iscan(np.full(2, float(rank + 1)), MPI.SUM, comm)
+        r2 = MPI.Ialltoall(np.arange(size, dtype=np.float64) + 10 * rank,
+                           1, comm)
+        flagged = MPI.Testall([r1, r2])
+        while not flagged[0]:
+            flagged = MPI.Testall([r1, r2])
+        assert aeq(r1.result, np.full(2, sum(range(1, rank + 2))))
+        assert aeq(r2.result, np.array([10.0 * s + rank for s in range(size)]))
+
+    run_spmd(body, nprocs)
+
+
+def test_blocking_after_nonblocking_keeps_initiation_order(nprocs):
+    """MPI-legal overlap: a BLOCKING collective issued while a nonblocking
+    one is outstanding must initiate after it on every rank (the ordering
+    guard routes it through the same per-comm worker)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        for i in range(10):                  # stress the race window
+            req = MPI.Ibarrier(comm)
+            buf = np.full(2, float(rank) if rank != 1 else 99.0 + i)
+            MPI.Bcast(buf, 1, comm)          # blocking, same comm, no Wait yet
+            assert buf[0] == 99.0 + i, (rank, i, buf)
+            MPI.Wait(req)
+        # nested flavor: allreduce between two outstanding ops
+        r1 = MPI.Iallreduce(np.full(2, 1.0), MPI.SUM, comm)
+        total = MPI.Allreduce(np.full(2, 2.0), MPI.SUM, comm)
+        assert total[0] == 2.0 * size
+        MPI.Wait(r1)
+        assert r1.result[0] == float(size)
+
+    run_spmd(body, nprocs)
+
+
+def test_nbcoll_worker_reclaimed_on_free(nprocs):
+    """Comm.free releases the I-collective worker; Finalize sweeps the rest
+    (no thread leak per communicator)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        sub = MPI.Comm_dup(comm)
+        MPI.Wait(MPI.Ibarrier(sub))
+        from tpu_mpi._runtime import require_env
+        ctx, wr = require_env()
+        key = ("nbcoll", sub.cid, wr)
+        assert key in ctx.objects
+        MPI.free(sub)
+        assert key not in ctx.objects
+        # world comm's worker lives until Finalize (checked by the runner's
+        # clean teardown; Finalize sweeps rank-owned workers)
+        MPI.Wait(MPI.Ibarrier(comm))
+
+    run_spmd(body, nprocs)

@@ -898,3 +898,35 @@ def test_p2p_on_split_comm_across_processes():
     assert res.returncode == 0, (res.stdout, res.stderr)
     for r in range(4):
         assert f"SPLIT-P2P-OK-{r}" in res.stdout
+
+
+def test_nonblocking_collectives_across_processes():
+    """Ibarrier/Iallreduce/Ibcast across OS processes: the per-comm
+    collective worker initiates on the cross-process rendezvous while the
+    main thread overlaps P2P."""
+    res = _run_procs("""
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank, size = comm.rank(), comm.size()
+        out = np.zeros(4)
+        r1 = MPI.Iallreduce(np.full(4, rank + 1.0), out, MPI.SUM, comm)
+        buf = np.full(2, float(rank))
+        r2 = MPI.Ibcast(buf, 2, comm)
+        # overlap P2P on the main thread while the collectives run
+        nxt, prv = (rank + 1) % size, (rank - 1) % size
+        pb = np.zeros(1)
+        MPI.Sendrecv(np.full(1, float(rank)), nxt, 11, pb, prv, 11, comm)
+        assert pb[0] == prv
+        MPI.Waitall([r1, r2])
+        assert np.all(out == sum(range(1, size + 1))), out
+        assert np.all(buf == 2.0), buf
+        rb = MPI.Ibarrier(comm)
+        MPI.Wait(rb)
+        print(f"ICOLL-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    for r in range(4):
+        assert f"ICOLL-OK-{r}" in res.stdout

@@ -46,11 +46,14 @@ from .datatypes import (BFLOAT16, BOOL, BYTE, CHAR, COMPLEX64, COMPLEX128,
 from .operators import (BAND, BOR, BXOR, LAND, LOR, LXOR, MAX, MIN, NO_OP, Op,
                         PROD, REPLACE, SUM)
 
-# Collectives (src/collective.jl)
+# Collectives (src/collective.jl) + nonblocking variants (MPI-3; absent
+# from the reference — beyond parity)
 from .collective import (Allgather, Allgatherv, Allreduce, Alltoall,
-                         Alltoallv, Barrier, Bcast, Exscan, Gather, Gatherv,
-                         Reduce, Reduce_scatter, Reduce_scatter_block, Scan,
-                         Scatter, Scatterv, bcast)
+                         Alltoallv, Barrier, Bcast, CollRequest, Exscan,
+                         Gather, Gatherv, Iallgather, Iallreduce, Ialltoall,
+                         Ibarrier, Ibcast, Iexscan, Igather, Ireduce, Iscan,
+                         Iscatter, Reduce, Reduce_scatter,
+                         Reduce_scatter_block, Scan, Scatter, Scatterv, bcast)
 
 # Point-to-point (src/pointtopoint.jl)
 from .pointtopoint import (Cancel, Get_count, Get_error, Get_source, Get_tag,
@@ -70,10 +73,11 @@ from .onesided import (Accumulate, Fetch_and_op, Get, Get_accumulate,
                        Win_create_dynamic, Win_detach, Win_fence, Win_flush,
                        Win_lock, Win_shared_query, Win_sync, Win_unlock)
 
-# Topology (src/topology.jl)
+# Topology (src/topology.jl) + MPI-3 neighborhood collectives (absent from
+# the reference — beyond parity)
 from .topology import (Cart_coords, Cart_create, Cart_get, Cart_rank,
                        Cart_shift, Cart_sub, CartComm, Cartdim_get,
-                       Dims_create)
+                       Dims_create, Neighbor_allgather, Neighbor_alltoall)
 def install_tpurun(*args, **kwargs):
     """Install the ``tpurun`` wrapper executable (MPI.install_mpiexecjl
     analog). Lazy import: eagerly importing .launcher here would put it in

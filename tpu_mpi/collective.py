@@ -42,7 +42,12 @@ from .operators import Op, as_op
 
 
 def _run(comm: Comm, contrib: Any, combine, opname: str, plan=None) -> Any:
-    return comm.channel().run(comm.rank(), contrib, combine, opname, plan=plan)
+    # _ordered_run (defined with the nonblocking machinery below) keeps a
+    # blocking collective from racing this rank's in-flight nonblocking
+    # ones to the rendezvous: with outstanding work it runs through the
+    # same single worker, preserving program order.
+    return _ordered_run(comm, lambda: comm.channel().run(
+        comm.rank(), contrib, combine, opname, plan=plan))
 
 
 def _run_rooted(comm: Comm, root: int, contrib: Any, combine, opname: str,
@@ -731,3 +736,248 @@ def Reduce_scatter_block(sendbuf: Any, recvbuf: Any, op: Any, comm: Comm) -> Any
     if n % size != 0:
         raise MPIError(f"send count {n} not divisible by comm size {size}")
     return Reduce_scatter(sendbuf, recvbuf, [n // size] * size, op, comm)
+
+
+# ---------------------------------------------------------------------------
+# Nonblocking collectives (MPI-3 Ibarrier/Ibcast/Iallreduce/… — absent from
+# the reference v0.14.2, SURVEY.md §2.3 note; provided natively, beyond
+# parity). Each communicator gets a per-rank single-thread worker, so this
+# rank's collectives INITIATE on the rendezvous in program order (the MPI
+# ordering contract) while the caller overlaps compute or P2P. Completion
+# integrates with the whole Wait/Test family via a Request subclass.
+# ---------------------------------------------------------------------------
+
+class CollRequest:
+    """Request handle for a nonblocking collective.
+
+    Duck-types the :class:`tpu_mpi.pointtopoint.Request` completion
+    protocol (``test``/``wait``/``active``/``cancel``), so Wait/Test/
+    Waitall/Testall/Waitany/Testany/Waitsome/Testsome accept mixed lists
+    of P2P and collective requests. ``result`` carries the allocating
+    variant's return value after completion; errors raised inside the
+    collective (mismatch, abort, deadlock) re-raise on Wait/Test.
+
+    MPI contract (caller's side): do not touch the operation's buffers
+    between initiation and completion, and initiate collectives on a
+    communicator in the same order on every rank.
+    """
+
+    def __init__(self, future):
+        self._future = future
+        self.result = None
+        self.status = None
+        self._done = False
+        self._inactive = False
+        self.kind = "coll"
+        self.buffer = None
+
+    def _complete(self) -> None:
+        self.result = self._future.result()   # re-raises collective errors
+        from .pointtopoint import STATUS_EMPTY
+        self.status = STATUS_EMPTY
+        self._done = True
+
+    def test(self) -> bool:
+        if self._done:
+            return True
+        if not self._future.done():
+            return False
+        self._complete()
+        return True
+
+    def wait(self):
+        from .pointtopoint import STATUS_EMPTY
+        if self._inactive:
+            return self.status or STATUS_EMPTY
+        if not self._done:
+            self._complete()
+        return self._consume()
+
+    def _consume(self):
+        """Surface the completion (Wait/Test-family contract): go inactive
+        like a consumed P2P request; ``result`` stays readable."""
+        from .pointtopoint import STATUS_EMPTY
+        self._inactive = True
+        return self.status or STATUS_EMPTY
+
+    @property
+    def active(self) -> bool:
+        return not self._inactive
+
+    def cancel(self) -> None:
+        raise MPIError("nonblocking collectives cannot be cancelled")
+
+    def __repr__(self) -> str:
+        return f"<CollRequest done={self._done}>"
+
+
+class _NbState:
+    """Per-(comm, rank) nonblocking-collective worker: a single thread, so
+    this rank's collectives INITIATE on the rendezvous in submission order,
+    plus an outstanding counter that lets blocking collectives detect
+    in-flight nonblocking ones and route through the same worker (ordering
+    would otherwise race — an MPI-legal ``Ibarrier; Bcast; Wait`` could
+    initiate in different orders on different ranks)."""
+
+    def __init__(self, world_rank: int):
+        from concurrent.futures import ThreadPoolExecutor
+        self.executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"tpu-mpi-nbcoll-{world_rank}")
+        self.outstanding = 0
+        self.lock = threading.Lock()
+
+    def submit(self, fn):
+        with self.lock:
+            self.outstanding += 1
+        fut = self.executor.submit(fn)
+
+        def done(_):
+            with self.lock:
+                self.outstanding -= 1
+
+        fut.add_done_callback(done)
+        return fut
+
+    def shutdown(self) -> None:
+        self.executor.shutdown(wait=False)
+
+
+_nb_worker_tls = threading.local()    # True on a collective worker thread
+
+
+def _nb_state(ctx, cid, world_rank, create: bool):
+    key = ("nbcoll", cid, world_rank)
+    with ctx.objects_lock:
+        st = ctx.objects.get(key)
+        if st is None and create:
+            st = _NbState(world_rank)
+            ctx.objects[key] = st
+        return st
+
+
+def nb_shutdown(ctx, cid=None, world_rank=None) -> None:
+    """Release nonblocking-collective workers: the ones of one comm+rank
+    (Comm.free) or every one owned by a rank (Finalize)."""
+    with ctx.objects_lock:
+        keys = [k for k in ctx.objects
+                if isinstance(k, tuple) and k and k[0] == "nbcoll"
+                and (cid is None or k[1] == cid)
+                and (world_rank is None or k[2] == world_rank)]
+        states = [ctx.objects.pop(k) for k in keys]
+    for st in states:
+        st.shutdown()
+
+
+def _nb_submit(comm: Comm, fn) -> CollRequest:
+    """Run ``fn`` on this rank's per-comm collective worker."""
+    from ._runtime import require_env, set_env
+
+    ctx, world_rank = require_env()
+    st = _nb_state(ctx, comm.cid, world_rank, create=True)
+
+    def run():
+        # the worker impersonates the initiating rank (thread-tier ranks
+        # are TLS-bound; the proc tier's process-global binding also works)
+        set_env((ctx, world_rank))
+        _nb_worker_tls.active = True
+        try:
+            return fn()
+        finally:
+            _nb_worker_tls.active = False
+            set_env(None)
+
+    return CollRequest(st.submit(run))
+
+
+def _ordered_run(comm: Comm, call):
+    """Initiation-order guard for BLOCKING collectives: when this rank's
+    nonblocking worker has outstanding work on this comm, run the blocking
+    collective THROUGH the worker (submission order = program order) and
+    wait; otherwise call directly. Without this, an MPI-legal
+    ``Ibarrier(comm); Bcast(buf, 0, comm); Wait(req)`` could initiate in
+    different orders on different ranks and mispair rendezvous rounds."""
+    if getattr(_nb_worker_tls, "active", False):
+        return call()                      # already ON the worker
+    from ._runtime import current_env
+    env = current_env()
+    if env is None:
+        return call()
+    ctx, world_rank = env
+    st = _nb_state(ctx, comm.cid, world_rank, create=False)
+    if st is None or st.outstanding == 0:
+        # an idle worker has fully completed everything it initiated, so a
+        # direct call cannot overtake anything (and a CONCURRENT submitter
+        # from another user thread is the user's ordering responsibility,
+        # exactly as in MPI THREAD_MULTIPLE)
+        return call()
+    from ._runtime import set_env
+
+    def run():
+        set_env((ctx, world_rank))
+        _nb_worker_tls.active = True
+        try:
+            return call()
+        finally:
+            _nb_worker_tls.active = False
+            set_env(None)
+
+    return st.submit(run).result()
+
+
+def Ibarrier(comm: Comm) -> CollRequest:
+    """Nonblocking barrier: complete once every rank has entered."""
+    return _nb_submit(comm, lambda: Barrier(comm))
+
+
+def Ibcast(buf: Any, root: int, comm: Comm) -> CollRequest:
+    """Nonblocking Bcast; ``req.result`` is the (mutated) buffer."""
+    return _nb_submit(comm, lambda: Bcast(buf, root, comm))
+
+
+def Iallreduce(*args) -> CollRequest:
+    """Nonblocking Allreduce (same flavors as :func:`Allreduce`); the
+    allocating variant's value arrives in ``req.result``."""
+    return _nb_submit(_comm_of(args), lambda: Allreduce(*args))
+
+
+def Ireduce(*args) -> CollRequest:
+    """Nonblocking rooted Reduce."""
+    return _nb_submit(_comm_of(args), lambda: Reduce(*args))
+
+
+def Igather(*args) -> CollRequest:
+    """Nonblocking rooted Gather."""
+    return _nb_submit(_comm_of(args), lambda: Gather(*args))
+
+
+def Iallgather(*args) -> CollRequest:
+    """Nonblocking Allgather."""
+    return _nb_submit(_comm_of(args), lambda: Allgather(*args))
+
+
+def Iscatter(*args) -> CollRequest:
+    """Nonblocking rooted Scatter."""
+    return _nb_submit(_comm_of(args), lambda: Scatter(*args))
+
+
+def Ialltoall(*args) -> CollRequest:
+    """Nonblocking Alltoall."""
+    return _nb_submit(_comm_of(args), lambda: Alltoall(*args))
+
+
+def Iscan(*args) -> CollRequest:
+    """Nonblocking inclusive Scan."""
+    return _nb_submit(_comm_of(args), lambda: Scan(*args))
+
+
+def Iexscan(*args) -> CollRequest:
+    """Nonblocking exclusive Scan."""
+    return _nb_submit(_comm_of(args), lambda: Exscan(*args))
+
+
+def _comm_of(args) -> Comm:
+    if not args or not isinstance(args[-1], Comm):
+        raise TypeError("the last argument must be the communicator")
+    return args[-1]
+
+

@@ -18,7 +18,7 @@ from __future__ import annotations
 import enum
 from typing import Optional, Sequence
 
-from ._runtime import UNDEFINED, CollectiveChannel, require_env
+from ._runtime import UNDEFINED, CollectiveChannel, current_env, require_env
 from .error import InvalidCommError, MPIError
 
 
@@ -101,6 +101,18 @@ class Comm:
         are device-resident by construction; each rank binds one device)."""
         ctx, world_rank = require_env()
         return ctx.device_for(world_rank)
+
+    def free(self) -> None:
+        """Mark the communicator unusable and release this rank's
+        nonblocking-collective worker thread, if one was created
+        (src/comm.jl MPI_Comm_free analog — no C resources, but the
+        I-collective executor is a real thread)."""
+        self._freed = True
+        env = current_env()
+        if env is not None:
+            from .collective import nb_shutdown
+            ctx, world_rank = env
+            nb_shutdown(ctx, cid=self._cid, world_rank=world_rank)
 
     def py2f(self) -> int:
         return self._cid
@@ -471,10 +483,11 @@ def Comm_compare(comm1: Comm, comm2: Comm) -> Comparison:
 def free(obj) -> None:
     """Release a communicator/window/datatype (src/handle.jl:50, src/comm.jl).
 
-    No C resources back these objects; freeing just marks them unusable."""
+    No C resources back these objects; freeing marks them unusable (and a
+    communicator's free() also reclaims its I-collective worker thread)."""
     if isinstance(obj, (_WorldComm, _SelfComm, _NullComm)):
         raise MPIError("cannot free a builtin communicator")
-    if hasattr(obj, "_freed"):
-        obj._freed = True
-    elif hasattr(obj, "free"):
+    if hasattr(obj, "free"):
         obj.free()
+    elif hasattr(obj, "_freed"):
+        obj._freed = True

@@ -120,6 +120,10 @@ def Finalize() -> None:
         raise MPIError("MPI.Finalize() before MPI.Init()")
     if ctx.finalized[rank]:
         raise MPIError("MPI.Finalize() was already called on this rank")
+    # reclaim every I-collective worker this rank created (one thread per
+    # communicator that saw a nonblocking collective)
+    from .collective import nb_shutdown
+    nb_shutdown(ctx, world_rank=rank)
     ctx.finalized[rank] = True
 
 
