@@ -229,3 +229,84 @@ def test_cart_device_mesh_cpu():
         assert mesh2.axis_names == ("x", "y")
 
     run_spmd(body, 8)
+
+
+def test_neighbor_allgather_ring(nprocs):
+    """MPI-3 Neighbor_allgather on a periodic 1-d grid: slots are
+    [-1 neighbor, +1 neighbor] values (beyond-reference feature)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        size = MPI.Comm_size(comm)
+        ring = MPI.Cart_create(comm, [size], [1], False)
+        r = MPI.Comm_rank(ring)
+        out = MPI.Neighbor_allgather(np.full(2, float(r)), ring)
+        got = np.asarray(out).reshape(2, 2)
+        assert got[0, 0] == (r - 1) % size, got     # negative-dir neighbor
+        assert got[1, 0] == (r + 1) % size, got
+
+    run_spmd(body, nprocs)
+
+
+def test_neighbor_alltoall_2d_boundaries(nprocs):
+    """Neighbor_alltoall on a non-periodic 2-d grid: distinct per-neighbor
+    blocks; PROC_NULL boundary slots stay zero."""
+    def body():
+        comm = MPI.COMM_WORLD
+        size = MPI.Comm_size(comm)
+        dims = MPI.Dims_create(size, [0, 0])
+        cart = MPI.Cart_create(comm, dims, [0, 0], False)
+        r = MPI.Comm_rank(cart)
+        nbrs = []
+        for d in range(2):
+            src, dst = MPI.Cart_shift(cart, d, 1)
+            nbrs.extend((src, dst))
+        # block i carries (100*me + 10*i) so the receiver can attribute it
+        send = np.concatenate([np.full(3, 100.0 * r + 10 * i)
+                               for i in range(4)])
+        out = np.asarray(MPI.Neighbor_alltoall(send, 3, cart)).reshape(4, 3)
+        for i, nb in enumerate(nbrs):
+            if nb == MPI.PROC_NULL:
+                assert np.all(out[i] == 0), (r, i, out)
+            else:
+                # neighbor nb sent ME its block aimed at my direction:
+                # I sit at index j in ITS neighbor list where j is i^1
+                # (its opposite direction along the same dimension)
+                assert np.all(out[i] == 100.0 * nb + 10 * (i ^ 1)), \
+                    (r, i, nb, out)
+
+    run_spmd(body, nprocs)
+
+
+def test_neighbor_requires_cart(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        try:
+            MPI.Neighbor_allgather(np.zeros(2), comm)
+            raise AssertionError("expected MPIError")
+        except MPI.MPIError:
+            pass
+
+    run_spmd(body, nprocs)
+
+
+def test_neighbor_allgather_mutating_preserves_proc_null_slots(nprocs):
+    """A caller-provided recv buffer keeps its pre-filled boundary values in
+    PROC_NULL slots (MPI semantics: those receives never happen)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        size = MPI.Comm_size(comm)
+        line = MPI.Cart_create(comm, [size], [0], False)   # non-periodic
+        r = MPI.Comm_rank(line)
+        recv = np.full(4, -7.0)                             # boundary fill
+        MPI.Neighbor_allgather(np.full(2, float(r)), recv, line)
+        got = recv.reshape(2, 2)
+        if r == 0:
+            assert np.all(got[0] == -7.0), got              # no -1 neighbor
+        else:
+            assert np.all(got[0] == r - 1), got
+        if r == size - 1:
+            assert np.all(got[1] == -7.0), got              # no +1 neighbor
+        else:
+            assert np.all(got[1] == r + 1), got
+
+    run_spmd(body, nprocs)

@@ -335,3 +335,98 @@ def Cart_sub(comm: CartComm, remain_dims: Sequence) -> Comm:
         sub_devices = [comm._devices[parent_rank[w]] for w in sub.group]
     return CartComm(sub.group, sub.cid, sub_dims or [1], sub_periods or [False],
                     name=f"{comm.name}.sub", devices=sub_devices)
+
+
+# ---------------------------------------------------------------------------
+# Neighborhood collectives (MPI-3 MPI_Neighbor_allgather / _alltoall —
+# absent from the reference v0.14.2; provided beyond parity). The
+# neighborhood of a Cartesian rank is its 2*ndims Cart_shift neighbors in
+# MPI order (per dimension: negative-displacement neighbor first), with
+# PROC_NULL at non-periodic boundaries leaving the matching slot untouched
+# (zeros in the allocating variant) — exactly the halo-exchange access
+# pattern (SURVEY.md §2.5 halo row) as one collective call.
+# ---------------------------------------------------------------------------
+
+# Internal tag for neighborhood exchanges, above any sane user tag space.
+_NEIGHBOR_TAG = (1 << 29) + 101
+
+
+def _neighbor_list(comm: CartComm) -> list[int]:
+    nbrs: list[int] = []
+    for d in range(len(comm.dims)):
+        src, dst = Cart_shift(comm, d, 1)
+        nbrs.extend((src, dst))
+    return nbrs
+
+
+def _neighbor_exchange(sendblocks, recvbuf, count: int, comm: CartComm,
+                       template) -> Any:
+    """Shared engine: sendblocks[i] goes to neighbor i; block i of the
+    result comes from neighbor i. PROC_NULL slots are zeros in the
+    allocating variant and LEFT UNTOUCHED in a caller-provided recvbuf
+    (MPI PROC_NULL semantics: the receive never happens, so pre-filled
+    boundary values survive)."""
+    from .buffers import clone_like, extract_array, write_range
+    from .pointtopoint import Irecv, Isend, Waitall
+
+    nbrs = _neighbor_list(comm)
+    dtype = extract_array(template).dtype
+    rows = np.zeros((len(nbrs), count), dtype=dtype)
+    reqs = []
+    for i, nb in enumerate(nbrs):
+        if nb != PROC_NULL:
+            reqs.append(Irecv(rows[i], nb, _NEIGHBOR_TAG, comm))
+    for i, nb in enumerate(nbrs):
+        if nb != PROC_NULL:
+            reqs.append(Isend(sendblocks[i], nb, _NEIGHBOR_TAG, comm))
+    Waitall(reqs)
+    if recvbuf is None:
+        return clone_like(template, rows)
+    for i, nb in enumerate(nbrs):
+        if nb != PROC_NULL:
+            write_range(recvbuf, i * count, rows[i])
+    return recvbuf
+
+
+def Neighbor_allgather(*args) -> Any:
+    """``Neighbor_allgather(send, [recv,] comm)`` — every rank sends its
+    whole buffer to each Cartesian neighbor and receives each neighbor's
+    buffer into slot i of the (2*ndims, count) result (MPI-3
+    MPI_Neighbor_allgather; neighbor order per :func:`Cart_shift`)."""
+    if len(args) == 2:
+        sendbuf, comm = args
+        recvbuf = None
+    elif len(args) == 3:
+        sendbuf, recvbuf, comm = args
+    else:
+        raise TypeError("Neighbor_allgather(send, [recv,] comm)")
+    if not isinstance(comm, CartComm):
+        raise MPIError("Neighbor_allgather requires a Cartesian communicator")
+    from .buffers import element_count
+    count = element_count(sendbuf)
+    nbrs = _neighbor_list(comm)
+    return _neighbor_exchange([sendbuf] * len(nbrs), recvbuf, count, comm,
+                              sendbuf)
+
+
+def Neighbor_alltoall(*args) -> Any:
+    """``Neighbor_alltoall(send, [recv,] count, comm)`` — block i of the
+    send buffer goes to neighbor i; block i of the result arrives from
+    neighbor i (MPI-3 MPI_Neighbor_alltoall). ``send`` holds 2*ndims
+    blocks of ``count`` elements in neighbor order."""
+    if len(args) == 3:
+        sendbuf, count, comm = args
+        recvbuf = None
+    elif len(args) == 4:
+        sendbuf, recvbuf, count, comm = args
+    else:
+        raise TypeError("Neighbor_alltoall(send, [recv,] count, comm)")
+    if not isinstance(comm, CartComm):
+        raise MPIError("Neighbor_alltoall requires a Cartesian communicator")
+    from .buffers import assert_minlength, to_wire
+    count = int(count)
+    nbrs = _neighbor_list(comm)
+    n = len(nbrs)
+    assert_minlength(sendbuf, n * count)   # the package-wide bounds guard
+    flat = to_wire(sendbuf, n * count).reshape(n, count)
+    return _neighbor_exchange(list(flat), recvbuf, count, comm, sendbuf)
