@@ -19,6 +19,7 @@ EXAMPLES = os.path.join(REPO, "examples")
     ("04-sendrecv.py", 4),
     ("05-ingraph.py", 8),
     ("06-jacobi.py", 4),
+    ("07-overlap.py", 4),
 ])
 def test_example_runs(name, nsim):
     env = dict(os.environ)

@@ -385,3 +385,40 @@ def test_debug_sequence_check_roundtrip():
     finally:
         os.environ.pop("TPU_MPI_DEBUG_SEQUENCE", None)
         config.load(refresh=True)
+
+
+def test_persistent_requests_halo_loop(AT, nprocs):
+    """Send_init/Recv_init/Startall (MPI persistent requests, beyond the
+    reference): one bound pattern re-armed per iteration of a halo loop,
+    buffers updated between rounds."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        size = MPI.Comm_size(comm)
+        nxt, prv = (rank + 1) % size, (rank - 1) % size
+        sbuf = AT.zeros(3)
+        rbuf = AT.zeros(3)
+        sreq = MPI.Send_init(sbuf, nxt, 21, comm)
+        rreq = MPI.Recv_init(rbuf, prv, 21, comm)
+        assert not sreq.active and not rreq.active
+        for it in range(5):
+            sbuf[0] = float(rank * 100 + it)   # refresh before re-arming
+            MPI.Startall([rreq, sreq])
+            assert rreq.active
+            sts = MPI.Waitall([sreq, rreq])
+            assert len(sts) == 2
+            assert np.asarray(rbuf)[0] == prv * 100 + it, (rank, it, rbuf)
+        # double-Start of an active request is an error
+        MPI.Start(rreq)
+        with pytest.raises(MPI.MPIError):
+            MPI.Start(rreq)
+        MPI.Start(sreq)
+        MPI.Waitall([sreq, rreq])
+        # Start on a non-persistent request refuses
+        with pytest.raises(MPI.MPIError):
+            MPI.Start(MPI.Isend(AT.zeros(1), nxt, 22, comm))
+        buf = AT.zeros(1)
+        MPI.Recv(buf, prv, 22, comm)
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs)

@@ -57,8 +57,9 @@ from .collective import (Allgather, Allgatherv, Allreduce, Alltoall,
 
 # Point-to-point (src/pointtopoint.jl)
 from .pointtopoint import (Cancel, Get_count, Get_error, Get_source, Get_tag,
-                           Iprobe, Irecv, Isend, Probe, Recv, Request,
-                           REQUEST_NULL, Send, Sendrecv, Status, STATUS_EMPTY,
+                           Iprobe, Irecv, Isend, Prequest, Probe, Recv,
+                           Recv_init, Request, REQUEST_NULL, Send, Send_init,
+                           Sendrecv, Start, Startall, Status, STATUS_EMPTY,
                            Test, Testall, Testany, Testsome, Wait, Waitall,
                            Waitany, Waitsome, irecv, isend, recv, send)
 
@@ -78,6 +79,25 @@ from .onesided import (Accumulate, Fetch_and_op, Get, Get_accumulate,
 from .topology import (Cart_coords, Cart_create, Cart_get, Cart_rank,
                        Cart_shift, Cart_sub, CartComm, Cartdim_get,
                        Dims_create, Neighbor_allgather, Neighbor_alltoall)
+# Null-handle constants and library identity (reference parity:
+# src/handle.jl null consts, src/implementations.jl MPI_LIBRARY /
+# MPI_VERSION). No FFI handles exist here, so the nulls are plain
+# sentinels usable in comparisons.
+DATATYPE_NULL = None
+OP_NULL = None
+WIN_NULL = None
+FILE_NULL = None
+MPI_LIBRARY = "tpu_mpi"
+MPI_VERSION = Get_version()
+
+
+def __getattr__(name):
+    # lazily computed: building the version string imports jax
+    if name == "MPI_LIBRARY_VERSION_STRING":
+        return Get_library_version()
+    raise AttributeError(f"module 'tpu_mpi' has no attribute {name!r}")
+
+
 def install_tpurun(*args, **kwargs):
     """Install the ``tpurun`` wrapper executable (MPI.install_mpiexecjl
     analog). Lazy import: eagerly importing .launcher here would put it in

@@ -465,3 +465,99 @@ def Testsome(reqs: Sequence[Request]):
 def Cancel(req: Request) -> None:
     """Cancel a pending receive (ref ``Cancel!`` :677-681)."""
     req.cancel()
+
+
+# ---------------------------------------------------------------------------
+# Persistent requests (MPI_Send_init / MPI_Recv_init / MPI_Start — absent
+# from the reference v0.14.2; provided beyond parity). A persistent request
+# binds the (buffer, peer, tag, comm) pattern once and Start re-arms it per
+# round — the MPI API shape for fixed-pattern exchanges (halo loops,
+# pipeline hops). Semantics only: each Start performs a full Isend/Irecv
+# under the hood, so there is no setup-amortization fast path here (an MPI
+# implementation MAY optimize persistent rounds; this one does not yet).
+# ---------------------------------------------------------------------------
+
+class Prequest:
+    """Persistent communication request.
+
+    Duck-types the Request completion protocol, so the whole Wait/Test
+    family accepts it. Completion returns it to INACTIVE-BUT-REUSABLE
+    (MPI semantics: a completed persistent request is not freed); call
+    :func:`Start` to re-arm it. The bound buffer stays attached across
+    rounds."""
+
+    def __init__(self, make, kind: str, buffer: Any):
+        self._make = make           # () -> a live one-shot Request
+        self._inner: Optional[Request] = None
+        self.kind = kind            # "psend" | "precv"
+        self.buffer = buffer
+        self.status: Optional[Status] = None
+
+    def start(self) -> "Prequest":
+        if self._inner is not None and self._inner.active:
+            raise MPIError("Start on an already-active persistent request")
+        self._inner = self._make()
+        return self
+
+    @property
+    def active(self) -> bool:
+        return self._inner is not None and self._inner.active
+
+    def test(self) -> bool:
+        if self._inner is None:
+            return True
+        return self._inner.test()
+
+    def wait(self) -> Status:
+        if self._inner is None:
+            return self.status or STATUS_EMPTY
+        self.status = self._inner.wait()
+        self._inner = None          # inactive, ready for the next Start
+        return self.status
+
+    def _consume(self) -> Status:
+        if self._inner is None:
+            return self.status or STATUS_EMPTY
+        self.status = self._inner._consume() if self._inner.active \
+            else (self._inner.status or STATUS_EMPTY)
+        self._inner = None
+        return self.status
+
+    def cancel(self) -> None:
+        if self._inner is not None:
+            self._inner.cancel()
+
+    def __repr__(self) -> str:
+        return f"<Prequest {self.kind} active={self.active}>"
+
+
+def Send_init(buf: Any, dest: int, tag: int, comm: Comm) -> Prequest:
+    """Create an inactive persistent send of ``buf`` to ``dest``
+    (MPI_Send_init). Arm with :func:`Start`; each round snapshots the
+    buffer's CURRENT contents (update it between rounds freely)."""
+    def make():
+        return Isend(buf, dest, tag, comm)
+    return Prequest(make, "psend", buf)
+
+
+def Recv_init(buf: Any, src: int, tag: int, comm: Comm) -> Prequest:
+    """Create an inactive persistent receive into ``buf``
+    (MPI_Recv_init). Arm with :func:`Start`."""
+    def make():
+        return Irecv(buf, src, tag, comm)
+    return Prequest(make, "precv", buf)
+
+
+def Start(req: Prequest) -> Prequest:
+    """Arm a persistent request (MPI_Start)."""
+    if not isinstance(req, Prequest):
+        raise MPIError("Start requires a persistent request "
+                       "(Send_init/Recv_init)")
+    return req.start()
+
+
+def Startall(reqs: Sequence[Prequest]) -> Sequence[Prequest]:
+    """Arm several persistent requests (MPI_Startall)."""
+    for r in reqs:
+        Start(r)
+    return reqs
