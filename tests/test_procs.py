@@ -930,3 +930,44 @@ def test_nonblocking_collectives_across_processes():
     assert res.returncode == 0, (res.stdout, res.stderr)
     for r in range(4):
         assert f"ICOLL-OK-{r}" in res.stdout
+
+
+def test_procs_children_get_distinct_chip_bindings():
+    """Real-hardware --procs deployment: each child process is bound to its
+    own local TPU chip via TPU_VISIBLE_DEVICES (libtpu is process-exclusive;
+    unbound children would fight over the whole host). --sim children are
+    exempt (forced to CPU); an explicit caller value wins."""
+    body = textwrap.dedent("""
+        import os
+        import tpu_mpi as MPI
+        MPI.Init()
+        rank = MPI.COMM_WORLD.rank()
+        print(f"CHIP-{rank}={os.environ.get('TPU_VISIBLE_DEVICES')}",
+              flush=True)
+        MPI.Finalize()
+    """)
+    path = "/tmp/tpu_mpi_chipbind.py"
+    with open(path, "w") as f:
+        f.write(f"import sys; sys.path.insert(0, {REPO!r})\n" + body)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("TPU_MPI_PROC_RANK", None)
+    env.pop("TPU_VISIBLE_DEVICES", None)
+    env["JAX_PLATFORMS"] = "cpu"             # no real chip touched here
+    res = subprocess.run(
+        [sys.executable, "-m", "tpu_mpi.launcher", "-n", "3", "--procs",
+         "--timeout", "120", path],
+        capture_output=True, text=True, timeout=150, env=env, cwd=REPO)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    for r in range(3):
+        assert f"CHIP-{r}={r}" in res.stdout, res.stdout
+    # a caller-set TPU_VISIBLE_DEVICES is the allowed chip POOL: child i
+    # gets the i-th entry, never the whole multi-chip set verbatim
+    env2 = dict(env, TPU_VISIBLE_DEVICES="4,5,6")
+    res = subprocess.run(
+        [sys.executable, "-m", "tpu_mpi.launcher", "-n", "3", "--procs",
+         "--timeout", "120", path],
+        capture_output=True, text=True, timeout=150, env=env2, cwd=REPO)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    for r, chip in enumerate(("4", "5", "6")):
+        assert f"CHIP-{r}={chip}" in res.stdout, res.stdout

@@ -81,12 +81,28 @@ from .topology import (Cart_coords, Cart_create, Cart_get, Cart_rank,
                        Dims_create, Neighbor_allgather, Neighbor_alltoall)
 # Null-handle constants and library identity (reference parity:
 # src/handle.jl null consts, src/implementations.jl MPI_LIBRARY /
-# MPI_VERSION). No FFI handles exist here, so the nulls are plain
-# sentinels usable in comparisons.
-DATATYPE_NULL = None
-OP_NULL = None
-WIN_NULL = None
-FILE_NULL = None
+# MPI_VERSION). No FFI handles exist here; each null is its own distinct
+# sentinel so `x is MPI.WIN_NULL` cannot be confused with another handle
+# kind or with a plain None default.
+
+
+class _NullHandle:
+    __slots__ = ("_name",)
+
+    def __init__(self, name):
+        self._name = name
+
+    def __repr__(self):
+        return self._name
+
+    def __bool__(self):
+        return False
+
+
+DATATYPE_NULL = _NullHandle("DATATYPE_NULL")
+OP_NULL = _NullHandle("OP_NULL")
+WIN_NULL = _NullHandle("WIN_NULL")
+FILE_NULL = _NullHandle("FILE_NULL")
 MPI_LIBRARY = "tpu_mpi"
 MPI_VERSION = Get_version()
 

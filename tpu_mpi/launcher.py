@@ -132,6 +132,25 @@ def launch_processes(path: str, nprocs: int,
                         + f" --xla_force_host_platform_device_count={sim}"
                     ).strip()
                 env.pop("PALLAS_AXON_POOL_IPS", None)
+            else:
+                # Real-hardware procs tier: libtpu is process-exclusive, so
+                # without a per-child chip assignment every rank process
+                # would fight over the whole host's TPUs. Bind rank i of
+                # this invocation to local chip i (the mpiexec local-rank ↔
+                # accelerator convention). A caller-set TPU_VISIBLE_DEVICES
+                # is treated as the allowed chip POOL: child i gets the
+                # i-th entry (a verbatim pass-through would hand every
+                # child the same multi-chip set — the very contention this
+                # binding prevents).
+                local_idx = rank - rank_base
+                pool = env.get("TPU_VISIBLE_DEVICES")
+                if pool is None:
+                    env["TPU_VISIBLE_DEVICES"] = str(local_idx)
+                else:
+                    chips = [c for c in pool.split(",") if c.strip()]
+                    if chips:
+                        env["TPU_VISIBLE_DEVICES"] = \
+                            chips[local_idx % len(chips)]
             procs.append(subprocess.Popen(
                 [sys.executable, path] + list(script_args or []), env=env))
         code = 0
