@@ -963,7 +963,7 @@ def test_procs_children_get_distinct_chip_bindings():
         assert f"CHIP-{r}={r}" in res.stdout, res.stdout
     # a caller-set TPU_VISIBLE_DEVICES is the allowed chip POOL: child i
     # gets the i-th entry, never the whole multi-chip set verbatim
-    env2 = dict(env, TPU_VISIBLE_DEVICES="4,5,6")
+    env2 = dict(env, TPU_VISIBLE_DEVICES="4, 5, 6")   # tolerate spaces
     res = subprocess.run(
         [sys.executable, "-m", "tpu_mpi.launcher", "-n", "3", "--procs",
          "--timeout", "120", path],
@@ -971,3 +971,11 @@ def test_procs_children_get_distinct_chip_bindings():
     assert res.returncode == 0, (res.stdout, res.stderr)
     for r, chip in enumerate(("4", "5", "6")):
         assert f"CHIP-{r}={chip}" in res.stdout, res.stdout
+    # an undersized pool fails loudly instead of double-binding a chip
+    env3 = dict(env, TPU_VISIBLE_DEVICES="4,5")
+    res = subprocess.run(
+        [sys.executable, "-m", "tpu_mpi.launcher", "-n", "3", "--procs",
+         "--timeout", "60", path],
+        capture_output=True, text=True, timeout=90, env=env3, cwd=REPO)
+    assert res.returncode != 0
+    assert "at least one chip per local rank" in res.stderr, res.stderr

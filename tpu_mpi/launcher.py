@@ -147,10 +147,17 @@ def launch_processes(path: str, nprocs: int,
                 if pool is None:
                     env["TPU_VISIBLE_DEVICES"] = str(local_idx)
                 else:
-                    chips = [c for c in pool.split(",") if c.strip()]
+                    chips = [c.strip() for c in pool.split(",") if c.strip()]
+                    if chips and local_idx >= len(chips):
+                        # silently wrapping would double-bind a chip — the
+                        # exact process-exclusive contention this prevents
+                        raise SystemExit(
+                            f"tpurun: TPU_VISIBLE_DEVICES lists "
+                            f"{len(chips)} chip(s) but this invocation "
+                            f"launches {nprocs} rank processes; provide at "
+                            f"least one chip per local rank")
                     if chips:
-                        env["TPU_VISIBLE_DEVICES"] = \
-                            chips[local_idx % len(chips)]
+                        env["TPU_VISIBLE_DEVICES"] = chips[local_idx]
             procs.append(subprocess.Popen(
                 [sys.executable, path] + list(script_args or []), env=env))
         code = 0
