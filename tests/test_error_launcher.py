@@ -81,3 +81,15 @@ def test_install_tpurun(tmp_path):
     res = subprocess.run([dest, "--help"], capture_output=True, text=True,
                          timeout=120)
     assert res.returncode == 0 and "SPMD" in res.stdout
+
+
+def test_error_string_parity():
+    """Error_string names known codes and degrades clearly for unknown ones
+    (src/error.jl:11-19 parity; exceptions already carry full messages)."""
+    import tpu_mpi as MPI
+    assert "MPI_SUCCESS" in MPI.Error_string(0)
+    assert "error" in MPI.Error_string(1)
+    assert "unknown" in MPI.Error_string(12345)
+    # exceptions carry the code Error_string names
+    e = MPI.MPIError("boom")
+    assert e.code == 1 and "boom" in str(e)

@@ -96,3 +96,68 @@ def test_random_collective_lockstep(nprocs):
 
     run_spmd(body, nprocs)
     assert not failures, failures[:3]
+
+
+def test_random_nonblocking_interleave_lockstep(nprocs):
+    """Randomized schedule mixing nonblocking collectives (completed after a
+    random number of later operations) with blocking ones on the same comm —
+    stressing the per-comm worker's initiation-order guarantee under every
+    interleaving the RNG produces. Deterministic (seeded)."""
+    rng = np.random.default_rng(777)
+    schedule = []
+    for _ in range(30):
+        op = rng.choice(["iallreduce", "iallgather", "iscan", "ibarrier",
+                         "allreduce", "bcast", "allgather"])
+        root = int(rng.integers(nprocs))
+        shape = (int(rng.integers(1, 17)),)
+        data = [(rng.integers(-40, 40, shape)).astype(np.float64)
+                for _ in range(nprocs)]
+        defer = int(rng.integers(0, 3))     # ops to run before the Wait
+        schedule.append((op, root, data, defer))
+
+    failures = []
+
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = comm.rank()
+        pending = []                        # (step, req, expect or None)
+
+        def drain(upto):
+            while pending and (len(pending) > upto):
+                step, req, expect = pending.pop(0)
+                MPI.Wait(req)
+                if expect is not None and not np.array_equal(
+                        np.asarray(req.result), expect):
+                    failures.append((step, rank, req.result, expect))
+
+        for i, (op, root, data, defer) in enumerate(schedule):
+            mine = data[rank]
+            if op == "iallreduce":
+                pending.append((i, MPI.Iallreduce(mine, MPI.SUM, comm),
+                                np.sum(data, axis=0)))
+            elif op == "iallgather":
+                pending.append((i, MPI.Iallgather(mine, comm),
+                                np.concatenate(data)))
+            elif op == "iscan":
+                pending.append((i, MPI.Iscan(mine, MPI.SUM, comm),
+                                np.cumsum(data, axis=0)[rank]))
+            elif op == "ibarrier":
+                pending.append((i, MPI.Ibarrier(comm), None))
+            elif op == "allreduce":
+                got = MPI.Allreduce(mine, MPI.SUM, comm)
+                if not np.array_equal(np.asarray(got), np.sum(data, axis=0)):
+                    failures.append((i, rank, got, "allreduce"))
+            elif op == "bcast":
+                buf = mine.copy()
+                MPI.Bcast(buf, root, comm)
+                if not np.array_equal(buf, data[root]):
+                    failures.append((i, rank, buf, "bcast"))
+            elif op == "allgather":
+                got = MPI.Allgather(mine, comm)
+                if not np.array_equal(np.asarray(got), np.concatenate(data)):
+                    failures.append((i, rank, got, "allgather"))
+            drain(defer)
+        drain(0)
+
+    run_spmd(body, nprocs)
+    assert not failures, failures[:3]

@@ -19,7 +19,8 @@ from .implementations import Get_library_version, Get_version
 from ._runtime import (ANY_SOURCE, ANY_TAG, PROC_NULL, UNDEFINED,
                        SpmdContext, spmd_run)
 from .error import (AbortError, CollectiveMismatchError, DeadlockError,
-                    InvalidCommError, MPIError, TruncationError)
+                    Error_string, InvalidCommError, MPIError,
+                    TruncationError)
 
 # Environment / lifecycle (src/environment.jl)
 from .environment import (Abort, Finalize, Finalized, Init, Init_thread,

@@ -49,3 +49,20 @@ class CollectiveMismatchError(MPIError):
 
 class InvalidCommError(MPIError):
     """Operation on COMM_NULL or a freed communicator."""
+
+
+# Code → description, in the spirit of MPI_Error_string
+# (/root/reference/src/error.jl:11-19 wraps it). The TPU-native runtime
+# raises typed exceptions rather than integer codes, so the table simply
+# names the classes' codes for FFI-shaped callers.
+_ERROR_STRINGS = {
+    0: "MPI_SUCCESS: no error",
+    1: "MPI error (see the raised exception's message for detail)",
+}
+
+
+def Error_string(code: int) -> str:
+    """Human-readable description of an error code
+    (src/error.jl:11-19 ``error_string``). Exceptions carry their full
+    message already; this exists for MPI-API parity."""
+    return _ERROR_STRINGS.get(int(code), f"unknown MPI error code {code}")
