@@ -16,15 +16,17 @@ import argparse
 import sys
 import time
 
-from common import emit
+from common import emit, force_cpu_sim
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("-o", "--out", default="-")
-    ap.add_argument("--sizes", default="2,4,8,16")
+    ap.add_argument("--sizes", default="2,4,8,16,32,64")
     args = ap.parse_args()
 
+    sizes = [int(s) for s in args.sizes.split(",")]
+    force_cpu_sim(max(sizes))
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -34,7 +36,7 @@ def main() -> None:
 
     devs = jax.devices()
     rows = []
-    for n in [int(s) for s in args.sizes.split(",")]:
+    for n in sizes:
         if n > len(devs):
             print(f"n={n}: only {len(devs)} devices, skipped", file=sys.stderr)
             continue

@@ -131,6 +131,21 @@ def size_sweep(max_bytes: int, min_bytes: int = 8) -> list[int]:
     return out
 
 
+def force_cpu_sim(n_devices: int) -> None:
+    """Pin this process to n fake XLA CPU devices, neutralizing the axon TPU
+    PJRT plugin (same dance as tests/conftest.py — the plugin's presence makes
+    CPU-only backend init hang on the TPU tunnel). Call before first jax use."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}").strip()
+    import jax
+    import jax._src.xla_bridge as xb
+    jax.config.update("jax_platforms", "cpu")
+    xb._backend_factories.pop("axon", None)
+
+
 def devices_with_watchdog(timeout_s: float = 240.0):
     """jax.devices() via the TPU tunnel can hang indefinitely when the tunnel
     is unhealthy; probe it on a daemon thread so sweeps always terminate

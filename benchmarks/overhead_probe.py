@@ -1,0 +1,260 @@
+"""Device-lane overhead breakdown probe (VERDICT r3 next-item #1).
+
+The round-3 headline (40 GB/s Allreduce = 0.24x of the 164 GB/s path
+roofline) implied ~21 ms/op of unaccounted dispatch overhead: the TPU sweep
+(`allreduce-tpu-v5e.json`) is latency-flat ~22-28 ms for every size >=128 MiB
+while roofline data movement at 256 MiB is ~1.6 ms. This probe decomposes the
+per-op time of the device lane on the real chip into:
+
+  A. ``null_rtt``          — jitted scalar +1, chained: pure dispatch RTT,
+                             operand-size ~zero.
+  B. ``elementwise``       — jitted ``x+1`` over Float32[2^26] (2x payload of
+                             HBM traffic), chained. The *irreducible per-op
+                             floor* of any single-dispatch 256 MiB op through
+                             this tunnel — the control row VERDICT asks for.
+  C. ``elementwise_donate``— same with ``donate_argnums=0``: eliminates the
+                             256 MiB alloc+free churn each chained op causes
+                             (diagnostic only — MPI semantics forbid donating
+                             user-visible send buffers).
+  D. ``fold4``             — the Allreduce combine itself, outside all MPI
+                             machinery: one jitted 4-operand left-fold sum
+                             (4 reads + 1 write = 5x payload), chained.
+  E. ``fused_elementwise`` — K=64 ``x+1`` steps inside ONE jit via fori_loop:
+                             amortizes the tunnel away; measures the chip's
+                             actual HBM rate under this harness (2x traffic).
+  F. ``fused_fold4``       — K=16 4-operand folds inside ONE jit (5x traffic
+                             per step): the *measured* execution roofline for
+                             the Allreduce fold, replacing the spec-sheet
+                             819 GB/s in the breakdown model.
+  G. ``mpi_allreduce``     — the full MPI.Allreduce device lane, 4 rank
+                             threads (exactly bench.py's headline protocol,
+                             shared impl in benchmarks/common.py).
+
+Every chain is data-dependent (op k+1 consumes op k's output) and every timed
+block ends with a one-element readback asserted against the closed-form chain
+value — unexecuted work fails instead of timing as fast (BASELINE.md
+"Protocol").
+
+Derived breakdown written to the artifact:
+  tunnel_floor_ms   = B - E_per_step        (per-dispatch overhead at 256 MiB)
+  alloc_churn_ms    = B - C                 (part of the floor that is buffer
+                                             alloc/free, removable by donation)
+  mpi_overhead_ms   = G - D                 (rendezvous + buffer normalization)
+  model_ms          = (B - E_per_step) + F_per_step   (floor + measured
+                                             execution roofline for the fold)
+  mpi_vs_model      = G / model_ms          (<= 1.1 closes VERDICT #1's
+                                             second branch)
+
+Run: ``python benchmarks/overhead_probe.py [out.json]`` (default
+``benchmarks/results/overhead-probe-tpu.json``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+for p in (_REPO, _HERE):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+from common import best_block, detect_platform, emit, host_allreduce_times
+
+N_ELEMS = 1 << 26           # Float32[2^26] = 256 MiB, the headline payload
+NBYTES = N_ELEMS * 4
+WARMUP, ITERS, REPEATS = 3, 20, 6
+
+
+def _log(msg: str) -> None:
+    print(f"probe: {msg}", file=sys.stderr, flush=True)
+
+
+def _time_chain(step, force, warmup: int, iters: int, repeats: int) -> float:
+    """Best per-op seconds over ``repeats`` blocks of ``iters`` chained ops;
+    each block ends in a forcing readback asserted by ``force(ops)``."""
+    ops = 0
+    for _ in range(warmup):
+        step()
+        ops += 1
+    force(ops)                      # also forces warmup completion
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            step()
+            ops += 1
+        force(ops)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def case_null_rtt(jax, jnp) -> float:
+    f = jax.jit(lambda x: x + 1.0)
+    box = [jnp.zeros((), jnp.float32)]
+
+    def step():
+        box[0] = f(box[0])
+
+    def force(ops):
+        got = float(box[0])
+        assert got == float(ops), (got, ops)
+
+    return _time_chain(step, force, 10, 100, 4)
+
+
+def case_elementwise(jax, jnp, donate: bool, n_elems: int = N_ELEMS,
+                     iters: int = ITERS, repeats: int = REPEATS) -> float:
+    f = jax.jit(lambda x: x + 1.0,
+                donate_argnums=(0,) if donate else ())
+    box = [jnp.zeros(n_elems, jnp.float32)]
+
+    def step():
+        box[0] = f(box[0])
+
+    def force(ops):
+        got = float(box[0][0])
+        assert got == float(ops), (got, ops)
+
+    return _time_chain(step, force, WARMUP, iters, repeats)
+
+
+def case_fold4(jax, jnp) -> float:
+    ones = [jnp.ones(N_ELEMS, jnp.float32) for _ in range(3)]
+
+    def fold(x0, x1, x2, x3):
+        acc = x0
+        for x in (x1, x2, x3):      # same left fold as collective._jitted_fold
+            acc = acc + x
+        return acc
+
+    f = jax.jit(fold)
+    box = [jnp.ones(N_ELEMS, jnp.float32)]
+
+    def step():
+        box[0] = f(box[0], *ones)
+
+    def force(ops):
+        got = float(box[0][0])
+        assert got == float(1 + 3 * ops), (got, ops)
+
+    return _time_chain(step, force, WARMUP, ITERS, REPEATS)
+
+
+def case_fused_elementwise(jax, jnp, k: int = 64) -> float:
+    @jax.jit
+    def f(x):
+        return jax.lax.fori_loop(0, k, lambda i, a: a + 1.0, x)
+
+    box = [jnp.zeros(N_ELEMS, jnp.float32)]
+
+    def step():
+        box[0] = f(box[0])
+
+    def force(calls):
+        got = float(box[0][0])
+        assert got == float(calls * k), (got, calls)
+
+    per_call = _time_chain(step, force, 2, 3, 4)
+    return per_call / k
+
+
+def case_fused_fold4(jax, jnp, k: int = 16) -> float:
+    o1, o2, o3 = (jnp.ones(N_ELEMS, jnp.float32) for _ in range(3))
+
+    @jax.jit
+    def f(x, o1, o2, o3):
+        def body(i, a):
+            return a + o1 + o2 + o3     # 4 distinct reads + 1 write = 5x
+        return jax.lax.fori_loop(0, k, body, x)
+
+    box = [jnp.ones(N_ELEMS, jnp.float32)]
+
+    def step():
+        box[0] = f(box[0], o1, o2, o3)
+
+    def force(calls):
+        got = float(box[0][0])
+        assert got == float(1 + 3 * k * calls), (got, calls)
+
+    per_call = _time_chain(step, force, 2, 3, 4)
+    return per_call / k
+
+
+def case_floor_vs_size(jax, jnp) -> list[dict]:
+    """Map the tunnel floor's operand-size step structure (the r3 sweep shows
+    plateaus ~2 ms / ~10.7 ms / ~22 ms with jumps at 8 MiB and 128 MiB)."""
+    rows = []
+    for mib in (1, 4, 8, 32, 64, 128, 256):
+        n = (mib << 20) // 4
+        t = case_elementwise(jax, jnp, donate=False, n_elems=n,
+                             iters=10, repeats=3)
+        rows.append({"mib": mib, "lat_ms": round(t * 1e3, 3)})
+        _log(f"  floor[{mib} MiB] = {t * 1e3:.2f} ms")
+    return rows
+
+
+def main() -> None:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else \
+        os.path.join(_HERE, "results", "overhead-probe-tpu.json")
+    platform = detect_platform()
+    _log(f"platform: {platform}")
+    import jax
+    import jax.numpy as jnp
+
+    t_null = case_null_rtt(jax, jnp)
+    _log(f"A null_rtt           = {t_null * 1e3:.3f} ms")
+    t_ew = case_elementwise(jax, jnp, donate=False)
+    _log(f"B elementwise        = {t_ew * 1e3:.3f} ms")
+    t_ewd = case_elementwise(jax, jnp, donate=True)
+    _log(f"C elementwise_donate = {t_ewd * 1e3:.3f} ms")
+    t_fold = case_fold4(jax, jnp)
+    _log(f"D fold4              = {t_fold * 1e3:.3f} ms")
+    t_few = case_fused_elementwise(jax, jnp)
+    _log(f"E fused_elementwise  = {t_few * 1e3:.3f} ms/step")
+    t_ffold = case_fused_fold4(jax, jnp)
+    _log(f"F fused_fold4        = {t_ffold * 1e3:.3f} ms/step")
+    size_rows = case_floor_vs_size(jax, jnp)
+
+    _log("G mpi_allreduce (4 rank threads, device lane) ...")
+    times = host_allreduce_times(N_ELEMS, 4, True, WARMUP, ITERS, REPEATS)
+    t_mpi = best_block(times)
+    _log(f"G mpi_allreduce      = {t_mpi * 1e3:.3f} ms")
+
+    floor = t_ew - t_few
+    model = floor + t_ffold
+    derived = {
+        "tunnel_floor_ms": round(floor * 1e3, 3),
+        "alloc_churn_ms": round((t_ew - t_ewd) * 1e3, 3),
+        "mpi_overhead_ms": round((t_mpi - t_fold) * 1e3, 3),
+        "hbm_gbps_measured_elementwise": round(2 * NBYTES / t_few / 1e9, 1),
+        "hbm_gbps_measured_fold": round(5 * NBYTES / t_ffold / 1e9, 1),
+        "model_ms": round(model * 1e3, 3),
+        "mpi_vs_model": round(t_mpi / model, 4),
+        "mpi_algbw_gbps": round(NBYTES / t_mpi / 1e9, 3),
+        "model_algbw_gbps": round(NBYTES / model / 1e9, 3),
+    }
+    _log(f"derived: {derived}")
+    emit(out_path, {
+        "benchmark": "overhead_probe",
+        "platform": platform,
+        "n_elems": N_ELEMS,
+        "payload_mib": NBYTES >> 20,
+        "cases_ms": {
+            "null_rtt": round(t_null * 1e3, 3),
+            "elementwise": round(t_ew * 1e3, 3),
+            "elementwise_donate": round(t_ewd * 1e3, 3),
+            "fold4": round(t_fold * 1e3, 3),
+            "fused_elementwise_per_step": round(t_few * 1e3, 3),
+            "fused_fold4_per_step": round(t_ffold * 1e3, 3),
+            "mpi_allreduce": round(t_mpi * 1e3, 3),
+        },
+        "floor_vs_size": size_rows,
+        "derived": derived,
+    })
+
+
+if __name__ == "__main__":
+    main()

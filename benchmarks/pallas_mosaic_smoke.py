@@ -88,7 +88,33 @@ def main() -> None:
         lambda out: (out.shape == (1, 1024) and np.array_equal(out[0], np.asarray(x)),
                      "n=1 gather identity"))
 
-    # 3. ring_attention local block: MXU + online softmax, causal mask
+    # 3-5. the remaining ring/pairwise kernels at n=1 (VERDICT r3 #3: these
+    # three had only ever run interpret-mode; round 3 proved interpret hides
+    # compile-only constraints — the collective_id gating fix, commit 93a9c84)
+    r = jax.jit(jax.shard_map(
+        lambda v: pk.ring_allreduce(v, "sum", axis="x", interpret=False),
+        mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))
+    run("ring_allreduce", lambda: r(x),
+        lambda out: (np.array_equal(out, np.asarray(x)),
+                     "n=1 allreduce identity"))
+
+    rs = jax.jit(jax.shard_map(
+        lambda v: pk.ring_reduce_scatter(v, "sum", axis="x", interpret=False),
+        mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))
+    run("ring_reduce_scatter", lambda: rs(x),
+        lambda out: (np.array_equal(np.asarray(out).reshape(-1),
+                                    np.asarray(x)),
+                     "n=1 reduce_scatter identity"))
+
+    a2a = jax.jit(jax.shard_map(
+        lambda v: pk.pairwise_alltoall(v, axis="x", interpret=False),
+        mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))
+    run("pairwise_alltoall", lambda: a2a(x),
+        lambda out: (np.array_equal(np.asarray(out).reshape(-1),
+                                    np.asarray(x)),
+                     "n=1 alltoall identity"))
+
+    # 6. ring_attention local block: MXU + online softmax, causal mask
     t, d = 128, 64
     key = jax.random.PRNGKey(0)
     q, k, v = (jax.random.normal(kk, (t, d), jnp.float32)

@@ -88,8 +88,60 @@ def test_error_string_parity():
     (src/error.jl:11-19 parity; exceptions already carry full messages)."""
     import tpu_mpi as MPI
     assert "MPI_SUCCESS" in MPI.Error_string(0)
-    assert "error" in MPI.Error_string(1)
+    assert "MPI_ERR_BUFFER" in MPI.Error_string(1)
     assert "unknown" in MPI.Error_string(12345)
     # exceptions carry the code Error_string names
     e = MPI.MPIError("boom")
-    assert e.code == 1 and "boom" in str(e)
+    assert e.code == MPI.error.ERR_OTHER and "boom" in str(e)
+
+
+def test_error_class_codes_roundtrip():
+    """Every public exception class carries a distinct default code, and
+    Error_string maps each to a distinct descriptive string (VERDICT r3 #6;
+    /root/reference/src/error.jl:11-19 surfaces the full MPI_Error_string
+    space — here the class space is the MPI 4.0 §9.4 error classes)."""
+    import tpu_mpi as MPI
+    classes = [MPI.MPIError, MPI.AbortError, MPI.DeadlockError,
+               MPI.TruncationError, MPI.CollectiveMismatchError,
+               MPI.InvalidCommError]
+    codes = [cls("x").code for cls in classes]
+    assert len(set(codes)) == len(codes), f"codes not distinct: {codes}"
+    strings = [MPI.Error_string(c) for c in codes]
+    assert len(set(strings)) == len(strings)
+    for s in strings:
+        assert "unknown MPI error code" not in s and len(s) > 10
+    # an explicit code overrides the class default (Abort(errorcode) path,
+    # environment.py:141)
+    assert MPI.MPIError("x", code=7).code == 7
+
+
+def test_error_codes_at_raise_sites():
+    """Semantic raise sites carry the matching MPI error class, not a generic
+    code (VERDICT r3 #6 'meaningful codes at raise sites')."""
+    import numpy as np
+    import pytest
+    import tpu_mpi as MPI
+    from tpu_mpi import error as ec
+    from tpu_mpi.testing import run_spmd
+
+    def body():
+        comm = MPI.COMM_WORLD
+        buf = np.zeros(4, np.float32)
+        with pytest.raises(MPI.MPIError) as ei:
+            MPI.Bcast(buf, 99, comm)         # invalid root
+        assert ei.value.code == ec.ERR_ROOT
+        with pytest.raises(MPI.MPIError) as ei:
+            MPI.Allreduce(object(), MPI.SUM, comm)   # not a buffer
+        assert ei.value.code == ec.ERR_BUFFER
+
+    run_spmd(body, 2)
+
+    # out-of-runtime sites
+    from tpu_mpi.topology import Dims_create
+    with pytest.raises(MPI.MPIError) as ei:
+        Dims_create(7, [2, 2])
+    assert ei.value.code == ec.ERR_DIMS
+    info = MPI.Info()
+    with pytest.raises(MPI.MPIError) as ei:
+        info["k" * 300] = "v"
+    assert ei.value.code == ec.ERR_INFO_KEY

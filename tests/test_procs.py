@@ -462,6 +462,54 @@ def test_spawn_across_processes():
         assert f"SPAWN-OK-{r}" in res.stdout
 
 
+def test_intercomm_collectives_across_processes():
+    """Barrier/Bcast with MPI_ROOT semantics directly on the spawn intercomm,
+    parents and children in separate OS processes (VERDICT r3 #8; reference
+    /root/reference/src/comm.jl:135-162 — libmpi honors intercomm
+    collectives)."""
+    worker_path = "/tmp/tpu_mpi_inter_worker.py"
+    with open(worker_path, "w") as f:
+        f.write(textwrap.dedent(f"""
+            import sys; sys.path.insert(0, {REPO!r})
+            import numpy as np
+            import tpu_mpi as MPI
+            MPI.Init()
+            parent = MPI.Comm_get_parent()
+            assert parent is not MPI.COMM_NULL
+            rank = MPI.Comm_rank(MPI.COMM_WORLD)
+            MPI.Barrier(parent)
+            buf = np.zeros(4, np.float64)
+            MPI.Bcast(buf, 0, parent)          # sourced by parent 0
+            assert np.array_equal(buf, np.arange(4.0) + 7), buf
+            obj = {{"from": "child"}} if rank == 0 else None
+            got = MPI.bcast(obj, MPI.ROOT if rank == 0 else MPI.PROC_NULL,
+                            parent)
+            assert got is obj
+            MPI.Finalize()
+        """))
+    res = _run_procs(f"""
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        inter = MPI.Comm_spawn({worker_path!r}, [], 2, comm)
+        MPI.Barrier(inter)
+        buf = np.arange(4.0) + 7 if rank == 0 else np.zeros(4, np.float64)
+        MPI.Bcast(buf, MPI.ROOT if rank == 0 else MPI.PROC_NULL, inter)
+        if rank != 0:
+            assert np.all(buf == 0), buf       # non-source root-group ranks
+        got = MPI.bcast(None, 0, inter)        # from child 0
+        assert got == {{"from": "child"}}, got
+        MPI.free(inter)
+        print(f"INTER-OK-{{rank}}", flush=True)
+        MPI.Finalize()
+    """, nprocs=2, timeout=240)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"INTER-OK-{r}" in res.stdout
+
+
 def test_slow_combine_does_not_false_positive_deadlock():
     """A collective whose combine outlasts the deadlock budget (e.g. a >60s
     XLA compile at the star root) must complete: waiters probe the root's

@@ -66,6 +66,80 @@ def test_spawn_callable(nprocs):
     run_spmd(body, nprocs)
 
 
+def test_intercomm_collectives(nprocs):
+    """Barrier/Bcast/bcast directly on the intercommunicator with MPI_ROOT
+    semantics (VERDICT r3 #8): in the root group the source passes MPI.ROOT
+    and the rest MPI.PROC_NULL; the receiving group passes the root's rank in
+    the remote group (reference /root/reference/src/comm.jl:135-162 — libmpi
+    honors collectives on the intercomms Comm_spawn creates)."""
+    def worker():
+        MPI.Init()
+        parent = MPI.Comm_get_parent()
+        assert parent is not MPI.COMM_NULL
+        rank = MPI.Comm_rank(MPI.COMM_WORLD)
+        MPI.Barrier(parent)
+        # receive a buffer broadcast sourced by parent 0 (remote-group rank 0)
+        buf = np.zeros(4, np.float64)
+        MPI.Bcast(buf, 0, parent)
+        assert np.array_equal(buf, np.arange(4.0) + 7), buf
+        # reverse direction: child 0 sources an object to all parents
+        obj = {"from": "child"} if rank == 0 else None
+        got = MPI.bcast(obj, MPI.ROOT if rank == 0 else MPI.PROC_NULL, parent)
+        assert got is obj       # root-group participants' argument unchanged
+        MPI.Finalize()
+
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = comm.rank()
+        inter = MPI.Comm_spawn(worker, None, 2, comm)
+        MPI.Barrier(inter)
+        buf = np.arange(4.0) + 7 if rank == 0 else np.zeros(4, np.float64)
+        MPI.Bcast(buf, MPI.ROOT if rank == 0 else MPI.PROC_NULL, inter)
+        if rank != 0:
+            assert np.all(buf == 0)   # non-source root-group ranks untouched
+        got = MPI.bcast(None, 0, inter)      # from child 0 (remote rank 0)
+        assert got == {"from": "child"}
+        # the rest of the collective family still refuses with ERR_COMM
+        import pytest
+        from tpu_mpi import error as ec
+        with pytest.raises(MPI.MPIError) as ei:
+            MPI.Allreduce(np.ones(2), MPI.SUM, inter)
+        assert ei.value.code == ec.ERR_COMM
+        MPI.free(inter)
+
+    run_spmd(body, nprocs)
+
+
+def test_intercomm_bcast_root_mismatch(nprocs):
+    """Receivers naming the wrong remote root must raise on every rank, not
+    deadlock or mis-deliver (the rooted-ops divergence contract applied to
+    the two-group channel)."""
+    import pytest
+    from tpu_mpi.error import CollectiveMismatchError
+
+    def worker():
+        MPI.Init()
+        parent = MPI.Comm_get_parent()
+        buf = np.zeros(2, np.float64)
+        with pytest.raises((CollectiveMismatchError, MPI.AbortError)):
+            MPI.Bcast(buf, 1, parent)    # actual source is remote rank 0
+        MPI.Finalize()
+
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = comm.rank()
+        inter = MPI.Comm_spawn(worker, None, 2, comm)
+        buf = np.ones(2, np.float64)
+        with pytest.raises((CollectiveMismatchError, MPI.AbortError)):
+            MPI.Bcast(buf, MPI.ROOT if rank == 0 else MPI.PROC_NULL, inter)
+        MPI.free(inter)
+
+    # the mismatch fate-shares the whole job, so the run itself reports it
+    # (same shape as test_root_mismatch.py's divergent-root tests)
+    with pytest.raises((CollectiveMismatchError, MPI.AbortError)):
+        run_spmd(body, nprocs)
+
+
 def test_universe_size(nprocs):
     """universe_size() query (test_universe_size.jl)."""
     def body():

@@ -34,7 +34,7 @@ from .comm import (COMM_NULL, COMM_SELF, COMM_TYPE_SHARED, COMM_WORLD,
                    CONGRUENT, Comm, Comm_compare, Comm_dup, Comm_get_parent,
                    Comm_rank, Comm_size, Comm_spawn, Comm_split,
                    Comm_split_type, Comparison, IDENT, Intercomm,
-                   Intercomm_merge, SIMILAR, UNEQUAL, free, spawn_argv)
+                   Intercomm_merge, ROOT, SIMILAR, UNEQUAL, free, spawn_argv)
 
 # Object model
 from .info import INFO_NULL, Info, infoval

@@ -38,6 +38,7 @@ import numpy as np
 from ._runtime import _POLL, deadlock_timeout, require_env
 from .buffers import (extract_array, resolve_attached, write_flat,
                       write_range)
+from . import error as _ec
 from .error import DeadlockError, MPIError
 from . import operators as _ops
 
@@ -381,10 +382,12 @@ def _origin_flat(origin: Any, count: int) -> np.ndarray:
     a clean MPIError, not in the owner's drainer (which would abort the job)."""
     arr = extract_array(origin)
     if arr is None:
-        raise MPIError(f"not an RMA origin buffer: {type(origin).__name__}")
+        raise MPIError(f"not an RMA origin buffer: {type(origin).__name__}",
+                       code=_ec.ERR_BUFFER)
     flat = np.asarray(arr).reshape(-1)
     if flat.size < int(count):
-        raise MPIError(f"RMA origin has {flat.size} elements, count={count}")
+        raise MPIError(f"RMA origin has {flat.size} elements, count={count}",
+                       code=_ec.ERR_COUNT)
     return np.ascontiguousarray(flat[:int(count)])
 
 

@@ -24,6 +24,7 @@ from typing import Any, Optional
 import numpy as np
 
 from .datatypes import Datatype, to_datatype
+from . import error as _ec
 from .error import MPIError
 
 # Host arrays created by to_wire as private snapshots — explicitly marked so
@@ -150,7 +151,8 @@ class Buffer:
         self.data = data
         arr = extract_array(data)
         if arr is None:
-            raise MPIError(f"not a communication buffer: {type(data).__name__}")
+            raise MPIError(f"not a communication buffer: {type(data).__name__}",
+                           code=_ec.ERR_BUFFER)
         self.count = count if count is not None else int(arr.size)
         self.datatype = datatype if datatype is not None else to_datatype(arr.dtype)
 
@@ -190,7 +192,8 @@ def extract_array(x: Any):
 def element_count(x: Any) -> int:
     arr = extract_array(x)
     if arr is None:
-        raise MPIError(f"not a communication buffer: {type(x).__name__}")
+        raise MPIError(f"not a communication buffer: {type(x).__name__}",
+                       code=_ec.ERR_BUFFER)
     return int(arr.size)
 
 
@@ -232,8 +235,9 @@ def write_flat(dest: Any, src: Any, count: Optional[int] = None) -> Any:
         return dest
     if is_jax_array(dest):
         raise MPIError("jax.Array is immutable; wrap it in DeviceBuffer for "
-                       "the mutating API, or use the allocating variant")
-    raise MPIError(f"cannot write into {type(dest).__name__}")
+                       "the mutating API, or use the allocating variant",
+                       code=_ec.ERR_BUFFER)
+    raise MPIError(f"cannot write into {type(dest).__name__}", code=_ec.ERR_BUFFER)
 
 
 def write_range(buf: Any, off: int, new: np.ndarray) -> None:

@@ -36,7 +36,9 @@ import numpy as np
 from .buffers import (IN_PLACE, DeviceBuffer, _InPlace, assert_minlength,
                       clone_like, element_count, extract_array, is_jax_array,
                       to_wire, write_flat)
-from .comm import Comm
+from .comm import Comm, Intercomm, ROOT
+from ._runtime import PROC_NULL
+from . import error as _ec
 from .error import CollectiveMismatchError, MPIError
 from .operators import Op, as_op
 
@@ -59,7 +61,8 @@ def _run_rooted(comm: Comm, root: int, contrib: Any, combine, opname: str,
     ``combine(contribs, root)`` sees the validated root."""
     size = comm.size()
     if not isinstance(root, (int, np.integer)) or not (0 <= root < size):
-        raise MPIError(f"invalid root {root!r} for a size-{size} communicator")
+        raise MPIError(f"invalid root {root!r} for a size-{size} communicator",
+                       code=_ec.ERR_ROOT)
     root = int(root)
 
     def outer(cs):
@@ -169,11 +172,116 @@ def _is_none(x: Any) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Intercommunicator collectives (MPI_ROOT semantics; VERDICT r3 #8).
+# The reference reaches these through libmpi, which honors collectives on the
+# intercomms Comm_spawn creates (/root/reference/src/comm.jl:135-162). Here
+# they run over the intercomm's two-group rendezvous: in the ROOT GROUP the
+# sourcing rank passes MPI.ROOT and the rest pass MPI.PROC_NULL; the RECEIVING
+# group passes the root's rank within the remote group.
+# ---------------------------------------------------------------------------
+
+def _inter_rooted(comm: Intercomm, root: Any, payload: Any, opname: str):
+    """Two-group rooted rendezvous. Returns (got_value, value): got_value is
+    True only for receiving-group ranks."""
+    chan, slot, a, b = comm.two_group_channel()
+    in_a = slot < len(a)
+    if root == ROOT:
+        contrib = ("root", payload, in_a)
+    elif root == PROC_NULL:
+        contrib = ("null", None, in_a)
+    else:
+        r = int(root)
+        if not (0 <= r < comm.remote_size()):
+            raise MPIError(f"invalid intercomm root {root!r}: pass MPI.ROOT "
+                           f"(source), MPI.PROC_NULL (non-source, root group) "
+                           f"or a remote-group rank < {comm.remote_size()}",
+                           code=_ec.ERR_ROOT)
+        contrib = ("recv", r, in_a)
+
+    def combine(cs):
+        roots = [i for i, c in enumerate(cs) if c[0] == "root"]
+        if len(roots) != 1:
+            raise CollectiveMismatchError(
+                f"{opname}: exactly one rank must pass MPI.ROOT, got "
+                f"{len(roots)}")
+        ri = roots[0]
+        root_in_a = cs[ri][2]
+        root_idx = ri if root_in_a else ri - len(a)
+        out = []
+        for i, (role, val, ia) in enumerate(cs):
+            if role == "root":
+                out.append((False, None))
+            elif role == "null":
+                if ia != root_in_a:
+                    raise CollectiveMismatchError(
+                        f"{opname}: rank in the receiving group passed "
+                        f"MPI.PROC_NULL; receivers must pass the root's "
+                        f"remote-group rank")
+                out.append((False, None))
+            else:
+                if ia == root_in_a:
+                    raise CollectiveMismatchError(
+                        f"{opname}: rank in the root group passed a root rank "
+                        f"({val}); non-source root-group ranks pass "
+                        f"MPI.PROC_NULL")
+                if val != root_idx:
+                    raise CollectiveMismatchError(
+                        f"{opname}: receiving group names root {val} but the "
+                        f"source is remote-group rank {root_idx}")
+                out.append((True, cs[ri][1]))
+        return out
+
+    return _ordered_run(comm, lambda: chan.run(slot, contrib, combine, opname))
+
+
+def _inter_barrier(comm: Intercomm) -> None:
+    chan, slot, a, b = comm.two_group_channel()
+    _ordered_run(comm, lambda: chan.run(
+        slot, None, lambda cs: [None] * len(cs), f"IBarrier@{comm.cid}"))
+
+
+def _inter_bcast_buf(buf: Any, count: Optional[int], root: Any,
+                     comm: Intercomm) -> Any:
+    opname = f"InterBcast@{comm.cid}"
+    if root == ROOT:
+        n = element_count(buf) if count is None else count
+        assert_minlength(buf, n)
+        _inter_rooted(comm, root, (to_wire(buf, n), n), opname)
+        return buf
+    got, res = _inter_rooted(comm, root, None, opname)
+    if got:
+        val, n_src = res
+        n = n_src if count is None else count
+        assert_minlength(buf, n)
+        write_flat(buf, val, n)
+    return buf
+
+
+def _inter_bcast_obj(obj: Any, root: Any, comm: Intercomm) -> Any:
+    opname = f"interbcast@{comm.cid}"
+    if root == ROOT:
+        try:
+            payload = ("pickle", pickle.dumps(obj))
+        except Exception:
+            payload = ("ref", obj)
+        _inter_rooted(comm, root, payload, opname)
+        return obj
+    got, res = _inter_rooted(comm, root, None, opname)
+    if not got:
+        return obj        # PROC_NULL participant: argument untouched
+    kind, data = res
+    return pickle.loads(data) if kind == "pickle" else data
+
+
+# ---------------------------------------------------------------------------
 # Barrier
 # ---------------------------------------------------------------------------
 
 def Barrier(comm: Comm) -> None:
-    """Block until every rank of comm arrives (src/collective.jl:15-19)."""
+    """Block until every rank of comm arrives (src/collective.jl:15-19).
+    On an intercommunicator: until every rank of BOTH groups arrives."""
+    if isinstance(comm, Intercomm):
+        return _inter_barrier(comm)
     _run(comm, None, lambda cs: [None] * len(cs), f"Barrier@{comm.cid}",
          plan=("barrier",))
 
@@ -191,6 +299,8 @@ def Bcast(buf: Any, *args) -> Any:
         count, root, comm = args
     else:
         raise TypeError("Bcast(buf, [count,] root, comm)")
+    if isinstance(comm, Intercomm):
+        return _inter_bcast_buf(buf, count, root, comm)
     rank = comm.rank()
     n = element_count(buf) if count is None else count
     assert_minlength(buf, n)
@@ -213,6 +323,8 @@ def bcast(obj: Any, root: int, comm: Comm) -> Any:
     The reference's two-phase length+payload dance collapses: the rendezvous
     carries dynamic sizes natively. Pickle round-trips give each rank its own
     copy; unpicklable objects (closures) are shared by reference in-process."""
+    if isinstance(comm, Intercomm):
+        return _inter_bcast_obj(obj, root, comm)
     rank = comm.rank()
     if rank == root:
         try:
@@ -715,7 +827,8 @@ def Reduce_scatter(sendbuf: Any, recvbuf: Any, counts: Sequence[int], op: Any,
         # Reduce_scatter has no root: every rank's counts must agree.
         lists = [c[1] for c in cs]
         if any(l != lists[0] for l in lists[1:]):
-            raise MPIError(f"Reduce_scatter counts differ across ranks: {lists}")
+            raise MPIError(f"Reduce_scatter counts differ across ranks: {lists}",
+                           code=_ec.ERR_COUNT)
         red = _reduce_arrays([c[0] for c in cs], op)
         displs = np.concatenate([[0], np.cumsum(lists[0])])
         return [red.reshape(-1)[displs[r]:displs[r] + lists[0][r]]
@@ -734,7 +847,8 @@ def Reduce_scatter_block(sendbuf: Any, recvbuf: Any, op: Any, comm: Comm) -> Any
     size = comm.size()
     n = element_count(sendbuf)
     if n % size != 0:
-        raise MPIError(f"send count {n} not divisible by comm size {size}")
+        raise MPIError(f"send count {n} not divisible by comm size {size}",
+                       code=_ec.ERR_COUNT)
     return Reduce_scatter(sendbuf, recvbuf, [n // size] * size, op, comm)
 
 

@@ -19,6 +19,7 @@ import enum
 from typing import Optional, Sequence
 
 from ._runtime import UNDEFINED, CollectiveChannel, current_env, require_env
+from . import error as _ec
 from .error import InvalidCommError, MPIError
 
 
@@ -37,6 +38,13 @@ UNEQUAL = Comparison.UNEQUAL
 
 # Split type for Comm_split_type (src/comm.jl:107-115): ranks sharing a host.
 COMM_TYPE_SHARED = 1
+
+# MPI_ROOT sentinel for rooted intercomm collectives: in the root group, the
+# one sourcing rank passes ROOT and the rest pass PROC_NULL; the receiving
+# group passes the root's rank within the remote group. (The value is this
+# runtime's own sentinel, like _runtime.PROC_NULL — the reference inherits
+# libmpi's, consts_mpich.jl.)
+ROOT = -4
 
 
 class Comm:
@@ -303,10 +311,35 @@ class Intercomm(Comm):
     def channel(self) -> CollectiveChannel:
         # Intercomm collectives have two-group semantics the intracomm
         # rendezvous cannot express (both sides would deposit into overlapping
-        # local-rank slots of one cid-keyed channel). P2P and Intercomm_merge
-        # work; use the merged intracomm for collectives.
-        raise MPIError("collectives on an intercommunicator are not supported; "
-                       "Intercomm_merge it into an intracommunicator first")
+        # local-rank slots of one cid-keyed channel). Barrier/Bcast/bcast use
+        # the two-group channel with MPI_ROOT semantics (collective.py); for
+        # the rest, Intercomm_merge into an intracommunicator first.
+        raise MPIError("only Barrier/Bcast/bcast are supported on an "
+                       "intercommunicator; Intercomm_merge it into an "
+                       "intracommunicator for other collectives",
+                       code=_ec.ERR_COMM)
+
+    def two_group_slots(self) -> tuple[tuple[int, ...], tuple[int, ...], int]:
+        """Canonical rendezvous ordering across both groups (shared with
+        Intercomm_merge): the group containing the smaller world rank is "A"
+        and occupies slots [0, len(A)); returns (A, B, my slot)."""
+        local, remote = self.group, self.remote_group
+        a, b = (local, remote) if min(local) < min(remote) else (remote, local)
+        _, world_rank = require_env()
+        slot = (a.index(world_rank) if world_rank in a
+                else len(a) + b.index(world_rank))
+        return tuple(a), tuple(b), slot
+
+    def two_group_channel(self):
+        """The all-ranks-of-both-groups rendezvous used by intercomm
+        collectives (MPI_ROOT semantics; /root/reference/src/comm.jl:135-162
+        creates intercomms whose collectives libmpi honors). Returns
+        (channel, my_slot, A, B)."""
+        self._check()
+        a, b, slot = self.two_group_slots()
+        chan = self.ctx.channel(("inter", self.cid), len(a) + len(b),
+                                group=a + b)
+        return chan, slot, a, b
 
     def __repr__(self) -> str:
         return (f"<Intercomm {self.name} cid={self.cid} local={len(self.group)} "
@@ -349,10 +382,12 @@ def _run_spawned(command, argv):
     elif argv:
         scripts = [a for a in argv if str(a).endswith(".py")]
         if not scripts:
-            raise MPIError(f"cannot spawn {command!r}: no python script in argv")
+            raise MPIError(f"cannot spawn {command!r}: no python script in argv",
+                           code=_ec.ERR_SPAWN)
         script = scripts[0]
     else:
-        raise MPIError(f"cannot spawn {command!r}: pass a callable or a .py path")
+        raise MPIError(f"cannot spawn {command!r}: pass a callable or a .py path",
+                       code=_ec.ERR_SPAWN)
     runpy.run_path(script, run_name="__main__")
 
 
@@ -441,16 +476,13 @@ def Intercomm_merge(intercomm: Intercomm, high: bool) -> Comm:
     (src/comm.jl:155-162). Groups whose members pass ``high=False`` are
     ordered first."""
     if not isinstance(intercomm, Intercomm):
-        raise MPIError("Intercomm_merge requires an intercommunicator")
+        raise MPIError("Intercomm_merge requires an intercommunicator",
+                       code=_ec.ERR_COMM)
     ctx = intercomm.ctx
-    local, remote = intercomm.group, intercomm.remote_group
-    # Canonical rendezvous slots across both groups: the group containing the
-    # smaller world rank is "A" and occupies slots [0, len(A)).
-    a, b = (local, remote) if min(local) < min(remote) else (remote, local)
+    a, b, slot = intercomm.two_group_slots()
     _, world_rank = require_env()
-    slot = a.index(world_rank) if world_rank in a else len(a) + b.index(world_rank)
     total = len(a) + len(b)
-    chan = ctx.channel(("merge", intercomm.cid), total, group=tuple(a) + tuple(b))
+    chan = ctx.channel(("merge", intercomm.cid), total, group=a + b)
 
     def combine(cs):
         cid = ctx.alloc_cid()
@@ -486,7 +518,7 @@ def free(obj) -> None:
     No C resources back these objects; freeing marks them unusable (and a
     communicator's free() also reclaims its I-collective worker thread)."""
     if isinstance(obj, (_WorldComm, _SelfComm, _NullComm)):
-        raise MPIError("cannot free a builtin communicator")
+        raise MPIError("cannot free a builtin communicator", code=_ec.ERR_COMM)
     if hasattr(obj, "free"):
         obj.free()
     elif hasattr(obj, "_freed"):

@@ -20,6 +20,7 @@ import threading
 from dataclasses import dataclass, fields
 from typing import Any, Optional
 
+from . import error as _ec
 from .error import MPIError
 
 _DEFAULT_TOML = os.path.join("~", ".config", "tpu_mpi", "config.toml")
@@ -115,7 +116,8 @@ def _coerce(name: str, default: Any, raw: Any) -> Any:
             return str(raw).lower() in ("1", "true", "yes", "on")
         return kind(raw)
     except (TypeError, ValueError):
-        raise MPIError(f"config key {name}={raw!r} is not a valid {kind.__name__}") from None
+        raise MPIError(f"config key {name}={raw!r} is not a valid {kind.__name__}",
+                       code=_ec.ERR_ARG) from None
 
 
 def load(refresh: bool = False) -> Config:
@@ -165,5 +167,5 @@ def get(name: str) -> Any:
     """One config value by key name."""
     cfg = load()
     if not hasattr(cfg, name):
-        raise MPIError(f"unknown config key {name!r}")
+        raise MPIError(f"unknown config key {name!r}", code=_ec.ERR_ARG)
     return getattr(cfg, name)

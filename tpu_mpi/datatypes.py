@@ -22,6 +22,7 @@ from typing import Any, Optional, Sequence
 
 import numpy as np
 
+from . import error as _ec
 from .error import MPIError
 
 
@@ -45,7 +46,8 @@ class Datatype:
             if extent is None:
                 extent = np_dtype.itemsize
         if blocks is None:
-            raise MPIError("datatype needs an np_dtype or explicit blocks")
+            raise MPIError("datatype needs an np_dtype or explicit blocks",
+                           code=_ec.ERR_TYPE)
         self.np_dtype = np_dtype            # None for non-record derived layouts
         self.blocks = blocks
         self.lb = lb
@@ -186,7 +188,7 @@ def to_datatype(T: Any) -> Datatype:
     try:
         return Datatype(np.dtype(T), name=str(np.dtype(T)))
     except TypeError:
-        raise MPIError(f"no wire datatype for {T!r}") from None
+        raise MPIError(f"no wire datatype for {T!r}", code=_ec.ERR_TYPE) from None
 
 
 def struct_np_dtype(T: Any) -> np.dtype:
@@ -198,7 +200,7 @@ def struct_np_dtype(T: Any) -> np.dtype:
         hints = T.__annotations__
         items = [(n, hints[n]) for n in T._fields]
     else:
-        raise MPIError(f"not a struct-like type: {T!r}")
+        raise MPIError(f"not a struct-like type: {T!r}", code=_ec.ERR_TYPE)
     fields = []
     for name, ftype in items:
         if dataclasses.is_dataclass(ftype) or (isinstance(ftype, type)

@@ -127,18 +127,21 @@ def Finalize() -> None:
     ctx.finalized[rank] = True
 
 
-def Abort(comm=None, errorcode: int = 1) -> None:
+def Abort(comm=None, errorcode: "int | None" = None) -> None:
     """Terminate the whole job (src/environment.jl:252-254).
 
     Fate-shares: every rank blocked in the runtime raises AbortError. In the
     multi-process launcher the process additionally exits with ``errorcode``.
+    With no explicit errorcode the AbortError carries ERR_ABORTED (code 1
+    would collide with MPI_ERR_BUFFER in the error-class table).
     """
     env = current_env()
     if env is None:
-        raise SystemExit(errorcode)
+        raise SystemExit(1 if errorcode is None else errorcode)
     ctx, rank = env
     err = AbortError(f"MPI.Abort called on rank {rank} with errorcode {errorcode}")
-    err.code = errorcode
+    if errorcode is not None:
+        err.code = errorcode
     ctx.fail(err, rank)
     raise err
 

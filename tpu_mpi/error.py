@@ -1,20 +1,120 @@
-"""Typed exception hierarchy.
+"""Typed exception hierarchy + the MPI error-code space.
 
 Reference: MPI.jl wraps every ccall in ``@mpichk`` and raises ``MPIError(code)``
-(/root/reference/src/error.jl:1-23). There is no C error-code table here — the
-TPU-native runtime raises typed Python exceptions directly, with an ``MPIError``
-root so user code can catch the whole family.
+whose message comes from ``MPI_Error_string`` (/root/reference/src/error.jl:1-23).
+The TPU-native runtime raises typed Python exceptions directly — the message is
+always complete — but every exception also carries a ``code`` drawn from the
+standard MPI error-class space (MPI 4.0 §9.4, MPICH numbering), so FFI-shaped
+callers and ``Error_string`` round-trip the way the reference's do.
 """
 
 from __future__ import annotations
 
+# --------------------------------------------------------------------------
+# MPI error classes (MPI 4.0 §9.4; values follow MPICH, the ABI the reference
+# defaults to — /root/reference/deps/consts_mpich.jl). SUCCESS..ERR_PENDING
+# are the MPI-1 classes; the 20+ block is the MPI-2 IO/RMA/spawn classes.
+# --------------------------------------------------------------------------
+SUCCESS = 0
+ERR_BUFFER = 1
+ERR_COUNT = 2
+ERR_TYPE = 3
+ERR_TAG = 4
+ERR_COMM = 5
+ERR_RANK = 6
+ERR_ROOT = 7
+ERR_GROUP = 8
+ERR_OP = 9
+ERR_TOPOLOGY = 10
+ERR_DIMS = 11
+ERR_ARG = 12
+ERR_UNKNOWN = 13
+ERR_TRUNCATE = 14
+ERR_OTHER = 15
+ERR_INTERN = 16
+ERR_IN_STATUS = 17
+ERR_PENDING = 18
+ERR_REQUEST = 19
+ERR_ACCESS = 20
+ERR_AMODE = 21
+ERR_ASSERT = 22
+ERR_FILE = 30
+ERR_INFO_KEY = 31
+ERR_INFO_VALUE = 33
+ERR_INFO = 34
+ERR_IO = 35
+ERR_LOCKTYPE = 37
+ERR_NO_SUCH_FILE = 42
+ERR_RMA_SYNC = 47
+ERR_SIZE = 49
+ERR_SPAWN = 50
+ERR_UNSUPPORTED_OPERATION = 52
+ERR_WIN = 53
+# Implementation-specific classes (past MPI_ERR_LASTCODE's standard block),
+# for conditions libmpi cannot detect but this runtime does:
+ERR_DEADLOCK = 64
+ERR_COLLECTIVE_MISMATCH = 65
+ERR_ABORTED = 66
+
+_ERROR_STRINGS = {
+    SUCCESS: "MPI_SUCCESS: no error",
+    ERR_BUFFER: "MPI_ERR_BUFFER: invalid buffer pointer or operand",
+    ERR_COUNT: "MPI_ERR_COUNT: invalid count argument",
+    ERR_TYPE: "MPI_ERR_TYPE: invalid datatype argument",
+    ERR_TAG: "MPI_ERR_TAG: invalid tag argument",
+    ERR_COMM: "MPI_ERR_COMM: invalid communicator (null, freed, or wrong kind)",
+    ERR_RANK: "MPI_ERR_RANK: invalid rank for this communicator",
+    ERR_REQUEST: "MPI_ERR_REQUEST: invalid or inactive request handle",
+    ERR_ROOT: "MPI_ERR_ROOT: invalid root rank for this communicator",
+    ERR_GROUP: "MPI_ERR_GROUP: invalid group argument",
+    ERR_OP: "MPI_ERR_OP: invalid or non-applicable reduction operation",
+    ERR_TOPOLOGY: "MPI_ERR_TOPOLOGY: invalid topology or topology-less communicator",
+    ERR_DIMS: "MPI_ERR_DIMS: invalid dimension specification",
+    ERR_ARG: "MPI_ERR_ARG: invalid argument",
+    ERR_UNKNOWN: "MPI_ERR_UNKNOWN: unknown error",
+    ERR_TRUNCATE: "MPI_ERR_TRUNCATE: receive buffer smaller than incoming message",
+    ERR_OTHER: "MPI_ERR_OTHER: known error not in this list "
+               "(see the raised exception's message)",
+    ERR_INTERN: "MPI_ERR_INTERN: internal runtime error",
+    ERR_IN_STATUS: "MPI_ERR_IN_STATUS: error code is in the status object",
+    ERR_PENDING: "MPI_ERR_PENDING: operation pending, not failed",
+    ERR_ACCESS: "MPI_ERR_ACCESS: permission denied on file",
+    ERR_AMODE: "MPI_ERR_AMODE: invalid file access-mode combination",
+    ERR_ASSERT: "MPI_ERR_ASSERT: invalid assertion argument",
+    ERR_FILE: "MPI_ERR_FILE: invalid file handle",
+    ERR_INFO_KEY: "MPI_ERR_INFO_KEY: info key too long or not ASCII",
+    ERR_INFO_VALUE: "MPI_ERR_INFO_VALUE: info value too long or not ASCII",
+    ERR_INFO: "MPI_ERR_INFO: invalid info object",
+    ERR_IO: "MPI_ERR_IO: file I/O error",
+    ERR_LOCKTYPE: "MPI_ERR_LOCKTYPE: invalid RMA lock type",
+    ERR_NO_SUCH_FILE: "MPI_ERR_NO_SUCH_FILE: file does not exist",
+    ERR_RMA_SYNC: "MPI_ERR_RMA_SYNC: RMA call out of epoch / wrong synchronization",
+    ERR_SIZE: "MPI_ERR_SIZE: invalid size argument",
+    ERR_SPAWN: "MPI_ERR_SPAWN: could not spawn processes",
+    ERR_UNSUPPORTED_OPERATION: "MPI_ERR_UNSUPPORTED_OPERATION: operation not "
+                               "supported on this object or backend",
+    ERR_WIN: "MPI_ERR_WIN: invalid RMA window",
+    ERR_DEADLOCK: "TPU_ERR_DEADLOCK: blocking operation exceeded the runtime's "
+                  "deadlock timeout",
+    ERR_COLLECTIVE_MISMATCH: "TPU_ERR_COLLECTIVE_MISMATCH: ranks of one "
+                             "communicator called different collectives in the "
+                             "same round",
+    ERR_ABORTED: "TPU_ERR_ABORTED: job fate-shared down by MPI.Abort or a "
+                 "failing rank",
+}
+
 
 class MPIError(RuntimeError):
-    """Root of all framework errors (analog of MPI.jl's MPIError, src/error.jl:1-3)."""
+    """Root of all framework errors (analog of MPI.jl's MPIError,
+    src/error.jl:1-3). ``code`` defaults to the class's MPI error class
+    (``CODE``), so every exception type is distinguishable by code alone the
+    way libmpi's error classes are."""
 
-    def __init__(self, msg: str = "MPI error", code: int = 1):
+    CODE = ERR_OTHER
+
+    def __init__(self, msg: str = "MPI error", code: "int | None" = None):
         super().__init__(msg)
-        self.code = code
+        self.code = self.CODE if code is None else int(code)
 
     def __str__(self) -> str:  # pretty-print like src/error.jl:21-23
         return f"{self.args[0]} (code {self.code})"
@@ -26,16 +126,23 @@ class AbortError(MPIError):
     The reference's ``MPI.Abort`` kills the whole job (src/environment.jl:252-254)
     and a single failing rank fails the run (test/runtests.jl:37-39). In the
     threaded host runtime, failure is propagated by raising this in every rank
-    blocked in the runtime.
+    blocked in the runtime. ``code`` is the user's Abort errorcode when one was
+    given, else ERR_ABORTED.
     """
+
+    CODE = ERR_ABORTED
 
 
 class DeadlockError(MPIError):
     """A blocking operation exceeded the runtime's deadlock timeout."""
 
+    CODE = ERR_DEADLOCK
+
 
 class TruncationError(MPIError):
     """Receive buffer smaller than the incoming message (MPI_ERR_TRUNCATE)."""
+
+    CODE = ERR_TRUNCATE
 
 
 class CollectiveMismatchError(MPIError):
@@ -46,23 +153,17 @@ class CollectiveMismatchError(MPIError):
     rendezvous sees every call.
     """
 
+    CODE = ERR_COLLECTIVE_MISMATCH
+
 
 class InvalidCommError(MPIError):
     """Operation on COMM_NULL or a freed communicator."""
 
-
-# Code → description, in the spirit of MPI_Error_string
-# (/root/reference/src/error.jl:11-19 wraps it). The TPU-native runtime
-# raises typed exceptions rather than integer codes, so the table simply
-# names the classes' codes for FFI-shaped callers.
-_ERROR_STRINGS = {
-    0: "MPI_SUCCESS: no error",
-    1: "MPI error (see the raised exception's message for detail)",
-}
+    CODE = ERR_COMM
 
 
 def Error_string(code: int) -> str:
-    """Human-readable description of an error code
-    (src/error.jl:11-19 ``error_string``). Exceptions carry their full
-    message already; this exists for MPI-API parity."""
+    """Human-readable description of an error code (src/error.jl:11-19
+    ``error_string``). Covers every code the package raises — the full MPI
+    error-class table plus the runtime-specific classes."""
     return _ERROR_STRINGS.get(int(code), f"unknown MPI error code {code}")

@@ -16,6 +16,7 @@ from __future__ import annotations
 from collections.abc import MutableMapping
 from typing import Any, Iterator, Optional
 
+from . import error as _ec
 from .error import MPIError
 
 MAX_INFO_KEY = 255
@@ -47,18 +48,20 @@ class Info(MutableMapping):
 
     def _check(self) -> None:
         if self._freed:
-            raise MPIError("operation on a freed Info")
+            raise MPIError("operation on a freed Info", code=_ec.ERR_INFO)
 
     def __setitem__(self, key: Any, value: Any) -> None:
         self._check()
         key = str(key)
         if not key.isascii():
-            raise MPIError("info keys must be ASCII")
+            raise MPIError("info keys must be ASCII", code=_ec.ERR_INFO_KEY)
         if len(key) > MAX_INFO_KEY:
-            raise MPIError(f"info key longer than {MAX_INFO_KEY}")
+            raise MPIError(f"info key longer than {MAX_INFO_KEY}",
+                           code=_ec.ERR_INFO_KEY)
         val = infoval(value)
         if len(val) > MAX_INFO_VAL:
-            raise MPIError(f"info value longer than {MAX_INFO_VAL}")
+            raise MPIError(f"info value longer than {MAX_INFO_VAL}",
+                           code=_ec.ERR_INFO_VALUE)
         self._d[key] = val
 
     def __getitem__(self, key: Any) -> str:

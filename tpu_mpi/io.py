@@ -24,6 +24,7 @@ import numpy as np
 from .buffers import Buffer, extract_array, to_wire, write_flat
 from .comm import Comm
 from .datatypes import BYTE, Datatype, to_datatype
+from . import error as _ec
 from .error import MPIError
 from .pointtopoint import Status
 
@@ -48,7 +49,7 @@ class FileHandle:
 
     def _check(self) -> None:
         if self.fd is None:
-            raise MPIError("file has been closed")
+            raise MPIError("file has been closed", code=_ec.ERR_FILE)
 
     def close(self) -> None:
         if self.fd is not None:

@@ -35,6 +35,7 @@ from .buffers import (DeviceBuffer, extract_array, element_count,
                       resolve_attached, write_flat, write_range)
 from .comm import Comm
 from .datatypes import Get_address
+from . import error as _ec
 from .error import DeadlockError, MPIError
 from .operators import Op, REPLACE, NO_OP, acc_combine, as_op
 
@@ -114,7 +115,7 @@ class Win:
 
     def _check(self) -> None:
         if self._state.freed:
-            raise MPIError("window has been freed")
+            raise MPIError("window has been freed", code=_ec.ERR_WIN)
 
     def free(self) -> None:
         """Release the window. MPI_Win_free is collective (src/onesided.jl:
@@ -161,7 +162,8 @@ def Win_create(base: Any, comm: Comm, **infokws) -> Win:
     Put/Get/accumulates are element offsets into the target's array."""
     arr = extract_array(base)
     if arr is None:
-        raise MPIError(f"not a window buffer: {type(base).__name__}")
+        raise MPIError(f"not a window buffer: {type(base).__name__}",
+                       code=_ec.ERR_WIN)
     disp_unit = arr.dtype.itemsize
     if _is_proc_mode(comm):
         from ._rma_wire import create_proc_window
@@ -227,7 +229,8 @@ def Win_shared_query(win: Win, owner_rank: int):
         return proc_shared_query(win._state, owner_rank)
     entry = win._state.buffers.get(int(owner_rank))
     if entry is None:
-        raise MPIError(f"rank {owner_rank} exposes no memory in this window")
+        raise MPIError(f"rank {owner_rank} exposes no memory in this window",
+                       code=_ec.ERR_WIN)
     buf, disp_unit = entry
     arr = extract_array(buf)
     return arr.size * arr.dtype.itemsize, disp_unit, buf
@@ -238,7 +241,7 @@ def Win_attach(win: Win, base: Any) -> None:
     Targets address it by its :func:`Get_address` byte address."""
     win._check()
     if not win._state.dynamic:
-        raise MPIError("Win_attach requires a dynamic window")
+        raise MPIError("Win_attach requires a dynamic window", code=_ec.ERR_WIN)
     arr = extract_array(base)
     addr = Get_address(arr)
     entry = (addr, arr.size * arr.dtype.itemsize, base)
@@ -260,7 +263,7 @@ def Win_detach(win: Win, base: Any) -> None:
         if b is base:
             del lst[i]
             return
-    raise MPIError("buffer was not attached to this window")
+    raise MPIError("buffer was not attached to this window", code=_ec.ERR_WIN)
 
 
 # ---------------------------------------------------------------------------
@@ -324,7 +327,8 @@ def Win_unlock(rank: int, win: Win) -> None:
             else:
                 win._state.user_locks[rank].release(excl)
             return
-    raise MPIError(f"Win_unlock: no lock held on rank {rank}")
+    raise MPIError(f"Win_unlock: no lock held on rank {rank}",
+                   code=_ec.ERR_RMA_SYNC)
 
 
 # ---------------------------------------------------------------------------
@@ -341,7 +345,8 @@ def _target_view(win: Win, target_rank: int, target_disp: int, count: int):
         return resolve_attached(st.attached[target_rank], target_disp,
                                 target_rank)
     if target_rank not in st.buffers:
-        raise MPIError(f"rank {target_rank} exposes no memory in this window")
+        raise MPIError(f"rank {target_rank} exposes no memory in this window",
+                       code=_ec.ERR_WIN)
     buf, _ = st.buffers[target_rank]
     return buf, extract_array(buf), int(target_disp)
 
@@ -349,7 +354,8 @@ def _target_view(win: Win, target_rank: int, target_disp: int, count: int):
 def _origin_array(origin: Any) -> np.ndarray:
     arr = extract_array(origin)
     if arr is None:
-        raise MPIError(f"not an RMA origin buffer: {type(origin).__name__}")
+        raise MPIError(f"not an RMA origin buffer: {type(origin).__name__}",
+                       code=_ec.ERR_BUFFER)
     return arr
 
 
@@ -392,7 +398,8 @@ def Put(origin: Any, *args) -> None:
     buf, tarr, off = _target_view(win, target_rank, target_disp, count)
     src = _origin_array(origin).reshape(-1)
     if src.size < count:
-        raise MPIError(f"Put origin has {src.size} elements, count={count}")
+        raise MPIError(f"Put origin has {src.size} elements, count={count}",
+                       code=_ec.ERR_COUNT)
     new = np.asarray(src[:count], dtype=tarr.dtype)
     if isinstance(buf, DeviceBuffer):
         # DeviceBuffer writes rebind the whole array: concurrent Puts into

@@ -31,6 +31,7 @@ from ._runtime import (ANY_SOURCE, ANY_TAG, PROC_NULL, Message, PendingRecv,
 from .buffers import element_count, to_wire, write_flat
 from .comm import Comm
 from .datatypes import Datatype, to_datatype
+from . import error as _ec
 from .error import MPIError, TruncationError
 
 _POLL = 0.001
@@ -495,7 +496,8 @@ class Prequest:
 
     def start(self) -> "Prequest":
         if self._inner is not None and self._inner.active:
-            raise MPIError("Start on an already-active persistent request")
+            raise MPIError("Start on an already-active persistent request",
+                           code=_ec.ERR_REQUEST)
         self._inner = self._make()
         return self
 
@@ -551,7 +553,7 @@ def Recv_init(buf: Any, src: int, tag: int, comm: Comm) -> Prequest:
 def Start(req: Prequest) -> Prequest:
     """Arm a persistent request (MPI_Start)."""
     if not isinstance(req, Prequest):
-        raise MPIError("Start requires a persistent request "
+        raise MPIError(code=_ec.ERR_REQUEST, msg="Start requires a persistent request "
                        "(Send_init/Recv_init)")
     return req.start()
 

@@ -21,6 +21,7 @@ import numpy as np
 
 from ._runtime import PROC_NULL
 from .comm import COMM_NULL, Comm, Comm_split
+from . import error as _ec
 from .error import MPIError
 
 
@@ -109,7 +110,7 @@ def Dims_create(nnodes: int, dims: Sequence[int]) -> list[int]:
     physical axis exactly and grid neighbors ride single ICI links."""
     dims = [int(d) for d in dims]
     if any(d < 0 for d in dims):
-        raise MPIError(f"negative entry in dims {dims}")
+        raise MPIError(f"negative entry in dims {dims}", code=_ec.ERR_DIMS)
     if dims and all(d == 0 for d in dims):
         from .implementations import ici_topology
         torus = ici_topology()
@@ -120,11 +121,13 @@ def Dims_create(nnodes: int, dims: Sequence[int]) -> list[int]:
     fixed = math.prod(d for d in dims if d > 0) if any(d > 0 for d in dims) else 1
     free = [i for i, d in enumerate(dims) if d == 0]
     if fixed <= 0 or nnodes % fixed != 0:
-        raise MPIError(f"cannot partition {nnodes} nodes over fixed dims {dims}")
+        raise MPIError(f"cannot partition {nnodes} nodes over fixed dims {dims}",
+                       code=_ec.ERR_DIMS)
     rem = nnodes // fixed
     if not free:
         if rem != 1:
-            raise MPIError(f"dims {dims} do not multiply to {nnodes}")
+            raise MPIError(f"dims {dims} do not multiply to {nnodes}",
+                           code=_ec.ERR_DIMS)
         return dims
     # Greedy balanced factorization: repeatedly take the largest factor of
     # `rem` not exceeding its k-th root.
@@ -243,7 +246,8 @@ def Cart_create(comm: Comm, *args) -> Comm:
     periods = [bool(p) for p in periods]
     n = math.prod(dims)
     if n > comm.size():
-        raise MPIError(f"grid {dims} needs {n} ranks, comm has {comm.size()}")
+        raise MPIError(f"grid {dims} needs {n} ranks, comm has {comm.size()}",
+                       code=_ec.ERR_TOPOLOGY)
     rank = comm.rank()
     key = rank
     grid_devices = None
@@ -316,7 +320,7 @@ def Cart_sub(comm: CartComm, remain_dims: Sequence) -> Comm:
     sub-communicator — axis subsetting of the device mesh."""
     remain = [bool(r) for r in remain_dims]
     if len(remain) != len(comm.dims):
-        raise MPIError("remain_dims length mismatch")
+        raise MPIError("remain_dims length mismatch", code=_ec.ERR_TOPOLOGY)
     coords = comm.coords_of_rank(comm.rank())
     dropped = tuple(c for c, r in zip(coords, remain) if not r)
     # Color by dropped coordinates -> unique int
@@ -401,7 +405,8 @@ def Neighbor_allgather(*args) -> Any:
     else:
         raise TypeError("Neighbor_allgather(send, [recv,] comm)")
     if not isinstance(comm, CartComm):
-        raise MPIError("Neighbor_allgather requires a Cartesian communicator")
+        raise MPIError("Neighbor_allgather requires a Cartesian communicator",
+                       code=_ec.ERR_TOPOLOGY)
     from .buffers import element_count
     count = element_count(sendbuf)
     nbrs = _neighbor_list(comm)
@@ -422,7 +427,8 @@ def Neighbor_alltoall(*args) -> Any:
     else:
         raise TypeError("Neighbor_alltoall(send, [recv,] count, comm)")
     if not isinstance(comm, CartComm):
-        raise MPIError("Neighbor_alltoall requires a Cartesian communicator")
+        raise MPIError("Neighbor_alltoall requires a Cartesian communicator",
+                       code=_ec.ERR_TOPOLOGY)
     from .buffers import assert_minlength, to_wire
     count = int(count)
     nbrs = _neighbor_list(comm)
