@@ -109,23 +109,20 @@ def _control_rows(n_elems: int, nranks: int) -> "dict | None":
     try:
         import jax
         import jax.numpy as jnp
+        from common import time_chain
         k = 8
 
-        def chain(f, x, expect, iters, reps):
-            for _ in range(2):
-                x = f(x)
-            got, want = float(x.reshape(-1)[0]), expect(2)
-            assert got == want, (got, want)
-            calls, best = 2, float("inf")
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                for _ in range(iters):
-                    x = f(x)
-                calls += iters
-                got, want = float(x.reshape(-1)[0]), expect(calls)
+        def chain(f, x0, expect, iters, reps):
+            box = [x0]
+
+            def step():
+                box[0] = f(box[0])
+
+            def force(calls):
+                got, want = float(box[0].reshape(-1)[0]), expect(calls)
                 assert got == want, (got, want)
-                best = min(best, (time.perf_counter() - t0) / iters)
-            return best
+
+            return time_chain(step, force, 2, iters, reps)
 
         t_ew = chain(jax.jit(lambda x: x + 1.0),
                      jnp.zeros(n_elems, jnp.float32),

@@ -116,6 +116,28 @@ def host_allreduce_times(n_elems: int, nranks: int, use_device: bool,
     return spmd_run(body, nranks)
 
 
+def time_chain(step, force, warmup: int, iters: int, repeats: int) -> float:
+    """Best per-op seconds over ``repeats`` blocks of ``iters`` chained ops;
+    each block ends in a forcing readback that ``force(ops)`` must assert
+    against the closed-form chain value (BASELINE.md "Protocol": unexecuted
+    or wrong work fails the bench instead of timing as fast). Shared by
+    bench.py's control rows and benchmarks/overhead_probe.py."""
+    ops = 0
+    for _ in range(warmup):
+        step()
+        ops += 1
+    force(ops)                      # also forces warmup completion
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            step()
+            ops += 1
+        force(ops)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
 def best_block(times: Sequence[Sequence[float]]) -> float:
     """times[rank][repeat] → min over repeats of max over ranks."""
     nrep = len(times[0])

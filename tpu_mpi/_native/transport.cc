@@ -298,15 +298,11 @@ class Transport {
     }
   }
 
-  // Pop into buf. 0 ok, 1 timeout, -2 stopped, -3 cap too small (frame kept).
-  int recv(void* buf, int64_t cap, int32_t* src_out, int64_t* len_out,
-           int timeout_ms) {
-    hot_spin();
-    std::unique_lock<std::mutex> lk(q_mtx_);
-    if (!q_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                        [this] { return !inbox_.empty() || stopped_.load(); }))
-      return 1;
-    if (inbox_.empty()) return -2;
+  // Pop the front frame (q_mtx_ held): 0 ok, 1 empty, -3 cap too small
+  // (frame kept for an exact-size retry).
+  int try_pop_locked(void* buf, int64_t cap, int32_t* src_out,
+                     int64_t* len_out) {
+    if (inbox_.empty()) return 1;
     Frame& f = inbox_.front();
     *len_out = static_cast<int64_t>(f.len);
     *src_out = f.src;
@@ -315,6 +311,83 @@ class Transport {
     inbox_.pop_front();
     inbox_n_.fetch_sub(1, std::memory_order_release);
     return 0;
+  }
+
+  // Pop into buf. 0 ok, 1 timeout, -2 stopped, -3 cap too small (frame kept).
+  //
+  // direct=false: wait on the inbox condition variable for the progress
+  // thread's push (two thread hand-offs per message).
+  //
+  // direct=true (VERDICT r3 #4, blocked-receiver direct drain): this thread
+  // takes the io lease and runs the poll/read engine INLINE — the sender's
+  // bytes wake this thread straight out of poll(), no progress-thread or
+  // cv hop. The progress thread parks itself while direct receives are
+  // active or recent (direct_hot), so the two never fight for the core.
+  int recv(void* buf, int64_t cap, int32_t* src_out, int64_t* len_out,
+           int timeout_ms, bool direct) {
+    if (!direct) hot_spin();
+    const int64_t deadline = now_us() + static_cast<int64_t>(timeout_ms) * 1000;
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> lk(q_mtx_);
+        int rc = try_pop_locked(buf, cap, src_out, len_out);
+        if (rc != 1) return rc;
+        if (stopped_.load()) return -2;
+        if (!direct) {
+          int64_t rem_ms = (deadline - now_us()) / 1000;
+          if (rem_ms <= 0) return 1;
+          // Yields early (as a timeout) when asked: the Python drainer
+          // holds its pump lease across this wait, and a direct receiver
+          // must be able to take that lease in microseconds, not after our
+          // full slice. The ask arrives via tm_poke (the Python layer's
+          // lock excludes reaching recv(direct) while we hold the lease,
+          // so the direct_waiters_ count alone cannot signal it).
+          q_cv_.wait_for(lk, std::chrono::milliseconds(rem_ms), [this] {
+            return !inbox_.empty() || stopped_.load() ||
+                   direct_waiters_.load(std::memory_order_relaxed) > 0 ||
+                   yield_req_.load(std::memory_order_relaxed) > 0;
+          });
+          if (inbox_.empty() && !stopped_.load()) {
+            yield_req_.store(0, std::memory_order_relaxed);
+            return 1;
+          }
+          continue;                    // loop pops under the lock
+        }
+      }
+      // -- direct drive ----------------------------------------------------
+      direct_waiters_.fetch_add(1, std::memory_order_relaxed);
+      if (io_mtx_.try_lock()) {
+        int64_t rem_ms = (deadline - now_us()) / 1000;
+        int slice = rem_ms < 1 ? 1 : (rem_ms > 50 ? 50 : static_cast<int>(rem_ms));
+        pump_io(slice);
+        io_mtx_.unlock();
+      } else {
+        // the progress thread holds the engine: poke its poll and yield any
+        // non-direct cv waiter, then wait briefly for it to hand over.
+        // (Poking only on THIS path matters: an unconditional poke would
+        // make our own next poll wake instantly on the stale pipe byte —
+        // a busy spin that starves the sender process on small-core hosts.)
+        poke_wake();
+        q_cv_.notify_all();
+        std::unique_lock<std::mutex> lk(q_mtx_);
+        q_cv_.wait_for(lk, std::chrono::milliseconds(1), [this] {
+          return !inbox_.empty() || stopped_.load();
+        });
+      }
+      last_direct_us_.store(now_us(), std::memory_order_relaxed);
+      direct_waiters_.fetch_sub(1, std::memory_order_relaxed);
+      if (now_us() >= deadline &&
+          inbox_n_.load(std::memory_order_acquire) == 0)
+        return 1;
+    }
+  }
+
+  // Ask any thread blocked in a NON-direct recv (the Python drainer) to
+  // yield its lease immediately; also breaks the progress thread's poll.
+  void request_yield() {
+    yield_req_.fetch_add(1, std::memory_order_relaxed);
+    poke_wake();
+    q_cv_.notify_all();
   }
 
   void stop() {
@@ -404,15 +477,44 @@ class Transport {
     q_cv_.notify_all();
   }
 
+  void poke_wake() {
+    if (wake_pipe_[1] >= 0) {
+      char c = 'w';
+      (void)!::write(wake_pipe_[1], &c, 1);
+    }
+  }
+
+  bool direct_hot() const {
+    return direct_waiters_.load(std::memory_order_relaxed) > 0 ||
+           now_us() - last_direct_us_.load(std::memory_order_relaxed) < 20000;
+  }
+
   void progress_loop() {
     while (!stopped_.load()) {
+      if (direct_hot()) {
+        // a receiver thread is (or was a moment ago) driving the io engine
+        // inline; staying off the sockets lets it wake directly on arrival
+        // instead of waiting out our poll slice
+        std::this_thread::sleep_for(std::chrono::milliseconds(5));
+        continue;
+      }
+      std::lock_guard<std::mutex> g(io_mtx_);
+      pump_io(200);
+    }
+  }
+
+  // One poll/accept/read cycle over the listen socket, wake pipe and all
+  // connections (io_mtx_ held by the caller: the progress thread or a
+  // direct-receiving rank thread).
+  void pump_io(int timeout_ms) {
+    {
       std::vector<pollfd> pfds;
       pfds.push_back({listen_fd_, POLLIN, 0});
       pfds.push_back({wake_pipe_[0], POLLIN, 0});
       for (Conn& c : conns_) pfds.push_back({c.fd, POLLIN, 0});
-      int rc = ::poll(pfds.data(), pfds.size(), 200);
+      int rc = ::poll(pfds.data(), pfds.size(), timeout_ms);
       if (stopped_.load()) return;
-      if (rc <= 0) continue;
+      if (rc <= 0) return;
       if (pfds[0].revents & POLLIN) {
         int fd = ::accept(listen_fd_, nullptr, nullptr);
         if (fd >= 0) {
@@ -531,6 +633,14 @@ class Transport {
   // lock-free mirrors for hot_spin(): queue depth + last-arrival stamp
   std::atomic<int> inbox_n_{0};
   std::atomic<int64_t> last_push_us_{0};
+  // direct-drain lease: exactly one thread (the progress thread or a
+  // direct-receiving rank thread) runs pump_io at a time; the waiter count
+  // + recency stamp park the progress thread while receivers drive the
+  // engine inline (see recv(direct=true))
+  std::mutex io_mtx_;
+  std::atomic<int> direct_waiters_{0};
+  std::atomic<int64_t> last_direct_us_{0};
+  std::atomic<int> yield_req_{0};
   std::thread progress_;
   std::atomic<bool> stopped_{false};
   std::vector<Conn> conns_;
@@ -572,13 +682,15 @@ int tm_sendv(void* h, int dst, const void** bufs, const long long* lens,
 }
 
 int tm_recv(void* h, void* buf, long long cap, int* src_out,
-            long long* len_out, int timeout_ms) {
+            long long* len_out, int timeout_ms, int direct) {
   int64_t len64 = 0;
   int rc = static_cast<Transport*>(h)->recv(buf, cap, src_out, &len64,
-                                            timeout_ms);
+                                            timeout_ms, direct != 0);
   *len_out = len64;
   return rc;
 }
+
+void tm_poke(void* h) { static_cast<Transport*>(h)->request_yield(); }
 
 void tm_stop(void* h) { static_cast<Transport*>(h)->stop(); }
 

@@ -33,6 +33,7 @@ import socket
 import struct
 import sys
 import threading
+import time
 from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
@@ -40,7 +41,8 @@ import numpy as np
 from . import config
 from .buffers import is_wire_snapshot
 from ._runtime import (ANY_SOURCE, Mailbox, Message, SpmdContext, _Waitable,
-                       collective_wait_limit, set_env, set_process_env)
+                       collective_wait_limit, deadlock_timeout, set_env,
+                       set_process_env)
 from .error import (AbortError, CollectiveMismatchError, DeadlockError,
                     MPIError)
 
@@ -352,18 +354,22 @@ class _RemoteMailbox:
         self.world_rank = world_rank
 
     def post_blocking(self, msg: Message, what: str) -> None:
-        import time as _time
-        from ._runtime import deadlock_timeout
         ctx = self.ctx
-        deadline = _time.monotonic() + deadlock_timeout()
-        with ctx._choke_cond:
-            while self.world_rank in ctx.choked_by:
-                ctx.check_failure()
-                if _time.monotonic() > deadline:
-                    raise DeadlockError(
-                        f"deadlock suspected: rank {self.world_rank} kept "
-                        f"this sender choked >{deadlock_timeout()}s in {what}")
-                ctx._choke_cond.wait(0.02)
+        # Lock-free peek (hot path): choked_by only has entries while this
+        # destination is over its high-water mark. Missing a just-added
+        # choke lets at most one extra message through — backpressure is a
+        # sustained-imbalance mechanism, not an exact credit count.
+        if self.world_rank in ctx.choked_by:
+            from ._runtime import deadlock_timeout
+            deadline = time.monotonic() + deadlock_timeout()
+            with ctx._choke_cond:
+                while self.world_rank in ctx.choked_by:
+                    ctx.check_failure()
+                    if time.monotonic() > deadline:
+                        raise DeadlockError(
+                            f"deadlock suspected: rank {self.world_rank} kept "
+                            f"this sender choked >{deadlock_timeout()}s in {what}")
+                    ctx._choke_cond.wait(0.02)
         self.post(msg)
 
     def post(self, msg: Message) -> None:
@@ -453,6 +459,40 @@ class ProcChannel(_Waitable):
         # rounds whose waiter is mid-busy-probe: pongs are stored only while
         # the round is here, so a pong racing the collres can't leak forever
         self.probing: set[int] = set()
+
+    def _wait_for(self, pred, what, timeout=None, limit=None) -> bool:
+        """Collective wait with blocked-receiver direct drain (VERDICT r3
+        #4, extended to the collective rendezvous): the waiting rank thread
+        pumps its own transport instead of depending on the drainer, which
+        stays parked during and shortly after direct activity. Falls back
+        to the plain cond wait semantics for timeout/limit handling."""
+        if timeout is not None:
+            budget = timeout
+        elif limit is not None:
+            budget = limit
+        else:
+            budget = deadlock_timeout()
+        deadline = time.monotonic() + budget
+        ctx = self.ctx
+        ctx._pump_begin()
+        try:
+            while not pred():
+                ctx.check_failure()
+                if time.monotonic() >= deadline:
+                    if timeout is not None:
+                        return False
+                    raise DeadlockError(
+                        f"deadlock suspected: blocked >{budget}s in {what}")
+                self.lock.release()
+                try:
+                    pumped = ctx._direct_pump(0.02, pred)
+                finally:
+                    self.lock.acquire()
+                if not pumped:
+                    self.cond.wait(0.002)
+        finally:
+            ctx._pump_end()
+        return True
 
     def _mismatch(self, theirs: str, mine: str) -> None:
         """Record a cross-tier mismatch (drainer-side: fail, don't raise —
@@ -975,6 +1015,25 @@ class ProcContext(SpmdContext):
         self._pending_unchokes: set[int] = set()
         self.mailboxes[local_rank].drain_hook = self._maybe_unchoke
         self.mailboxes[local_rank].pending_recv_hook = self._unchoke_all
+        # Blocked-receiver direct drain (VERDICT r3 #4): one lease on the
+        # transport's recv side, shared by the drainer thread and any rank
+        # thread blocked in Recv/Wait/Probe. While a receiver waits, the
+        # DRAINER IS PARKED (event) and the receiver owns the socket: the
+        # message path is sender-process → this thread's own poll(), no
+        # drainer→mailbox→scheduler hops and no polling thread competing
+        # for the core. ``_last_direct`` keeps the drainer's poll slices
+        # short for a grace period after direct activity, so a ping-pong
+        # receiver re-entering Recv reclaims the lease without waiting out
+        # a full _POLL_MS slice.
+        self._pump_lock = threading.Lock()
+        self._last_direct = 0.0
+        self._direct_waiters = 0
+        self._waiters_lock = threading.Lock()
+        self._drainer_resume = threading.Event()
+        mb = self.mailboxes[local_rank]
+        mb.direct_pump = self._direct_pump
+        mb.pump_begin = self._pump_begin
+        mb.pump_end = self._pump_end
         self._drainer = threading.Thread(target=self._drain, daemon=True,
                                          name="tpu-mpi-drainer")
         self._drainer_stop = threading.Event()
@@ -1022,6 +1081,8 @@ class ProcContext(SpmdContext):
         """Drainer-loop tail: ship queued unchoke frames. A failed unchoke
         fate-shares — the peer would otherwise hang choked until a
         misleading DeadlockError."""
+        if not self._pending_unchokes:     # lock-free peek: hot-path no-op
+            return
         with self._choke_peers_lock:
             if not self._pending_unchokes:
                 return
@@ -1044,34 +1105,115 @@ class ProcContext(SpmdContext):
                    shm_ok=self.shm_ok(world_dst))
 
     # -- frame pump -----------------------------------------------------------
+    def _handle_frame(self, src_world: int, frame) -> None:
+        """Decode + dispatch one received frame (drainer and direct-pump
+        shared body; caller holds the pump lease, so frame order is
+        preserved across the two entry points)."""
+        try:
+            fast = _fast_p2p_decode(frame)
+            item = None if fast is not None else loads_oob(frame)
+        except Exception as e:                  # corrupted frame: fate-share
+            self.fail(MPIError(f"undecodable frame from {src_world}: {e}"))
+            return
+        try:
+            if fast is not None:
+                self._deliver_p2p(src_world, fast)
+            else:
+                self._dispatch(src_world, item)
+        except Exception as e:
+            # A failure while dispatching a decoded frame (malformed
+            # tuple, error inside deliver/post) must fate-share, not
+            # silently kill the drainer thread (ADVICE r1).
+            self.fail(MPIError(
+                f"error dispatching frame from {src_world}: "
+                f"{type(e).__name__}: {e}"))
+
+    def _pump_begin(self) -> None:
+        """A rank thread is entering a blocked receive: park the drainer."""
+        with self._waiters_lock:
+            self._direct_waiters += 1
+            self._drainer_resume.clear()
+
+    def _pump_end(self) -> None:
+        with self._waiters_lock:
+            self._direct_waiters -= 1
+            if self._direct_waiters == 0:
+                self._last_direct = time.monotonic()
+        # no resume-event set here: waking the drainer per completed receive
+        # costs a context switch per message on small-core hosts. The
+        # drainer's parked wait has a 50 ms cap, and every blocking wait
+        # (P2P and collective) pumps for itself, so nothing depends on the
+        # drainer for latency.
+
+    def _direct_pump(self, timeout_s: float, done=None) -> bool:
+        """Blocked-receiver drain: poll the transport from the waiting rank
+        thread itself (the drainer is parked by _pump_begin). Returns True
+        iff a frame was delivered or ``done()`` turned true while acquiring
+        the lease (e.g. the drainer delivered our message during its last
+        slice); False on idle socket or when a sibling holds the lease."""
+        if not self._pump_lock.acquire(timeout=0.001):
+            # the drainer holds the lease, possibly blocked deep in its poll
+            # slice: ask it to yield (tm_poke -> its non-direct recv returns
+            # as a timeout in microseconds), then wait for the handover
+            poke = getattr(self.transport, "poke", None)
+            if poke is not None:
+                poke()
+            if not self._pump_lock.acquire(timeout=timeout_s):
+                return False
+        try:
+            if done is not None and done():
+                return True                 # delivered while we waited
+            self._last_direct = time.monotonic()
+            self._flush_unchokes()
+            try:
+                got = self.transport.recv(max(1, int(timeout_s * 1000)),
+                                          direct=True)
+            except ConnectionResetError:
+                return False                    # shutting down
+            if got is None:
+                return False
+            self._handle_frame(*got)
+            return True
+        finally:
+            self._pump_lock.release()
+
     def _drain(self) -> None:
         while not self._drainer_stop.is_set():
             self._flush_unchokes()
-            try:
-                got = self.transport.recv(_POLL_MS)
-            except ConnectionResetError:
-                return
-            if got is None:
+            # park while any rank thread is pumping its own socket — zero
+            # CPU from this thread during a blocked receive (the wait has a
+            # cap only so stop/failure are still noticed)
+            if self._direct_waiters > 0:
+                # parked nap, capped at 50 ms. Deliberately NOT woken per
+                # completed receive (_pump_end) — that would cost a context
+                # switch per message; every blocking wait pumps for itself,
+                # so only shutdown() needs to wake us early (it sets the
+                # event).
+                self._drainer_resume.wait(0.05)
+                self._drainer_resume.clear()
                 continue
-            src_world, frame = got
-            try:
-                fast = _fast_p2p_decode(frame)
-                item = None if fast is not None else loads_oob(frame)
-            except Exception as e:              # corrupted frame: fate-share
-                self.fail(MPIError(f"undecodable frame from {src_world}: {e}"))
+            # grace period after direct activity: the main thread is mid
+            # message loop (e.g. between ping-pong Recvs) and will re-take
+            # the lease within microseconds — touching the socket here would
+            # make it wait out our poll slice. Sleep without the lease;
+            # frames sit in the C++ inbox at most this long if the main
+            # thread never comes back.
+            if time.monotonic() - self._last_direct < 0.02:
+                time.sleep(0.005)
                 continue
+            # recv AND dispatch under one lease hold: releasing between the
+            # two would let a direct pumper deliver a later frame first,
+            # breaking non-overtaking order
+            self._pump_lock.acquire()
             try:
-                if fast is not None:
-                    self._deliver_p2p(src_world, fast)
-                else:
-                    self._dispatch(src_world, item)
-            except Exception as e:
-                # A failure while dispatching a decoded frame (malformed
-                # tuple, error inside deliver/post) must fate-share, not
-                # silently kill the drainer thread (ADVICE r1).
-                self.fail(MPIError(
-                    f"error dispatching frame from {src_world}: "
-                    f"{type(e).__name__}: {e}"))
+                try:
+                    got = self.transport.recv(_POLL_MS)
+                except ConnectionResetError:
+                    return
+                if got is not None:
+                    self._handle_frame(*got)
+            finally:
+                self._pump_lock.release()
 
     def _deliver_p2p(self, src_world: int, msg: Message) -> None:
         mb = self.mailboxes[self.local_rank]
@@ -1331,6 +1473,7 @@ class ProcContext(SpmdContext):
                     except Exception:
                         pass
         self._drainer_stop.set()
+        self._drainer_resume.set()      # wake a parked drainer promptly
         self.transport.stop()
 
 

@@ -120,12 +120,19 @@ def _coerce(name: str, default: Any, raw: Any) -> Any:
                        code=_ec.ERR_ARG) from None
 
 
+# Bumped whenever the effective config is (re)computed; hot-path callers
+# (``_runtime.deadlock_timeout``) key their caches on it so a
+# ``load(refresh=True)`` invalidates them without taking the lock per call.
+GENERATION = 0
+
+
 def load(refresh: bool = False) -> Config:
     """The effective configuration (cached after first read)."""
-    global _cached
+    global _cached, GENERATION
     with _lock:
         if _cached is not None and not refresh:
             return _cached
+        GENERATION += 1
         cfg = Config()
         file_vals = _read_toml(_toml_path())
         merged: dict[str, Any] = {}

@@ -124,42 +124,57 @@ def transformer_forward(cfg: TransformerConfig, params: dict,
 
     x = params["embed"][tokens]                                   # (b, t, d)
     for layer in params["layers"]:
-        # -- attention --
-        y = _rms_norm(x, layer["ln1"])
-        if tp_axis is not None:
-            qkv = column_parallel(y, layer["w_qkv"], axis=tp_axis)
-        else:
-            qkv = y @ layer["w_qkv"]                          # (b, t, 3d/tp)
-        # w_qkv columns are packed per head ([head][q|k|v][dh]) so a
-        # contiguous tp column shard holds whole heads and the sharded
-        # forward equals the single-device one.
-        qkv = qkv.reshape(b, t, h_local, 3, dh).transpose(0, 2, 1, 3, 4)
-        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-        q = _rope(q, positions)
-        k = _rope(k, positions)
-        if sp_axis is not None:
-            o = ring_attention(q, k, v, axis=sp_axis, causal=True)
-        else:
-            s = jnp.einsum("bhqd,bhkd->bhqk", q * dh ** -0.5, k)
-            mask = jnp.tril(jnp.ones((t, t), dtype=bool))
-            s = jnp.where(mask, s, -1e30)
-            o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
-        o = o.transpose(0, 2, 1, 3).reshape(b, t, h_local * dh)
-        if tp_axis is not None:
-            x = x + row_parallel(o, layer["w_proj"], axis=tp_axis)
-        else:
-            x = x + o @ layer["w_proj"]
-
-        # -- feed-forward --
-        y = _rms_norm(x, layer["ln2"])
-        if tp_axis is not None:
-            hmid = jax.nn.gelu(column_parallel(y, layer["w_in"], axis=tp_axis))
-            x = x + row_parallel(hmid, layer["w_out"], axis=tp_axis)
-        else:
-            x = x + jax.nn.gelu(y @ layer["w_in"]) @ layer["w_out"]
-
+        x = _attn_ffn_block(cfg, layer, x, positions,
+                            tp_axis=tp_axis, sp_axis=sp_axis)
     x = _rms_norm(x, params["ln_f"])
     return (x @ params["embed"].T).astype(jnp.float32)            # (b, t, V)
+
+
+def _attn_ffn_block(cfg: TransformerConfig, layer: dict, x: jnp.ndarray,
+                    positions: jnp.ndarray, *, tp_axis: Optional[str],
+                    sp_axis: Optional[str]) -> jnp.ndarray:
+    """One transformer layer (pre-norm attention + FFN), tp/sp aware —
+    shared by the flat forward and the pipelined 4-axis stage."""
+    b, t, _ = x.shape
+    h = cfg.n_heads
+    tp = 1 if tp_axis is None else lax.axis_size(tp_axis)
+    h_local = h // tp
+    dh = cfg.head_dim
+
+    # -- attention --
+    y = _rms_norm(x, layer["ln1"])
+    if tp_axis is not None:
+        qkv = column_parallel(y, layer["w_qkv"], axis=tp_axis)
+    else:
+        qkv = y @ layer["w_qkv"]                              # (b, t, 3d/tp)
+    # w_qkv columns are packed per head ([head][q|k|v][dh]) so a
+    # contiguous tp column shard holds whole heads and the sharded
+    # forward equals the single-device one.
+    qkv = qkv.reshape(b, t, h_local, 3, dh).transpose(0, 2, 1, 3, 4)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    q = _rope(q, positions)
+    k = _rope(k, positions)
+    if sp_axis is not None:
+        o = ring_attention(q, k, v, axis=sp_axis, causal=True)
+    else:
+        s = jnp.einsum("bhqd,bhkd->bhqk", q * dh ** -0.5, k)
+        mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+        s = jnp.where(mask, s, -1e30)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+    o = o.transpose(0, 2, 1, 3).reshape(b, t, h_local * dh)
+    if tp_axis is not None:
+        x = x + row_parallel(o, layer["w_proj"], axis=tp_axis)
+    else:
+        x = x + o @ layer["w_proj"]
+
+    # -- feed-forward --
+    y = _rms_norm(x, layer["ln2"])
+    if tp_axis is not None:
+        hmid = jax.nn.gelu(column_parallel(y, layer["w_in"], axis=tp_axis))
+        x = x + row_parallel(hmid, layer["w_out"], axis=tp_axis)
+    else:
+        x = x + jax.nn.gelu(y @ layer["w_in"]) @ layer["w_out"]
+    return x
 
 
 def _xent(logits, labels):
@@ -369,6 +384,127 @@ def transformer_pp_moe_train_step(cfg: TransformerConfig, mesh,
         return params, loss
 
     data_spec = P(dp_axis, None)
+    step = jax.jit(jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(specs, data_spec, data_spec),
+        out_specs=(specs, P())))
+    return step, specs
+
+
+# ---------------------------------------------------------------------------
+# 4-axis variant: DP x TP x SP x PP in ONE step (VERDICT r3 #9). Layers are
+# stacked over 'pp' (GPipe microbatch rotation), attention/FFN weights are
+# Megatron-sharded over 'tp', the sequence is ring-attention-sharded over
+# 'sp', and the batch over 'dp' — four simultaneously nontrivial axes.
+# ---------------------------------------------------------------------------
+
+def transformer_4d_init(key, cfg: TransformerConfig) -> dict:
+    """Layer-stacked dense params: every layer tensor carries a leading
+    (n_layers,) dim (sharded over 'pp'); within a layer the shapes match
+    transformer_init's per-layer dicts."""
+    def dense(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    d, f, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    keys = jax.random.split(key, 5)
+    return {
+        "embed": dense(keys[0], (cfg.vocab, d), d ** -0.5),
+        "ln_f": jnp.ones((d,), cfg.dtype),
+        "ln1": jnp.ones((L, d), cfg.dtype),
+        "w_qkv": dense(keys[1], (L, d, 3 * d), d ** -0.5),
+        "w_proj": dense(keys[2], (L, d, d), (2 * d * L) ** -0.5),
+        "ln2": jnp.ones((L, d), cfg.dtype),
+        "w_in": dense(keys[3], (L, d, f), d ** -0.5),
+        "w_out": dense(keys[4], (L, f, d), (2 * f * L) ** -0.5),
+    }
+
+
+def transformer_4d_specs(pp_axis: str, tp_axis: str) -> dict:
+    """PartitionSpecs matching transformer_4d_init: leading layer dim over
+    pp; Megatron column/row sharding over tp within each layer."""
+    return {
+        "embed": P(), "ln_f": P(),
+        "ln1": P(pp_axis), "ln2": P(pp_axis),
+        "w_qkv": P(pp_axis, None, tp_axis),    # column-parallel
+        "w_proj": P(pp_axis, tp_axis, None),   # row-parallel
+        "w_in": P(pp_axis, None, tp_axis),
+        "w_out": P(pp_axis, tp_axis, None),
+    }
+
+
+def transformer_4d_train_step(cfg: TransformerConfig, mesh, lr: float = 1e-2,
+                              *, dp_axis: str = "dp", tp_axis: str = "tp",
+                              sp_axis: str = "sp", pp_axis: str = "pp",
+                              microbatches: Optional[int] = None):
+    """Jitted DP x TP x SP x PP train step (the flagship on a 4-axis mesh):
+    batch over dp, Megatron f/g matmuls over tp, ring attention over sp,
+    GPipe stages over pp. Returns (step, param_specs); step(params, tokens,
+    labels) -> (params, loss) with tokens/labels global (batch, seq) arrays
+    sharded (batch->dp, seq->sp)."""
+    from ..parallel.pp import pipeline_forward
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    for a in (dp_axis, tp_axis, sp_axis, pp_axis):
+        if a not in sizes:
+            raise ValueError(f"mesh is missing axis {a!r}")
+    if cfg.n_heads % sizes[tp_axis]:
+        raise ValueError(f"n_heads={cfg.n_heads} must divide over tp size "
+                         f"{sizes[tp_axis]}")
+    if cfg.n_layers % sizes[pp_axis]:
+        raise ValueError(f"n_layers={cfg.n_layers} must divide over "
+                         f"{sizes[pp_axis]} pipeline stages")
+    n_pp = sizes[pp_axis]
+    m = microbatches or max(2, 2 * n_pp)
+    specs = transformer_4d_specs(pp_axis, tp_axis)
+
+    def local_step(params, tokens, labels):
+        b, t = tokens.shape            # local (dp- and sp-sharded) block
+        if b % m:
+            raise ValueError(f"local batch {b} must divide into {m} "
+                             f"microbatches")
+        sp_idx = lax.axis_index(sp_axis)
+        positions = sp_idx * t + jnp.arange(t)
+
+        def loss_fn(p):
+            stage = {k: p[k] for k in ("ln1", "w_qkv", "w_proj", "ln2",
+                                       "w_in", "w_out")}
+            e = p["embed"][tokens].reshape(m, b // m, t, cfg.d_model)
+
+            def stage_fn(sp_, x):
+                for i in range(sp_["w_qkv"].shape[0]):     # local layers
+                    layer = {k: v[i] for k, v in sp_.items()}
+                    x = _attn_ffn_block(cfg, layer, x, positions,
+                                        tp_axis=tp_axis, sp_axis=sp_axis)
+                return x
+
+            acts = pipeline_forward(stage_fn, stage, e, axis=pp_axis)
+            acts = acts.reshape(b, t, cfg.d_model)
+            logits = (_rms_norm(acts, p["ln_f"])
+                      @ p["embed"].T).astype(jnp.float32)
+            l = _xent(logits, labels)
+            # only the last stage's emissions are the real model output
+            last = lax.axis_index(pp_axis) == n_pp - 1
+            return lax.psum(jnp.where(last, l, 0.0), pp_axis)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+
+        def reduce_leaf(path_key, g):
+            if path_key in ("embed", "ln_f"):
+                # replicated everywhere; distinct contributions from each
+                # dp/sp data shard and each pp stage (embed: the injected
+                # activations on stage 0 + the logit matmul on the last)
+                return lax.psum(g, (dp_axis, sp_axis, pp_axis))
+            # pp-sharded layer stacks: dp/sp data shards sum; tp grads are
+            # already correct from the f/g custom_vjp pair
+            return lax.psum(g, (dp_axis, sp_axis))
+
+        grads = {k: reduce_leaf(k, g) for k, g in grads.items()}
+        params = jax.tree_util.tree_map(
+            lambda p_, g: (p_ - lr * g).astype(p_.dtype), params, grads)
+        loss = lax.pmean(loss, (dp_axis, sp_axis))
+        return params, loss
+
+    data_spec = P(dp_axis, sp_axis)
     step = jax.jit(jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(specs, data_spec, data_spec),

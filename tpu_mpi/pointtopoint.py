@@ -24,11 +24,13 @@ from __future__ import annotations
 
 import pickle
 import time
+
+import numpy as np
 from typing import Any, Optional, Sequence
 
-from ._runtime import (ANY_SOURCE, ANY_TAG, PROC_NULL, Message, PendingRecv,
-                       require_env)
-from .buffers import element_count, to_wire, write_flat
+from ._runtime import (ANY_SOURCE, ANY_TAG, PROC_NULL, Mailbox, Message,
+                       PendingRecv, require_env)
+from .buffers import element_count, extract_array, to_wire, write_flat
 from .comm import Comm
 from .datatypes import Datatype, to_datatype
 from . import error as _ec
@@ -216,6 +218,20 @@ def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
 
 def _send_typed(buf: Any, dest: int, tag: int, comm: Comm, block: bool) -> None:
     count = element_count(buf)
+    if block:
+        ctx, _ = require_env()
+        mb = ctx.mailboxes[_resolve(comm, dest)]
+        if not isinstance(mb, Mailbox):
+            # Remote blocking send: the frame is fully on the wire before
+            # this call returns, so no defensive snapshot is needed — pass
+            # the user's array straight to the codec (it serializes or
+            # writev's from the original memory). Isend and same-process
+            # destinations still snapshot: their payload outlives the call.
+            arr = extract_array(buf)
+            if isinstance(arr, np.ndarray):
+                _post(comm, dest, tag, arr, count, to_datatype(arr.dtype),
+                      "typed", block=True)
+                return
     arr = to_wire(buf, count)
     _post(comm, dest, tag, arr, count, to_datatype(arr.dtype), "typed",
           block=block)
@@ -285,8 +301,19 @@ def Recv(buf_or_type: Any, src: int, tag: int, comm: Comm):
         return (tmp[0].item() if dt.np_dtype.fields is None else tmp[0]), st
     if src == PROC_NULL:
         return Status(source=PROC_NULL, tag=ANY_TAG, count=0)
-    req = Irecv(buf_or_type, src, tag, comm)
-    return req.wait()
+    # inline blocking path (no Request object): post the receive, wait on
+    # the mailbox (direct-drain capable), deliver — the small-message
+    # latency lane (VERDICT r3 #4)
+    mb = _my_mailbox(comm)
+    pr = mb.post_recv(int(src), int(tag), comm.cid)
+    msg = mb.wait_recv(pr)
+    assert msg is not None            # blocking Recv exposes no cancel handle
+    n = element_count(buf_or_type)
+    if msg.count > n:
+        raise TruncationError(
+            f"message of {msg.count} elements truncated to {n}")
+    write_flat(buf_or_type, msg.payload, msg.count)
+    return _status_of(msg)
 
 
 def Irecv(buf: Any, src: int, tag: int, comm: Comm) -> Request:
