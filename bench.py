@@ -242,6 +242,10 @@ def _force_cpu_backend() -> None:
 
 
 def main() -> None:
+    # a congested tunnel can stretch one 1 GB device op past the default
+    # 60 s deadlock budget while sibling rank-threads wait in Barrier —
+    # that is slowness, not deadlock. Don't clobber an explicit override.
+    os.environ.setdefault("TPU_MPI_DEADLOCK_TIMEOUT", "600")
     result = None
     try:
         import jax

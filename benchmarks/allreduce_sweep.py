@@ -183,6 +183,10 @@ def _procs_child(max_bytes: int, rows_out: str) -> None:
 
 
 def main() -> None:
+    # a congested tunnel can stretch one 1 GB device op past the default
+    # 60 s deadlock budget while sibling rank-threads wait in Barrier —
+    # that is slowness, not deadlock. Don't clobber an explicit override.
+    os.environ.setdefault("TPU_MPI_DEADLOCK_TIMEOUT", "600")
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-bytes", type=int, default=1 << 30)
     ap.add_argument("--ranks", type=int, default=4)

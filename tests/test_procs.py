@@ -510,6 +510,44 @@ def test_intercomm_collectives_across_processes():
         assert f"INTER-OK-{r}" in res.stdout
 
 
+def test_partitioned_p2p_across_processes():
+    """MPI-4 partitioned send/recv across OS processes: partition messages
+    ride the generic wire codec (tuple-tagged), out-of-order Pready, early
+    Parrived consumption."""
+    res = _run_procs("""
+        import time
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        P, L = 4, 3
+        if rank == 0:
+            src = np.arange(P * L, dtype=np.float64)
+            sreq = MPI.Psend_init(src, P, 1, 9, comm)
+            MPI.Start(sreq)
+            for i in (1, 3, 0, 2):
+                MPI.Pready(sreq, i)
+            MPI.Wait(sreq)
+        elif rank == 1:
+            dst = np.zeros(P * L, np.float64)
+            rreq = MPI.Precv_init(dst, P, 0, 9, comm)
+            MPI.Start(rreq)
+            deadline = time.monotonic() + 60
+            while not MPI.Parrived(rreq, 3):
+                assert time.monotonic() < deadline
+                time.sleep(0.001)
+            MPI.Wait(rreq)
+            assert np.array_equal(dst, np.arange(P * L, dtype=np.float64)), dst
+        MPI.Barrier(comm)
+        print(f"PART-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """, nprocs=2)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"PART-OK-{r}" in res.stdout
+
+
 def test_slow_combine_does_not_false_positive_deadlock():
     """A collective whose combine outlasts the deadlock budget (e.g. a >60s
     XLA compile at the star root) must complete: waiters probe the root's

@@ -422,3 +422,179 @@ def test_persistent_requests_halo_loop(AT, nprocs):
         MPI.Barrier(comm)
 
     run_spmd(body, nprocs)
+
+
+def test_sendrecv_replace(nprocs):
+    """MPI_Sendrecv_replace: one buffer, ring shift (standard MPI-1; absent
+    from the reference v0.14.2 — beyond parity)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        buf = np.full(4, float(rank))
+        MPI.Sendrecv_replace(buf, (rank + 1) % size, 3, (rank - 1) % size,
+                             3, comm)
+        assert np.all(buf == (rank - 1) % size), buf
+
+    run_spmd(body, nprocs)
+
+
+def test_isendrecv(nprocs):
+    """MPI-4 Isendrecv / Isendrecv_replace: nonblocking combined exchange."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        nxt, prv = (rank + 1) % size, (rank - 1) % size
+        out = np.zeros(3)
+        req = MPI.Isendrecv(np.full(3, float(rank)), nxt, 5, out, prv, 5, comm)
+        st = MPI.Wait(req)
+        assert np.all(out == prv) and st.source == prv
+
+        buf = np.full(2, float(rank))
+        req = MPI.Isendrecv_replace(buf, nxt, 6, prv, 6, comm)
+        MPI.Wait(req)
+        assert np.all(buf == prv), buf
+
+    run_spmd(body, nprocs)
+
+
+def test_partitioned_p2p(nprocs):
+    """MPI-4 partitioned communication: Psend_init/Pready out-of-order,
+    Parrived early consumption, two rounds through the same requests."""
+    if nprocs < 2:
+        import pytest
+        pytest.skip("needs >= 2 ranks")
+
+    P = 4          # partitions
+    L = 3          # elements per partition
+
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        if rank == 0:
+            src = np.arange(P * L, dtype=np.float64)
+            sreq = MPI.Psend_init(src, P, 1, 9, comm)
+            for rnd in range(2):
+                src += 100 * rnd
+                MPI.Start(sreq)
+                # mark partitions ready out of order: each ships eagerly
+                for i in (2, 0, 3, 1):
+                    MPI.Pready(sreq, i)
+                MPI.Wait(sreq)
+        elif rank == 1:
+            dst = np.zeros(P * L, np.float64)
+            rreq = MPI.Precv_init(dst, P, 0, 9, comm)
+            expect = np.arange(P * L, dtype=np.float64)
+            for rnd in range(2):
+                expect = expect + 100 * rnd
+                MPI.Start(rreq)
+                # consume an early partition before full completion
+                import time as _t
+                deadline = _t.monotonic() + 30
+                while not MPI.Parrived(rreq, 2):
+                    assert _t.monotonic() < deadline
+                    _t.sleep(0.001)
+                assert np.array_equal(dst[2 * L:3 * L], expect[2 * L:3 * L])
+                MPI.Wait(rreq)
+                assert np.array_equal(dst, expect), (dst, expect)
+        # ranks >= 2 idle this test
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs)
+
+
+def test_partitioned_validation(nprocs):
+    """Partitioned misuse raises with the right error codes."""
+    import pytest
+    from tpu_mpi import error as ec
+
+    def body():
+        comm = MPI.COMM_WORLD
+        buf = np.zeros(10)
+        with pytest.raises(MPI.MPIError) as ei:
+            MPI.Psend_init(buf, 3, 0, 1, comm)      # 10 % 3 != 0
+        assert ei.value.code == ec.ERR_COUNT
+        req = MPI.Psend_init(buf, 5, 0, 1, comm)
+        with pytest.raises(MPI.MPIError) as ei:
+            MPI.Pready(req, 0)                       # before Start
+        assert ei.value.code == ec.ERR_REQUEST
+
+    run_spmd(body, 1)
+
+
+def test_partitioned_isolated_from_wildcards(nprocs):
+    """MPI-4 forbids partitioned transfers matching normal wildcard
+    receives: an ANY_TAG Recv must not steal in-flight partition messages
+    (review finding r4)."""
+    if nprocs < 2:
+        import pytest
+        pytest.skip("needs >= 2 ranks")
+
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        if rank == 0:
+            src = np.arange(4.0)
+            sreq = MPI.Psend_init(src, 2, 1, 9, comm)
+            MPI.Start(sreq)
+            MPI.Pready_range(sreq, 0, 1)
+            MPI.Wait(sreq)
+            MPI.Send(np.full(2, 77.0), 1, 9, comm)   # the normal message
+        elif rank == 1:
+            # wildcard receive posted FIRST must get the normal message,
+            # not a partition frame
+            buf = np.zeros(2)
+            st = MPI.Recv(buf, 0, MPI.ANY_TAG, comm)
+            assert np.all(buf == 77.0), buf
+            assert st.tag == 9
+            dst = np.zeros(4)
+            rreq = MPI.Precv_init(dst, 2, 0, 9, comm)
+            MPI.Start(rreq)
+            MPI.Wait(rreq)
+            assert np.array_equal(dst, np.arange(4.0)), dst
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs)
+
+
+def test_partitioned_count_mismatch_fails_loudly(nprocs):
+    """Asymmetric partition counts corrupt silently in naive designs; here
+    delivery validates each partition's length (review finding r4)."""
+    if nprocs < 2:
+        import pytest
+        pytest.skip("needs >= 2 ranks")
+    import pytest
+
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        if rank == 0:
+            src = np.arange(12.0)
+            sreq = MPI.Psend_init(src, 4, 1, 9, comm)    # 4 x 3 elements
+            MPI.Start(sreq)
+            MPI.Pready_range(sreq, 0, 3)
+            MPI.Wait(sreq)
+        elif rank == 1:
+            dst = np.zeros(12)
+            rreq = MPI.Precv_init(dst, 2, 0, 9, comm)    # 2 x 6 elements
+            MPI.Start(rreq)
+            with pytest.raises((MPI.MPIError, MPI.AbortError)):
+                MPI.Wait(rreq)
+
+    run_spmd(body, nprocs)   # the error raises (and is asserted) in rank 1
+
+
+def test_partitioned_cancel_then_wait(nprocs):
+    """Cancel on an armed partitioned receive completes Wait with
+    STATUS_EMPTY instead of crashing (review finding r4)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        if MPI.Comm_rank(comm) == 0:
+            dst = np.zeros(4)
+            rreq = MPI.Precv_init(dst, 2, MPI.Comm_size(comm) - 1, 9, comm)
+            MPI.Start(rreq)
+            MPI.Cancel(rreq)
+            st = MPI.Wait(rreq)
+            assert st is MPI.STATUS_EMPTY or st.count == 0
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs)

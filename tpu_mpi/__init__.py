@@ -58,11 +58,14 @@ from .collective import (Allgather, Allgatherv, Allreduce, Alltoall,
 
 # Point-to-point (src/pointtopoint.jl)
 from .pointtopoint import (Cancel, Get_count, Get_error, Get_source, Get_tag,
-                           Iprobe, Irecv, Isend, Prequest, Probe, Recv,
+                           Iprobe, Irecv, Isend, Isendrecv, Isendrecv_replace,
+                           Parrived, PartitionedRequest, Pready, Pready_range,
+                           Precv_init, Prequest, Probe, Psend_init, Recv,
                            Recv_init, Request, REQUEST_NULL, Send, Send_init,
-                           Sendrecv, Start, Startall, Status, STATUS_EMPTY,
-                           Test, Testall, Testany, Testsome, Wait, Waitall,
-                           Waitany, Waitsome, irecv, isend, recv, send)
+                           Sendrecv, Sendrecv_replace, Start, Startall,
+                           Status, STATUS_EMPTY, Test, Testall, Testany,
+                           Testsome, Wait, Waitall, Waitany, Waitsome, irecv,
+                           isend, recv, send)
 
 # Parallel I/O (src/io.jl) — usage: MPI.File.open / read_at / write_at_all …
 from . import io as File

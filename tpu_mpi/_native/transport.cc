@@ -399,6 +399,10 @@ class Transport {
       (void)!::write(wake_pipe_[1], &c, 1);
     }
     if (progress_.joinable()) progress_.join();
+    // A rank thread may still be inside pump_io() (direct drain); the
+    // stopped_ flag + wake poke make it return promptly, and holding the
+    // io lease below means we never close fds out from under it.
+    std::lock_guard<std::mutex> io_g(io_mtx_);
     int npeers;
     {
       std::lock_guard<std::mutex> g(peers_mtx_);

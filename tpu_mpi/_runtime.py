@@ -175,9 +175,14 @@ class PendingRecv:
         self.cancelled = False
 
     def matches(self, m: Message) -> bool:
+        # ANY_TAG is a USER wildcard: it must not capture internal
+        # tuple-tagged lanes (partitioned traffic uses ("part", tag) —
+        # MPI-4 forbids partitioned transfers matching normal wildcard
+        # receives). An explicit tuple tag still matches exactly.
         return (m.cid == self.cid
                 and (self.src == ANY_SOURCE or self.src == m.src)
-                and (self.tag == ANY_TAG or self.tag == m.tag))
+                and ((self.tag == ANY_TAG and not isinstance(m.tag, tuple))
+                     or self.tag == m.tag))
 
 
 class Mailbox(_Waitable):

@@ -30,7 +30,8 @@ from typing import Any, Optional, Sequence
 
 from ._runtime import (ANY_SOURCE, ANY_TAG, PROC_NULL, Mailbox, Message,
                        PendingRecv, require_env)
-from .buffers import element_count, extract_array, to_wire, write_flat
+from .buffers import (element_count, extract_array, is_wire_snapshot,
+                      to_wire, write_flat)
 from .comm import Comm
 from .datatypes import Datatype, to_datatype
 from . import error as _ec
@@ -200,7 +201,11 @@ def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
     # no seq stamp here: thread-tier delivery is atomic with ordering (one
     # mailbox lock), so there is nothing to check and the hot path stays
     # config-free; the wire proxy stamps under its own lock (backend.py)
-    msg = Message(my_rank, int(tag), comm.cid, payload, count, dtype, kind)
+    # tuple tags carry internal lanes (partitioned traffic: ("part", tag));
+    # user tags stay ints
+    msg = Message(my_rank,
+                  tag if isinstance(tag, tuple) else int(tag),
+                  comm.cid, payload, count, dtype, kind)
     mb = ctx.mailboxes[_resolve(comm, dest)]
     if block and hasattr(mb, "post_blocking"):
         # Flow control for blocking sends. Thread tier: admission-checked
@@ -218,6 +223,12 @@ def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
 
 def _send_typed(buf: Any, dest: int, tag: int, comm: Comm, block: bool) -> None:
     count = element_count(buf)
+    if isinstance(buf, np.ndarray) and is_wire_snapshot(buf):
+        # already a private to_wire snapshot (Sendrecv_replace /
+        # Isendrecv_replace made it): re-snapshotting would just copy again
+        _post(comm, dest, tag, buf, count, to_datatype(buf.dtype), "typed",
+              block=block)
+        return
     if block:
         ctx, _ = require_env()
         mb = ctx.mailboxes[_resolve(comm, dest)]
@@ -578,10 +589,11 @@ def Recv_init(buf: Any, src: int, tag: int, comm: Comm) -> Prequest:
 
 
 def Start(req: Prequest) -> Prequest:
-    """Arm a persistent request (MPI_Start)."""
-    if not isinstance(req, Prequest):
-        raise MPIError(code=_ec.ERR_REQUEST, msg="Start requires a persistent request "
-                       "(Send_init/Recv_init)")
+    """Arm a persistent or partitioned request (MPI_Start)."""
+    if not hasattr(req, "start"):
+        raise MPIError(code=_ec.ERR_REQUEST,
+                       msg="Start requires a persistent/partitioned request "
+                       "(Send_init/Recv_init/Psend_init/Precv_init)")
     return req.start()
 
 
@@ -590,3 +602,254 @@ def Startall(reqs: Sequence[Prequest]) -> Sequence[Prequest]:
     for r in reqs:
         Start(r)
     return reqs
+
+
+def Sendrecv_replace(buf: Any, dest: int, sendtag: int, src: int,
+                     recvtag: int, comm: Comm) -> Status:
+    """Combined send+receive through ONE buffer (MPI_Sendrecv_replace —
+    absent from the reference v0.14.2; standard MPI-1). The outgoing data
+    is snapshotted before the receive can overwrite it."""
+    snap = to_wire(buf, element_count(buf))
+    return Sendrecv(snap, dest, sendtag, buf, src, recvtag, comm)
+
+
+def Isendrecv(sendbuf: Any, dest: int, sendtag: int,
+              recvbuf: Any, src: int, recvtag: int, comm: Comm) -> Request:
+    """Nonblocking combined send+receive (MPI-4 MPI_Isendrecv; beyond the
+    reference). Returns ONE request that completes when the receive lands;
+    the send side is buffered (Isend semantics) and needs no tracking."""
+    rreq = Irecv(recvbuf, src, recvtag, comm) if src != PROC_NULL else \
+        Request("null", status=Status(source=PROC_NULL, tag=ANY_TAG))
+    if dest != PROC_NULL:
+        _send_typed(sendbuf, dest, sendtag, comm, block=False)
+    return rreq
+
+
+def Isendrecv_replace(buf: Any, dest: int, sendtag: int, src: int,
+                      recvtag: int, comm: Comm) -> Request:
+    """Nonblocking combined send+receive through one buffer (MPI-4
+    MPI_Isendrecv_replace). The outgoing data is snapshotted at call time."""
+    snap = to_wire(buf, element_count(buf))
+    return Isendrecv(snap, dest, sendtag, buf, src, recvtag, comm)
+
+
+# ---------------------------------------------------------------------------
+# Partitioned communication (MPI-4 §4.2 — far beyond the reference v0.14.2).
+# A partitioned send binds one buffer split into N equal partitions; the
+# application marks partitions ready as it produces them (Pready) and each
+# ships immediately — the MPI API shape for compute/communication overlap
+# that TPU pipelines use (a stage Preadys its microbatch slice as the next
+# one computes). The receive side completes partition-by-partition
+# (Parrived), so a consumer can start on early partitions while later ones
+# are still in flight.
+#
+# Host-path realization: each partition travels as one ordinary message on
+# the derived tag ("part", tag) — per-(src,dst,cid) FIFO plus the
+# Start-after-Wait contract keeps rounds from interleaving, so no round
+# counter is needed on the wire.
+# ---------------------------------------------------------------------------
+
+class PartitionedRequest:
+    """Partitioned request (Psend_init / Precv_init). Duck-types the Request
+    completion protocol, so the whole Wait/Test family accepts it. Like
+    persistent requests, completion returns it to inactive-but-reusable."""
+
+    def __init__(self, kind: str, buf: Any, partitions: int, peer: int,
+                 tag: int, comm: Comm):
+        n = element_count(buf)
+        if partitions < 1 or n % partitions != 0:
+            raise MPIError(f"buffer of {n} elements cannot split into "
+                           f"{partitions} equal partitions",
+                           code=_ec.ERR_COUNT)
+        self.kind = kind            # "psend" | "precv"
+        self.buffer = buf
+        self.partitions = partitions
+        self.plen = n // partitions
+        self.peer = peer
+        self.tag = ("part", int(tag))
+        self.comm = comm
+        self.status: Optional[Status] = None
+        self._active = False
+        # send side: which partitions were Pready'd this round
+        self._ready: set[int] = set()
+        # recv side: pending receives + arrived partition payloads
+        self._pending: list = []
+        self._arrived: dict[int, Any] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "PartitionedRequest":
+        if self._active:
+            raise MPIError("Start on an already-active partitioned request",
+                           code=_ec.ERR_REQUEST)
+        self._active = True
+        self._ready = set()
+        self._arrived = {}
+        if self.kind == "precv":
+            mb = _my_mailbox(self.comm)
+            self._pending = [
+                mb.post_recv(int(self.peer), self.tag, self.comm.cid)
+                for _ in range(self.partitions)]
+        return self
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    # -- send side -----------------------------------------------------------
+    def pready(self, i: int) -> None:
+        if self.kind != "psend":
+            raise MPIError("Pready on a partitioned receive",
+                           code=_ec.ERR_REQUEST)
+        if not self._active:
+            raise MPIError("Pready before Start", code=_ec.ERR_REQUEST)
+        i = int(i)
+        if not (0 <= i < self.partitions):
+            raise MPIError(f"partition {i} out of range "
+                           f"[0, {self.partitions})", code=_ec.ERR_ARG)
+        if i in self._ready:
+            raise MPIError(f"partition {i} already marked ready",
+                           code=_ec.ERR_REQUEST)
+        arr = extract_array(self.buffer)
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        part = np.array(flat[i * self.plen:(i + 1) * self.plen], copy=True)
+        _post(self.comm, self.peer, self.tag, (i, part), self.plen, None,
+              "object", block=False)
+        self._ready.add(i)
+
+    # -- recv side -----------------------------------------------------------
+    def _accept(self, payload) -> None:
+        i, part = payload
+        n = int(np.asarray(part).size)
+        if n != self.plen:
+            raise MPIError(
+                f"partitioned transfer mismatch: sender partition holds {n} "
+                f"elements, receiver expects {self.plen} — Psend_init and "
+                f"Precv_init must describe the same partitioning",
+                code=_ec.ERR_COUNT)
+        self._arrived[int(i)] = part
+
+    def _drain_arrivals(self) -> None:
+        mb = _my_mailbox(self.comm)
+        still = []
+        for pr in self._pending:
+            if mb.test_recv(pr) and pr.msg is not None:
+                self._accept(pr.msg.payload)
+            else:
+                still.append(pr)
+        self._pending = still
+
+    def parrived(self, i: int) -> bool:
+        if self.kind != "precv":
+            raise MPIError("Parrived on a partitioned send",
+                           code=_ec.ERR_REQUEST)
+        self._drain_arrivals()
+        if int(i) in self._arrived:
+            self._deliver_one(int(i))
+            return True
+        return False
+
+    def _deliver_one(self, i: int) -> None:
+        part = self._arrived.get(i)
+        if part is None or isinstance(part, bool):
+            return
+        from .buffers import write_range
+        write_range(self.buffer, i * self.plen, np.asarray(part).reshape(-1))
+        self._arrived[i] = True       # delivered marker
+
+    # -- completion protocol (Wait/Test family) ------------------------------
+    def test(self) -> bool:
+        if not self._active:
+            return True
+        if self.kind == "psend":
+            return len(self._ready) == self.partitions
+        self._drain_arrivals()
+        return len(self._arrived) == self.partitions
+
+    def wait(self) -> Status:
+        if not self._active:
+            return self.status or STATUS_EMPTY
+        ctx, _ = require_env()
+        if self.kind == "psend":
+            # completes once every partition was marked ready (they ship
+            # eagerly at Pready time). Another thread may still be
+            # producing partitions — poll with the deadlock budget.
+            from ._runtime import deadlock_timeout
+            deadline = time.monotonic() + deadlock_timeout()
+            while len(self._ready) < self.partitions:
+                ctx.check_failure()
+                if time.monotonic() > deadline:
+                    raise MPIError(
+                        f"Wait on partitioned send with only "
+                        f"{len(self._ready)}/{self.partitions} partitions "
+                        f"marked ready", code=_ec.ERR_PENDING)
+                time.sleep(0.0005)
+            self.status = STATUS_EMPTY
+        else:
+            mb = _my_mailbox(self.comm)
+            cancelled = False
+            for pr in self._pending:
+                msg = mb.wait_recv(pr)
+                if msg is None:               # receive was cancelled
+                    cancelled = True
+                    continue
+                self._accept(msg.payload)
+            self._pending = []
+            if cancelled and len(self._arrived) < self.partitions:
+                self.status = STATUS_EMPTY
+                self._active = False
+                return self.status
+            for i in range(self.partitions):
+                self._deliver_one(i)
+            self.status = Status(source=int(self.peer), tag=self.tag[1],
+                                 count=self.partitions * self.plen)
+        self._active = False
+        return self.status
+
+    def _consume(self) -> Status:
+        st = self.wait() if self._active else (self.status or STATUS_EMPTY)
+        return st
+
+    def cancel(self) -> None:
+        mb = _my_mailbox(self.comm)
+        for pr in self._pending:
+            mb.cancel(pr)
+
+    def __repr__(self) -> str:
+        return (f"<PartitionedRequest {self.kind} "
+                f"{self.partitions}x{self.plen} active={self._active}>")
+
+
+def Psend_init(buf: Any, partitions: int, dest: int, tag: int,
+               comm: Comm) -> PartitionedRequest:
+    """Create an inactive partitioned send (MPI-4 MPI_Psend_init): ``buf``
+    splits into ``partitions`` equal parts; after :func:`Start`, mark each
+    with :func:`Pready` as its data becomes valid — it ships immediately."""
+    return PartitionedRequest("psend", buf, int(partitions), dest, tag, comm)
+
+
+def Precv_init(buf: Any, partitions: int, src: int, tag: int,
+               comm: Comm) -> PartitionedRequest:
+    """Create an inactive partitioned receive (MPI-4 MPI_Precv_init);
+    :func:`Parrived` reports (and delivers) individual partitions before
+    the whole request completes."""
+    return PartitionedRequest("precv", buf, int(partitions), src, tag, comm)
+
+
+def Pready(req: PartitionedRequest, i: int) -> None:
+    """Mark partition ``i`` of an active partitioned send ready
+    (MPI_Pready); the partition is transferred immediately."""
+    req.pready(i)
+
+
+def Pready_range(req: PartitionedRequest, lo: int, hi: int) -> None:
+    """Mark partitions [lo, hi] ready (MPI_Pready_range; bounds inclusive
+    per the MPI-4 binding)."""
+    for i in range(int(lo), int(hi) + 1):
+        req.pready(i)
+
+
+def Parrived(req: PartitionedRequest, i: int) -> bool:
+    """Whether partition ``i`` of an active partitioned receive has arrived
+    (MPI_Parrived); an arrived partition is delivered into its slice of the
+    receive buffer before this returns True."""
+    return req.parrived(i)
