@@ -20,6 +20,8 @@ EXAMPLES = os.path.join(REPO, "examples")
     ("05-ingraph.py", 8),
     ("06-jacobi.py", 4),
     ("07-overlap.py", 4),
+    ("08-checkpoint.py", 4),
+    ("09-partitioned.py", 2),
 ])
 def test_example_runs(name, nsim):
     env = dict(os.environ)
