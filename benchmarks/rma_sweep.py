@@ -1,0 +1,185 @@
+"""One-sided RMA latency/bandwidth sweep (Put / Get / Fetch_and_op).
+
+OSU-style companion to ``p2p_sweep.py`` for the window path
+(reference surface: /root/reference/src/onesided.jl; SURVEY.md §2.3
+"one-sided RMA"). Two ranks; rank 1 exposes a window, rank 0 drives:
+
+- ``put_lat`` / ``get_lat`` — lock → one op → unlock (flush included),
+  per-op latency;
+- ``put_bw``  — lock → WINDOW ops → unlock, bandwidth;
+- ``fop_lat`` — Fetch_and_op(SUM) scalar, the atomic round-trip.
+
+Thread tier by default; ``--procs`` runs the cross-process wire engine
+(tpu_mpi._rma_wire) over the native transport.
+
+Usage: python benchmarks/rma_sweep.py [--max-bytes N] [--procs] [-o file]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from common import detect_platform, emit, iters_for, size_sweep
+
+WINDOW = 32
+REPEATS = 3
+
+
+def _sweep_body(max_bytes: int, emit_row) -> None:
+    import numpy as np
+    import tpu_mpi as MPI
+
+    comm = MPI.COMM_WORLD
+    rank = comm.rank()
+
+    for nbytes in size_sweep(max_bytes):
+        n = max(1, nbytes // 8)
+        target = np.zeros(n, np.float64)
+        win = MPI.Win_create(target, comm)
+        src = np.ones(n, np.float64)
+        dst = np.zeros(n, np.float64)
+        warmup, iters = iters_for(nbytes)
+        iters = max(4, iters // 2)
+
+        def timed(op):
+            best = float("inf")
+            for rep in range(REPEATS + 1):
+                it = max(2, warmup) if rep == 0 else iters
+                MPI.Barrier(comm)
+                t0 = time.perf_counter()
+                if rank == 0:
+                    for _ in range(it):
+                        op()
+                dt = (time.perf_counter() - t0) / it
+                MPI.Barrier(comm)
+                if rep > 0 and rank == 0:
+                    best = min(best, dt)
+            return best
+
+        def put_once():
+            MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 1, 0, win)
+            MPI.Put(src, n, 1, 0, win)
+            MPI.Win_unlock(1, win)
+
+        def get_once():
+            MPI.Win_lock(MPI.LOCK_SHARED, 1, 0, win)
+            MPI.Get(dst, n, 1, 0, win)
+            MPI.Win_unlock(1, win)
+
+        def put_window():
+            MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 1, 0, win)
+            for _ in range(WINDOW):
+                MPI.Put(src, n, 1, 0, win)
+            MPI.Win_unlock(1, win)
+
+        put_lat = timed(put_once)
+        get_lat = timed(get_once)
+        put_win = timed(put_window)
+
+        if rank == 0:
+            # correctness spot check: the target saw our ones
+            MPI.Win_lock(MPI.LOCK_SHARED, 1, 0, win)
+            MPI.Get(dst, n, 1, 0, win)
+            MPI.Win_unlock(1, win)
+            assert np.all(dst == 1.0), dst[:4]
+        MPI.Barrier(comm)
+        win.free() if hasattr(win, "free") else None
+
+        if rank == 0:
+            emit_row({"bytes": n * 8,
+                      "put_lat_us": round(put_lat * 1e6, 2),
+                      "get_lat_us": round(get_lat * 1e6, 2),
+                      "put_bw_gbps": round(n * 8 * WINDOW / put_win / 1e9, 3)})
+
+    # scalar atomic
+    import numpy as np
+    counter = np.zeros(1, np.float64)
+    win = MPI.Win_create(counter, comm)
+    result = np.zeros(1, np.float64)
+    one = np.ones(1, np.float64)
+
+    def fop_once():
+        MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 1, 0, win)
+        MPI.Fetch_and_op(one, result, 1, 0, MPI.SUM, win)
+        MPI.Win_unlock(1, win)
+
+    best = float("inf")
+    for rep in range(REPEATS + 1):
+        it = 10 if rep == 0 else 50
+        MPI.Barrier(comm)
+        t0 = time.perf_counter()
+        if rank == 0:
+            for _ in range(it):
+                fop_once()
+        dt = (time.perf_counter() - t0) / it
+        MPI.Barrier(comm)
+        if rep > 0 and rank == 0:
+            best = min(best, dt)
+    if rank == 0:
+        emit_row({"fop_lat_us": round(best * 1e6, 2)})
+
+
+def run_threads(max_bytes: int) -> list[dict]:
+    import tpu_mpi as MPI
+    from tpu_mpi import spmd_run
+
+    rows: list[dict] = []
+
+    def body():
+        MPI.Init()
+
+        def emit_row(row):
+            rows.append(row)
+            print(f"rma {row}", file=sys.stderr)
+        _sweep_body(max_bytes, emit_row)
+        MPI.Finalize()
+
+    spmd_run(body, 2)
+    return rows
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-bytes", type=int, default=1 << 22)
+    ap.add_argument("--procs", action="store_true")
+    ap.add_argument("--rows-out", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("-o", "--out", default="-")
+    args = ap.parse_args()
+
+    if os.environ.get("TPU_MPI_PROC_RANK") is not None:
+        import json
+        import tpu_mpi as MPI
+        MPI.Init()
+        with open(args.rows_out or os.devnull, "a") as f:
+            _sweep_body(args.max_bytes,
+                        lambda row: (f.write(json.dumps(row) + "\n"),
+                                     f.flush()))
+        MPI.Finalize()
+        return
+
+    if args.procs:
+        import json
+        import tempfile
+        from tpu_mpi.launcher import launch_processes
+        with tempfile.NamedTemporaryFile("r", suffix=".jsonl") as rows_f:
+            code = launch_processes(
+                os.path.abspath(__file__), 2,
+                ["--max-bytes", str(args.max_bytes),
+                 "--rows-out", rows_f.name], timeout=3600)
+            if code != 0:
+                sys.exit(code)
+            rows = [json.loads(l) for l in rows_f.read().splitlines()]
+        tier = "procs"
+    else:
+        rows = run_threads(args.max_bytes)
+        tier = "threads"
+
+    emit(args.out, {"benchmark": "rma_sweep", "tier": tier, "window": WINDOW,
+                    "platform": detect_platform(), "rows": rows})
+
+
+if __name__ == "__main__":
+    main()

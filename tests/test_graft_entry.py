@@ -35,3 +35,20 @@ def test_dryrun_multichip(n):
         # the 4-axis flagship config must have run, all axes nontrivial
         assert "4-axis mesh" in res.stdout, res.stdout
         assert "'dp': 2, 'tp': 2, 'sp': 2, 'pp': 2" in res.stdout, res.stdout
+
+
+def test_entry_compiles_single_chip():
+    """The driver compile-checks entry() single-chip; keep that path green
+    on the CPU-sim substrate too (same jit, different backend)."""
+    code = (f"import sys; sys.path.insert(0, {REPO!r}); "
+            "import jax; import __graft_entry__ as g; "
+            "fn, args = g.entry(); out = jax.jit(fn)(*args); "
+            "print('ENTRY-OK', out.shape)")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300, cwd=REPO, env=env)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "ENTRY-OK" in res.stdout

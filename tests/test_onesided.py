@@ -203,3 +203,53 @@ def test_concurrent_puts_distinct_slots_devicebuffer(nprocs):
         win.free()
 
     run_spmd(body, nprocs)
+
+
+def test_lazy_epoch_semantics(nprocs):
+    """Deferred (lazy) passive-target epochs: a short write-only epoch
+    ships as one frame at unlock; reads inside an epoch see the epoch's
+    own buffered Puts (materialization replays in order); epochs past the
+    op bound materialize and stay correct."""
+    if nprocs < 2:
+        import pytest
+        pytest.skip("needs >= 2 ranks")
+
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        target = np.zeros(64, np.float64)
+        win = MPI.Win_create(target, comm)
+        if rank == 0:
+            # (1) write-only epoch (the 1-round-trip lane)
+            MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 1, 0, win)
+            MPI.Put(np.full(4, 5.0), 4, 1, 0, win)
+            MPI.Accumulate(np.full(4, 2.0), 4, 1, 0, MPI.SUM, win)
+            MPI.Win_unlock(1, win)
+            # (2) read-inside-epoch: Get must see this epoch's Put
+            got = np.zeros(4)
+            MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 1, 0, win)
+            MPI.Put(np.full(4, 9.0), 4, 1, 8, win)
+            MPI.Get(got, 4, 1, 8, win)
+            MPI.Win_unlock(1, win)
+            assert np.all(got == 9.0), got
+            # (3) epoch overflowing the batch bound (forces materialize)
+            MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 1, 0, win)
+            for i in range(24):            # > _EPOCH_MAX_OPS
+                MPI.Put(np.full(1, float(i)), 1, 1, 16 + i, win)
+            MPI.Win_unlock(1, win)
+            # (4) flush inside a deferred epoch completes the buffered ops
+            MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 1, 0, win)
+            MPI.Put(np.full(1, 77.0), 1, 1, 63, win)
+            MPI.Win_flush(1, win)
+            MPI.Win_unlock(1, win)
+        MPI.Barrier(comm)
+        if rank == 1:
+            assert np.all(np.asarray(target[0:4]) == 7.0), target[:4]
+            assert np.all(np.asarray(target[8:12]) == 9.0)
+            assert np.array_equal(np.asarray(target[16:40]),
+                                  np.arange(24.0)), target[16:40]
+            assert float(np.asarray(target[63])) == 77.0
+        MPI.Barrier(comm)
+        win.free()
+
+    run_spmd(body, nprocs)

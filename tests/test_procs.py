@@ -510,6 +510,52 @@ def test_intercomm_collectives_across_processes():
         assert f"INTER-OK-{r}" in res.stdout
 
 
+def test_lazy_epoch_across_processes():
+    """Deferred passive-target epochs over the wire engine: write-only
+    epochs batch into one lock+ops+unlock frame; reads materialize the lock
+    and see the epoch's own Puts; overflow + flush materialize correctly."""
+    res = _run_procs("""
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        target = np.zeros(64, np.float64)
+        win = MPI.Win_create(target, comm)
+        if rank == 0:
+            MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 1, 0, win)
+            MPI.Put(np.full(4, 5.0), 4, 1, 0, win)
+            MPI.Accumulate(np.full(4, 2.0), 4, 1, 0, MPI.SUM, win)
+            MPI.Win_unlock(1, win)
+            got = np.zeros(4)
+            MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 1, 0, win)
+            MPI.Put(np.full(4, 9.0), 4, 1, 8, win)
+            MPI.Get(got, 4, 1, 8, win)
+            MPI.Win_unlock(1, win)
+            assert np.all(got == 9.0), got
+            MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 1, 0, win)
+            for i in range(24):
+                MPI.Put(np.full(1, float(i)), 1, 1, 16 + i, win)
+            MPI.Win_unlock(1, win)
+            MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 1, 0, win)
+            MPI.Put(np.full(1, 77.0), 1, 1, 63, win)
+            MPI.Win_flush(1, win)
+            MPI.Win_unlock(1, win)
+        MPI.Barrier(comm)
+        if rank == 1:
+            assert np.all(target[0:4] == 7.0), target[:4]
+            assert np.all(target[8:12] == 9.0)
+            assert np.array_equal(target[16:40], np.arange(24.0))
+            assert target[63] == 77.0
+        MPI.Barrier(comm)
+        print(f"LAZY-RMA-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """, nprocs=2)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"LAZY-RMA-OK-{r}" in res.stdout
+
+
 def test_partitioned_p2p_across_processes():
     """MPI-4 partitioned send/recv across OS processes: partition messages
     ride the generic wire codec (tuple-tagged), out-of-order Pready, early

@@ -142,6 +142,11 @@ class ProcWinState:
         self.atomic_lock = threading.Lock()
         self.lockmgr = LockManager()
         self.lock = threading.Lock()        # origin-side bookkeeping
+        # Lazy passive-target epochs (MPICH-style): Win_lock on a remote
+        # target defers the wire lock; short write-only epochs ship as ONE
+        # lock+ops+unlock frame at Win_unlock (1 round trip instead of 2+).
+        # world rank -> {"excl": bool, "ops": [(kind, ...), ...]}
+        self.deferred: dict[int, dict] = {}
         self.dirty: set[int] = set()        # world ranks with unacked ops
         self._shm_own = None                # SharedMemory this rank created
         self._shm_peers: dict[int, tuple[Any, np.ndarray]] = {}
@@ -223,6 +228,28 @@ class RmaEngine:
     def wait_resp(self, reqid: int, what: str) -> Any:
         limit = deadlock_timeout()
         deadline = time.monotonic() + limit
+        pump = getattr(self.ctx, "_direct_pump", None)
+        if pump is not None:
+            # blocked-origin direct drain (VERDICT r3 #4, extended to RMA):
+            # the origin thread pumps its own transport while waiting for
+            # the target's response, instead of depending on the parked
+            # drainer — the response wakes THIS thread out of poll().
+            done = lambda: reqid in self._responses
+            self.ctx._pump_begin()
+            try:
+                while not done():
+                    self.ctx.check_failure()
+                    if time.monotonic() > deadline:
+                        raise DeadlockError(
+                            f"deadlock suspected: {what} blocked >{limit}s")
+                    if not pump(0.02, done):
+                        with self.cond:
+                            if not done():
+                                self.cond.wait(0.002)
+            finally:
+                self.ctx._pump_end()
+            with self.cond:
+                return self._responses.pop(reqid)
         with self.cond:
             while reqid not in self._responses:
                 self.ctx.check_failure()
@@ -284,6 +311,25 @@ class RmaEngine:
             _, _, _, reqid, origin, excl = item
             st.lockmgr.release(origin, excl)
             self.respond(origin, reqid, None)
+        elif kind == "lepoch":
+            # a whole deferred lock epoch in one frame: acquire the lock
+            # (immediately or queued), apply every buffered op, release,
+            # ack. The grant callback runs wherever the lock manager fires
+            # it (this dispatch, or a later release's pump) — always a
+            # frame-pumping thread, never blocked.
+            _, _, _, reqid, origin, excl, ops = item
+
+            def run_epoch():
+                for op in ops:
+                    if op[0] == "put":
+                        st.apply_put(op[1], np.asarray(op[2]))
+                    else:               # ("acc", disp, arr, opspec)
+                        st.apply_acc(op[1], np.asarray(op[2]),
+                                     _resolve_op(op[3]), fetch=False)
+                st.lockmgr.release(origin, excl)
+                self.respond(origin, reqid, None)
+
+            st.lockmgr.request(origin, excl, run_epoch)
         else:
             raise MPIError(f"unknown RMA frame kind {kind!r}")
 
@@ -391,6 +437,50 @@ def _origin_flat(origin: Any, count: int) -> np.ndarray:
     return np.ascontiguousarray(flat[:int(count)])
 
 
+# A deferred epoch stays batched while it is small and write-only; past
+# these bounds (or on any read) it materializes into a live wire lock.
+_EPOCH_MAX_OPS = 16
+_EPOCH_MAX_BYTES = 1 << 20
+
+
+def _materialize_lock(st: ProcWinState, world: int) -> None:
+    """Turn a deferred epoch into a live one: take the wire lock for real
+    and replay the buffered ops as ordinary frames (FIFO keeps order)."""
+    ctx, _ = require_env()
+    ep = st.deferred.pop(world, None)
+    if ep is None:
+        return
+    eng = _engine(ctx)
+    reqid = eng.new_reqid()
+    eng.send(world, ("lock", st.win_id, reqid, ctx.local_rank, ep["excl"]))
+    eng.wait_resp(reqid, "Win_lock")
+    for op in ep["ops"]:
+        if op[0] == "put":
+            with st.lock:
+                st.dirty.add(world)
+            eng.send(world, ("put", st.win_id, op[1], op[2]))
+        else:
+            with st.lock:
+                st.dirty.add(world)
+            eng.send(world, ("acc", st.win_id, op[1], op[2], op[3],
+                             None, ctx.local_rank))
+
+
+def _epoch_buffer(st: ProcWinState, world: int, op: tuple) -> bool:
+    """Try to buffer an op into a deferred epoch; False = caller sends
+    live (materializing first if the epoch just overflowed)."""
+    ep = st.deferred.get(world)
+    if ep is None:
+        return False
+    nbytes = sum(getattr(o[2], "nbytes", 0) for o in ep["ops"])
+    if (len(ep["ops"]) >= _EPOCH_MAX_OPS
+            or nbytes + getattr(op[2], "nbytes", 0) > _EPOCH_MAX_BYTES):
+        _materialize_lock(st, world)
+        return False
+    ep["ops"].append(op)
+    return True
+
+
 def rma_put(st: ProcWinState, origin: Any, count: int, target_rank: int,
             disp: int) -> None:
     ctx, _ = require_env()
@@ -398,6 +488,8 @@ def rma_put(st: ProcWinState, origin: Any, count: int, target_rank: int,
     world = _target_world(st, target_rank)
     if world == ctx.local_rank:
         st.apply_put(disp, src)
+        return
+    if _epoch_buffer(st, world, ("put", int(disp), src)):
         return
     with st.lock:
         st.dirty.add(world)
@@ -411,6 +503,9 @@ def rma_get(st: ProcWinState, origin: Any, count: int, target_rank: int,
     if world == ctx.local_rank:
         data = st.read(disp, int(count))
     else:
+        # reads need the real lock + earlier ops applied (a Get must see
+        # this epoch's own Puts)
+        _materialize_lock(st, world)
         eng = _engine(ctx)
         reqid = eng.new_reqid()
         eng.send(world, ("get", st.win_id, int(disp), int(count), reqid,
@@ -433,11 +528,14 @@ def rma_accumulate(st: ProcWinState, origin_flat: np.ndarray, target_rank: int,
         return
     eng = _engine(ctx)
     if fetch_into is None:
+        if _epoch_buffer(st, world, ("acc", int(disp), src, _op_spec(op))):
+            return
         with st.lock:
             st.dirty.add(world)
         eng.send(world, ("acc", st.win_id, int(disp), src, _op_spec(op),
                          None, ctx.local_rank))
     else:
+        _materialize_lock(st, world)    # fetching ops read: need real lock
         reqid = eng.new_reqid()
         eng.send(world, ("acc", st.win_id, int(disp), src, _op_spec(op),
                          reqid, ctx.local_rank))
@@ -461,6 +559,10 @@ def _flush_targets(st: ProcWinState, worlds) -> None:
 
 def proc_flush(st: ProcWinState, target_rank: int) -> None:
     world = _target_world(st, target_rank)
+    if world in st.deferred:
+        # Win_flush inside a deferred epoch: the ops must complete at the
+        # target NOW — take the lock for real and flush the replayed ops
+        _materialize_lock(st, world)
     with st.lock:
         pending = world in st.dirty
         st.dirty.discard(world)
@@ -496,10 +598,17 @@ def proc_lock(st: ProcWinState, target_rank: int, exclusive: bool) -> None:
                 raise DeadlockError(
                     f"deadlock suspected: Win_lock blocked >{limit}s")
         return
-    eng = _engine(ctx)
-    reqid = eng.new_reqid()
-    eng.send(world, ("lock", st.win_id, reqid, ctx.local_rank, exclusive))
-    eng.wait_resp(reqid, "Win_lock")
+    # Lazy lock (MPICH-style): defer the wire lock — a short write-only
+    # epoch ships as one lock+ops+unlock frame at Win_unlock (1 round trip
+    # instead of 2+). Reads, flushes and big epochs materialize it.
+    if world in st.deferred:
+        # double lock on the same target from this origin: the eager
+        # protocol self-deadlocked loudly here; keep the failure loud
+        # instead of silently dropping the first epoch's buffered ops
+        raise MPIError(
+            f"Win_lock on target {target_rank}: this origin already holds "
+            f"a lock epoch on that target", code=_ec.ERR_RMA_SYNC)
+    st.deferred[world] = {"excl": bool(exclusive), "ops": []}
 
 
 def proc_unlock(st: ProcWinState, target_rank: int, exclusive: bool) -> None:
@@ -511,6 +620,19 @@ def proc_unlock(st: ProcWinState, target_rank: int, exclusive: bool) -> None:
         st.lockmgr.release(ctx.local_rank, exclusive)
         return
     eng = _engine(ctx)
+    ep = st.deferred.pop(world, None)
+    if ep is not None:
+        # whole deferred epoch in one frame; the ack means lock acquired,
+        # every op applied, lock released
+        reqid = eng.new_reqid()
+        eng.send(world, ("lepoch", st.win_id, reqid, ctx.local_rank,
+                         ep["excl"], ep["ops"]))
+        eng.wait_resp(reqid, "Win_unlock")
+        with st.lock:
+            # the ack completed every earlier FIFO frame too — keep the
+            # fence-mode dirty bookkeeping consistent with the live path
+            st.dirty.discard(world)
+        return
     reqid = eng.new_reqid()
     eng.send(world, ("unlock", st.win_id, reqid, ctx.local_rank, exclusive))
     eng.wait_resp(reqid, "Win_unlock")
