@@ -510,6 +510,47 @@ def test_intercomm_collectives_across_processes():
         assert f"INTER-OK-{r}" in res.stdout
 
 
+def test_isend_buffer_reuse_across_processes():
+    """Isend to a remote rank is buffered: the caller may overwrite the
+    send buffer immediately after Isend returns (MPI buffered-send
+    semantics). Guards the no-snapshot remote fast path — the wire write
+    completes inside the call, so mutation-after-Isend must never leak
+    into the received data."""
+    res = _run_procs("""
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        if rank == 0:
+            buf = np.full(1 << 16, 1.0)        # big enough for the shm lane
+            reqs = []
+            for k in range(4):
+                buf[:] = float(k)
+                reqs.append(MPI.Isend(buf, 1, k, comm))
+                buf[:] = -99.0                 # immediately clobber
+            MPI.Waitall(reqs)
+            small = np.full(8, 5.0)            # fast-lane size too
+            r = MPI.Isend(small, 1, 99, comm)
+            small[:] = -1.0
+            MPI.Wait(r)
+        elif rank == 1:
+            got = np.zeros(1 << 16)
+            for k in range(4):
+                MPI.Recv(got, 0, k, comm)
+                assert np.all(got == float(k)), (k, got[:4])
+            s = np.zeros(8)
+            MPI.Recv(s, 0, 99, comm)
+            assert np.all(s == 5.0), s
+        MPI.Barrier(comm)
+        print(f"ISEND-REUSE-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """, nprocs=2)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"ISEND-REUSE-OK-{r}" in res.stdout
+
+
 def test_lazy_epoch_across_processes():
     """Deferred passive-target epochs over the wire engine: write-only
     epochs batch into one lock+ops+unlock frame; reads materialize the lock

@@ -147,6 +147,12 @@ class ProcWinState:
         # lock+ops+unlock frame at Win_unlock (1 round trip instead of 2+).
         # world rank -> {"excl": bool, "ops": [(kind, ...), ...]}
         self.deferred: dict[int, dict] = {}
+        # THREAD_MULTIPLE: sibling threads sharing an origin epoch must see
+        # buffer/materialize/ship as atomic steps — an append racing a
+        # materialize pop would orphan (lose) the op, and a live send
+        # racing the materialize's wire lock could reach the target before
+        # the lock does. RLock: materialize replays ops that re-enter.
+        self.epoch_lock = threading.RLock()
         self.dirty: set[int] = set()        # world ranks with unacked ops
         self._shm_own = None                # SharedMemory this rank created
         self._shm_peers: dict[int, tuple[Any, np.ndarray]] = {}
@@ -226,30 +232,17 @@ class RmaEngine:
         self.send(origin, ("resp", reqid, payload))
 
     def wait_resp(self, reqid: int, what: str) -> Any:
-        limit = deadlock_timeout()
-        deadline = time.monotonic() + limit
-        pump = getattr(self.ctx, "_direct_pump", None)
-        if pump is not None:
+        done = lambda: reqid in self._responses
+        if getattr(self.ctx, "_direct_pump", None) is not None:
             # blocked-origin direct drain (VERDICT r3 #4, extended to RMA):
             # the origin thread pumps its own transport while waiting for
-            # the target's response, instead of depending on the parked
-            # drainer — the response wakes THIS thread out of poll().
-            done = lambda: reqid in self._responses
-            self.ctx._pump_begin()
-            try:
-                while not done():
-                    self.ctx.check_failure()
-                    if time.monotonic() > deadline:
-                        raise DeadlockError(
-                            f"deadlock suspected: {what} blocked >{limit}s")
-                    if not pump(0.02, done):
-                        with self.cond:
-                            if not done():
-                                self.cond.wait(0.002)
-            finally:
-                self.ctx._pump_end()
+            # the target's response (_runtime.pump_wait, the shared loop).
+            from ._runtime import pump_wait
             with self.cond:
+                pump_wait(self.ctx, self.cond, done, what)
                 return self._responses.pop(reqid)
+        limit = deadlock_timeout()
+        deadline = time.monotonic() + limit
         with self.cond:
             while reqid not in self._responses:
                 self.ctx.check_failure()
@@ -445,7 +438,8 @@ _EPOCH_MAX_BYTES = 1 << 20
 
 def _materialize_lock(st: ProcWinState, world: int) -> None:
     """Turn a deferred epoch into a live one: take the wire lock for real
-    and replay the buffered ops as ordinary frames (FIFO keeps order)."""
+    and replay the buffered ops as ordinary frames (FIFO keeps order).
+    Caller holds st.epoch_lock."""
     ctx, _ = require_env()
     ep = st.deferred.pop(world, None)
     if ep is None:
@@ -468,7 +462,8 @@ def _materialize_lock(st: ProcWinState, world: int) -> None:
 
 def _epoch_buffer(st: ProcWinState, world: int, op: tuple) -> bool:
     """Try to buffer an op into a deferred epoch; False = caller sends
-    live (materializing first if the epoch just overflowed)."""
+    live (materializing first if the epoch just overflowed). Caller holds
+    st.epoch_lock."""
     ep = st.deferred.get(world)
     if ep is None:
         return False
@@ -489,11 +484,12 @@ def rma_put(st: ProcWinState, origin: Any, count: int, target_rank: int,
     if world == ctx.local_rank:
         st.apply_put(disp, src)
         return
-    if _epoch_buffer(st, world, ("put", int(disp), src)):
-        return
-    with st.lock:
-        st.dirty.add(world)
-    _engine(ctx).send(world, ("put", st.win_id, int(disp), src))
+    with st.epoch_lock:
+        if _epoch_buffer(st, world, ("put", int(disp), src)):
+            return
+        with st.lock:
+            st.dirty.add(world)
+        _engine(ctx).send(world, ("put", st.win_id, int(disp), src))
 
 
 def rma_get(st: ProcWinState, origin: Any, count: int, target_rank: int,
@@ -505,7 +501,8 @@ def rma_get(st: ProcWinState, origin: Any, count: int, target_rank: int,
     else:
         # reads need the real lock + earlier ops applied (a Get must see
         # this epoch's own Puts)
-        _materialize_lock(st, world)
+        with st.epoch_lock:
+            _materialize_lock(st, world)
         eng = _engine(ctx)
         reqid = eng.new_reqid()
         eng.send(world, ("get", st.win_id, int(disp), int(count), reqid,
@@ -528,14 +525,17 @@ def rma_accumulate(st: ProcWinState, origin_flat: np.ndarray, target_rank: int,
         return
     eng = _engine(ctx)
     if fetch_into is None:
-        if _epoch_buffer(st, world, ("acc", int(disp), src, _op_spec(op))):
-            return
-        with st.lock:
-            st.dirty.add(world)
-        eng.send(world, ("acc", st.win_id, int(disp), src, _op_spec(op),
-                         None, ctx.local_rank))
+        with st.epoch_lock:
+            if _epoch_buffer(st, world, ("acc", int(disp), src,
+                                         _op_spec(op))):
+                return
+            with st.lock:
+                st.dirty.add(world)
+            eng.send(world, ("acc", st.win_id, int(disp), src, _op_spec(op),
+                             None, ctx.local_rank))
     else:
-        _materialize_lock(st, world)    # fetching ops read: need real lock
+        with st.epoch_lock:             # fetching ops read: need real lock
+            _materialize_lock(st, world)
         reqid = eng.new_reqid()
         eng.send(world, ("acc", st.win_id, int(disp), src, _op_spec(op),
                          reqid, ctx.local_rank))
@@ -559,10 +559,11 @@ def _flush_targets(st: ProcWinState, worlds) -> None:
 
 def proc_flush(st: ProcWinState, target_rank: int) -> None:
     world = _target_world(st, target_rank)
-    if world in st.deferred:
-        # Win_flush inside a deferred epoch: the ops must complete at the
-        # target NOW — take the lock for real and flush the replayed ops
-        _materialize_lock(st, world)
+    with st.epoch_lock:
+        if world in st.deferred:
+            # Win_flush inside a deferred epoch: the ops must complete at
+            # the target NOW — take the real lock and flush the replay
+            _materialize_lock(st, world)
     with st.lock:
         pending = world in st.dirty
         st.dirty.discard(world)
@@ -601,6 +602,12 @@ def proc_lock(st: ProcWinState, target_rank: int, exclusive: bool) -> None:
     # Lazy lock (MPICH-style): defer the wire lock — a short write-only
     # epoch ships as one lock+ops+unlock frame at Win_unlock (1 round trip
     # instead of 2+). Reads, flushes and big epochs materialize it.
+    with st.epoch_lock:
+        _proc_lock_deferred(st, world, target_rank, exclusive)
+
+
+def _proc_lock_deferred(st: ProcWinState, world: int, target_rank: int,
+                        exclusive: bool) -> None:
     if world in st.deferred:
         # double lock on the same target from this origin: the eager
         # protocol self-deadlocked loudly here; keep the failure loud
@@ -620,19 +627,23 @@ def proc_unlock(st: ProcWinState, target_rank: int, exclusive: bool) -> None:
         st.lockmgr.release(ctx.local_rank, exclusive)
         return
     eng = _engine(ctx)
-    ep = st.deferred.pop(world, None)
-    if ep is not None:
-        # whole deferred epoch in one frame; the ack means lock acquired,
-        # every op applied, lock released
-        reqid = eng.new_reqid()
-        eng.send(world, ("lepoch", st.win_id, reqid, ctx.local_rank,
-                         ep["excl"], ep["ops"]))
-        eng.wait_resp(reqid, "Win_unlock")
-        with st.lock:
-            # the ack completed every earlier FIFO frame too — keep the
-            # fence-mode dirty bookkeeping consistent with the live path
-            st.dirty.discard(world)
-        return
+    with st.epoch_lock:
+        # pop AND ship under the epoch lock: a sibling thread's op racing
+        # this unlock must either land in the batch or observe the epoch
+        # gone — never send a live frame that could beat the batch's lock
+        ep = st.deferred.pop(world, None)
+        if ep is not None:
+            # whole deferred epoch in one frame; the ack means lock
+            # acquired, every op applied, lock released
+            reqid = eng.new_reqid()
+            eng.send(world, ("lepoch", st.win_id, reqid, ctx.local_rank,
+                             ep["excl"], ep["ops"]))
+            eng.wait_resp(reqid, "Win_unlock")
+            with st.lock:
+                # the ack completed every earlier FIFO frame too — keep
+                # fence-mode dirty bookkeeping consistent with live unlock
+                st.dirty.discard(world)
+            return
     reqid = eng.new_reqid()
     eng.send(world, ("unlock", st.win_id, reqid, ctx.local_rank, exclusive))
     eng.wait_resp(reqid, "Win_unlock")

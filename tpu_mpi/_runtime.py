@@ -142,6 +142,46 @@ def collective_wait_limit(opname: str) -> Optional[float]:
     return None
 
 
+def pump_wait(ctx, cond, pred: Callable[[], bool], what: str, *,
+              timeout: Optional[float] = None,
+              limit: Optional[float] = None) -> bool:
+    """Blocked-waiter loop driving the context's direct transport pump
+    (VERDICT r3 #4). The single implementation behind Mailbox receives,
+    ProcChannel collective waits and RmaEngine response waits: cond's lock
+    must be held exactly once on entry; the loop releases it around each
+    pump so deliveries (which take the same lock) can land. Returns pred()
+    — False only in ``timeout`` mode; raises DeadlockError past the budget
+    otherwise; ``limit`` overrides the budget but keeps raising semantics."""
+    if timeout is not None:
+        budget = timeout
+    elif limit is not None:
+        budget = limit
+    else:
+        budget = deadlock_timeout()
+    deadline = time.monotonic() + budget
+    ctx._pump_begin()
+    try:
+        while not pred():
+            ctx.check_failure()
+            if time.monotonic() >= deadline:
+                if timeout is not None:
+                    return False
+                raise DeadlockError(
+                    f"deadlock suspected: blocked >{budget}s in {what}")
+            cond.release()
+            try:
+                pumped = ctx._direct_pump(0.02, pred)
+            finally:
+                cond.acquire()
+            if not pumped:
+                # pump busy (a sibling holds the lease) or idle socket:
+                # brief cond wait keeps us responsive to wakeups
+                cond.wait(0.002)
+    finally:
+        ctx._pump_end()
+    return True
+
+
 class Message:
     """An in-flight point-to-point message (typed buffer or serialized object)."""
 
@@ -331,39 +371,10 @@ class Mailbox(_Waitable):
         a short condition wait whenever the pump is busy (the drainer or a
         sibling thread holds it), so THREAD_MULTIPLE receivers and the
         drainer interleave safely."""
-        pump = self.direct_pump
-        if pump is None:
+        if self.direct_pump is None:
             self._wait_for(pred, what)
             return
-        limit = deadlock_timeout()
-        deadline = time.monotonic() + limit
-        if self.pump_begin is not None:
-            self.pump_begin()           # parks the drainer for the duration
-        try:
-            while not pred():
-                self.ctx.check_failure()
-                if time.monotonic() >= deadline:
-                    raise DeadlockError(
-                        f"deadlock suspected: blocked >{limit}s in {what}")
-                # The pump takes the mailbox lock to deliver; release it
-                # while polling (wait_recv/probe hold it exactly once).
-                # ``pred`` is passed through as the pump's done-check: if
-                # another thread delivered our message while we waited for
-                # the lease, the pump returns before sitting out an idle
-                # poll (pred reads monotonic booleans set under this lock —
-                # a stale False only costs one extra loop).
-                self.lock.release()
-                try:
-                    pumped = pump(0.02, pred)
-                finally:
-                    self.lock.acquire()
-                if not pumped:
-                    # pump busy (a sibling holds the lease) or idle socket:
-                    # brief cond wait keeps us responsive to wakeups
-                    self.cond.wait(0.002)
-        finally:
-            if self.pump_end is not None:
-                self.pump_end()
+        pump_wait(self.ctx, self.cond, pred, what)
 
     def wait_recv(self, pr: PendingRecv) -> Optional[Message]:
         """Block until pr completes (Wait!); returns None if cancelled."""

@@ -229,20 +229,22 @@ def _send_typed(buf: Any, dest: int, tag: int, comm: Comm, block: bool) -> None:
         _post(comm, dest, tag, buf, count, to_datatype(buf.dtype), "typed",
               block=block)
         return
-    if block:
-        ctx, _ = require_env()
-        mb = ctx.mailboxes[_resolve(comm, dest)]
-        if not isinstance(mb, Mailbox):
-            # Remote blocking send: the frame is fully on the wire before
-            # this call returns, so no defensive snapshot is needed — pass
-            # the user's array straight to the codec (it serializes or
-            # writev's from the original memory). Isend and same-process
-            # destinations still snapshot: their payload outlives the call.
-            arr = extract_array(buf)
-            if isinstance(arr, np.ndarray):
-                _post(comm, dest, tag, arr, count, to_datatype(arr.dtype),
-                      "typed", block=True)
-                return
+    ctx, _ = require_env()
+    mb = ctx.mailboxes[_resolve(comm, dest)]
+    if not isinstance(mb, Mailbox):
+        # Remote destination: the frame is FULLY off this buffer before the
+        # call returns — tm_send/writev blocks until written, the shm lane
+        # copies into its segment, the pickle lane serializes — for both
+        # blocking Send and buffered Isend. The defensive to_wire snapshot
+        # would be a second copy of every large payload (it halved the
+        # shm-lane bandwidth); pass the user's array straight to the codec.
+        # Same-process destinations still snapshot: there the payload
+        # object itself outlives the call inside the peer's mailbox.
+        arr = extract_array(buf)
+        if isinstance(arr, np.ndarray):
+            _post(comm, dest, tag, arr, count, to_datatype(arr.dtype),
+                  "typed", block=block)
+            return
     arr = to_wire(buf, count)
     _post(comm, dest, tag, arr, count, to_datatype(arr.dtype), "typed",
           block=block)
