@@ -510,6 +510,34 @@ def test_intercomm_collectives_across_processes():
         assert f"INTER-OK-{r}" in res.stdout
 
 
+def test_sharded_checkpoint_across_processes():
+    """checkpoint.save_sharded/load_sharded across OS processes: one
+    coherent file from independent per-process writes."""
+    res = _run_procs("""
+        import os, tempfile
+        import numpy as np
+        import tpu_mpi as MPI
+        from tpu_mpi import checkpoint
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        path = os.path.join(tempfile.gettempdir(), "tpu_mpi_ckpt_procs.bin")
+        tree = {"w": np.full((8,), float(rank)), "s": np.array([rank * 10])}
+        checkpoint.save_sharded(path, tree, comm)
+        got = checkpoint.load_sharded(path, comm)
+        assert np.array_equal(got["w"], tree["w"]), got
+        assert got["s"][0] == rank * 10
+        MPI.Barrier(comm)
+        if rank == 0:
+            os.remove(path)
+        print(f"CKPT-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """, nprocs=2)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"CKPT-OK-{r}" in res.stdout
+
+
 def test_isend_buffer_reuse_across_processes():
     """Isend to a remote rank is buffered: the caller may overwrite the
     send buffer immediately after Isend returns (MPI buffered-send

@@ -70,6 +70,8 @@ from .pointtopoint import (Cancel, Get_count, Get_error, Get_source, Get_tag,
 # Parallel I/O (src/io.jl) — usage: MPI.File.open / read_at / write_at_all …
 from . import io as File
 from .io import FileHandle
+# Sharded checkpoint/resume on top of the File layer (SURVEY.md §5)
+from . import checkpoint
 
 # One-sided RMA (src/onesided.jl)
 from .onesided import (Accumulate, Fetch_and_op, Get, Get_accumulate,
