@@ -217,3 +217,44 @@ def test_sharded_checkpoint_size_mismatch(nprocs):
 
     run_spmd(load_body, 1)
     os.remove(path)
+
+
+def test_sharded_checkpoint_edge_dtypes(nprocs):
+    """Review findings r4: '/'-bearing dict keys must not collide with
+    nested structure; structured dtypes keep their fields; object dtypes
+    refuse BEFORE any collective."""
+    import os
+    import tempfile
+    import pytest
+    from tpu_mpi import checkpoint
+    from tpu_mpi import error as ec
+
+    path = os.path.join(tempfile.gettempdir(),
+                        f"tpu_mpi_ckpt_edge_{os.getpid()}.bin")
+
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        structured = np.zeros(3, dtype=[("lr", "<f4"), ("step", "<i4")])
+        structured["lr"] = rank + 0.5
+        tree = {
+            "a": {"b": np.ones(4) * rank},
+            "a/b": np.zeros(4),              # must NOT collide with a.b
+            "opt": structured,
+        }
+        checkpoint.save_sharded(path, tree, comm)
+        got = checkpoint.load_sharded(path, comm)
+        assert np.array_equal(got["a"]["b"], np.ones(4) * rank)
+        assert np.array_equal(got["a/b"], np.zeros(4))
+        assert got["opt"].dtype.names == ("lr", "step")
+        assert np.allclose(got["opt"]["lr"], rank + 0.5)
+        # object dtype fails loudly at the origin, before any collective
+        with pytest.raises(MPI.MPIError) as ei:
+            checkpoint.save_sharded(path + ".x",
+                                    {"bad": np.array([1, "a"], object)}, comm)
+        assert ei.value.code == ec.ERR_ARG
+        MPI.Barrier(comm)
+        if rank == 0:
+            os.remove(path)
+
+    run_spmd(body, nprocs)

@@ -513,6 +513,7 @@ def test_intercomm_collectives_across_processes():
 def test_sharded_checkpoint_across_processes():
     """checkpoint.save_sharded/load_sharded across OS processes: one
     coherent file from independent per-process writes."""
+    import os as _os
     res = _run_procs("""
         import os, tempfile
         import numpy as np
@@ -521,7 +522,8 @@ def test_sharded_checkpoint_across_processes():
         MPI.Init()
         comm = MPI.COMM_WORLD
         rank = MPI.Comm_rank(comm)
-        path = os.path.join(tempfile.gettempdir(), "tpu_mpi_ckpt_procs.bin")
+        path = os.path.join(tempfile.gettempdir(),
+                            "tpu_mpi_ckpt_procs_%d.bin")
         tree = {"w": np.full((8,), float(rank)), "s": np.array([rank * 10])}
         checkpoint.save_sharded(path, tree, comm)
         got = checkpoint.load_sharded(path, comm)
@@ -532,7 +534,7 @@ def test_sharded_checkpoint_across_processes():
             os.remove(path)
         print(f"CKPT-OK-{rank}", flush=True)
         MPI.Finalize()
-    """, nprocs=2)
+    """ % _os.getpid(), nprocs=2)
     assert res.returncode == 0, res.stderr + res.stdout
     for r in range(2):
         assert f"CKPT-OK-{r}" in res.stdout

@@ -41,12 +41,18 @@ from .error import MPIError
 _MAGIC = 0x7D5AC4B7_00000001
 
 
+def _esc(key: str) -> str:
+    """Escape the path separator in dict keys: a key containing '/' must
+    not collide with nested structure ("a/b" vs {"a": {"b": ...}})."""
+    return str(key).replace("\\", "\\\\").replace("/", "\\/")
+
+
 def _flatten(tree: Any, prefix: str = "") -> list[tuple[str, np.ndarray]]:
     """Deterministic (key, array) leaves of a nested dict/list/tuple tree."""
     out: list[tuple[str, np.ndarray]] = []
     if isinstance(tree, dict):
         for k in sorted(tree):
-            out.extend(_flatten(tree[k], f"{prefix}{k}/"))
+            out.extend(_flatten(tree[k], f"{prefix}{_esc(k)}/"))
         return out
     if isinstance(tree, (list, tuple)):
         for i, v in enumerate(tree):
@@ -56,12 +62,19 @@ def _flatten(tree: Any, prefix: str = "") -> list[tuple[str, np.ndarray]]:
     if arr is None:
         raise MPIError(f"checkpoint leaf {prefix[:-1]!r} is not an array "
                        f"({type(tree).__name__})", code=_ec.ERR_ARG)
-    return [(prefix[:-1], np.asarray(arr))]
+    arr = np.asarray(arr)
+    if arr.dtype.hasobject:
+        # must fail HERE, before any collective: a raw ValueError later in
+        # the write loop would strand the other ranks mid-rendezvous
+        raise MPIError(f"checkpoint leaf {prefix[:-1]!r} has object dtype "
+                       f"{arr.dtype} (not storable as raw bytes)",
+                       code=_ec.ERR_ARG)
+    return [(prefix[:-1], arr)]
 
 
 def _unflatten(spec: Any, leaves: dict[str, np.ndarray], prefix: str = ""):
     if isinstance(spec, dict):
-        return {k: _unflatten(v, leaves, f"{prefix}{k}/")
+        return {k: _unflatten(v, leaves, f"{prefix}{_esc(k)}/")
                 for k, v in spec.items()}
     if isinstance(spec, (list, tuple)):
         seq = [_unflatten(v, leaves, f"{prefix}{i}/")
@@ -85,7 +98,9 @@ def save_sharded(path: str, tree: Any, comm: Comm) -> None:
     rank, size = comm.rank(), comm.size()
     leaves = _flatten(tree)
     my_meta = (_tree_spec(tree),
-               [(k, a.dtype.str, a.shape, int(a.nbytes)) for k, a in leaves])
+               # structured dtypes keep their field layout via descr
+               [(k, a.dtype.str if a.dtype.names is None else a.dtype.descr,
+                 a.shape, int(a.nbytes)) for k, a in leaves])
     # allgather of python meta objects (dynamic sizes) via the rendezvous
     from .collective import _run
     all_metas = _run(comm, my_meta, lambda cs: [list(cs)] * len(cs),
