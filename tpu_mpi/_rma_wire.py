@@ -43,7 +43,8 @@ from .error import DeadlockError, MPIError
 from . import operators as _ops
 
 # Predefined ops travel by name (pickling an Op loses singleton identity);
-# custom ops travel pickled and must therefore be module-level functions.
+# custom ops travel through the extended wire codec (tpu_mpi.serialization
+# via backend.send_frame), so closures/lambdas work cross-process too.
 _PREDEFINED: dict[str, _ops.Op] = {
     v.name: v for v in vars(_ops).values() if isinstance(v, _ops.Op)
 }
@@ -218,8 +219,7 @@ class RmaEngine:
             raise
         except (pickle.PicklingError, AttributeError, TypeError) as e:
             raise MPIError(
-                "RMA payload is not picklable (custom reduction ops must be "
-                f"module-level functions in multi-process mode): {e}") from None
+                f"RMA payload is not serializable: {e}") from None
         except Exception as e:
             # transport failure (peer died mid-epoch): fate-share like the
             # collective send path so siblings abort instead of timing out

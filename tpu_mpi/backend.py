@@ -39,6 +39,7 @@ from typing import Any, Callable, Optional, Sequence
 import numpy as np
 
 from . import config
+from . import serialization
 from .buffers import is_wire_snapshot
 from ._runtime import (ANY_SOURCE, Mailbox, Message, SpmdContext, _Waitable,
                        collective_wait_limit, deadlock_timeout, set_env,
@@ -168,7 +169,9 @@ def dumps_oob_parts(item: Any, shm_ok: bool = False) -> list:
     own memory straight to the socket — no join copy. With ``shm_ok`` (the
     destination shares this host), large buffers take the shm lane instead."""
     bufs: list[pickle.PickleBuffer] = []
-    skel = pickle.dumps(item, protocol=5, buffer_callback=bufs.append)
+    # extended pickler: closures/local classes inside frames (spawn
+    # commands, custom ops, object payloads) travel by value cross-process
+    skel = serialization.dumps_oob(item, buffer_callback=bufs.append)
     parts = [_OOB_MAGIC + struct.pack("<IQ", len(bufs), len(skel)), skel]
     shm_min = _shm_min_bytes() if shm_ok else 0
     for pb in bufs:
@@ -1323,7 +1326,7 @@ class ProcContext(SpmdContext):
         inter_cid = self.alloc_cid()
         world_cid = self.alloc_cid()
         if callable(command):
-            command_wire: Any = pickle.dumps(command)
+            command_wire: Any = serialization.dumps(command)
         else:
             command_wire = str(command)
         spec = {

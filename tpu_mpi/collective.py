@@ -28,6 +28,8 @@ from __future__ import annotations
 import functools
 import pickle
 import threading
+
+from . import serialization as _serialization
 from collections import OrderedDict
 from typing import Any, Optional, Sequence
 
@@ -261,7 +263,7 @@ def _inter_bcast_obj(obj: Any, root: Any, comm: Intercomm) -> Any:
     opname = f"interbcast@{comm.cid}"
     if root == ROOT:
         try:
-            payload = ("pickle", pickle.dumps(obj))
+            payload = ("pickle", _serialization.dumps(obj))
         except Exception:
             payload = ("ref", obj)
         _inter_rooted(comm, root, payload, opname)
@@ -321,14 +323,17 @@ def bcast(obj: Any, root: int, comm: Comm) -> Any:
     """Broadcast an arbitrary serialized object (src/collective.jl:44-60).
 
     The reference's two-phase length+payload dance collapses: the rendezvous
-    carries dynamic sizes natively. Pickle round-trips give each rank its own
-    copy; unpicklable objects (closures) are shared by reference in-process."""
+    carries dynamic sizes natively. Serialization round-trips give each rank
+    its own copy; closures/lambdas/local classes travel by value on every
+    tier via :mod:`tpu_mpi.serialization` (ref broadcasts a *function*,
+    test/test_bcast.jl:38-55). Truly unserializable objects (sockets,
+    locks) fall back to by-reference sharing, thread tier only."""
     if isinstance(comm, Intercomm):
         return _inter_bcast_obj(obj, root, comm)
     rank = comm.rank()
     if rank == root:
         try:
-            payload = ("pickle", pickle.dumps(obj))
+            payload = ("pickle", _serialization.dumps(obj))
         except Exception:
             payload = ("ref", obj)
     else:

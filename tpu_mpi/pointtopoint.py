@@ -28,6 +28,8 @@ import time
 import numpy as np
 from typing import Any, Optional, Sequence
 
+from . import serialization as _serialization
+
 from ._runtime import (ANY_SOURCE, ANY_TAG, PROC_NULL, Mailbox, Message,
                        PendingRecv, require_env)
 from .buffers import (element_count, extract_array, is_wire_snapshot,
@@ -281,11 +283,14 @@ def _send_obj(obj: Any, dest: int, tag: int, comm: Comm, block: bool) -> None:
     if dest == PROC_NULL:
         return
     try:
-        data = pickle.dumps(obj)
+        # closures/lambdas/local classes travel by value on every tier
+        # (tpu_mpi.serialization; ref ships closures between processes,
+        # src/MPI.jl:9-18)
+        data = _serialization.dumps(obj)
     except Exception:
-        # In-process transport: unpicklable objects travel by reference
-        # (the multi-process mailbox proxy rejects this kind with a clear
-        # error — no shared address space there).
+        # In-process transport: truly unserializable objects (sockets,
+        # locks) travel by reference (the multi-process mailbox proxy
+        # rejects this kind with a clear error — no shared address space).
         _post(comm, dest, tag, obj, 0, None, "objref", block=block)
         return
     _post(comm, dest, tag, data, len(data), None, "object", block=block)
