@@ -7,22 +7,26 @@ Adaptive to the hardware the driver gives us:
   jit/shard_map over the full mesh; reports ring bus bandwidth
   (2*(n-1)/n * bytes / t) as a fraction of 90% of the generation's aggregate
   ICI bandwidth (the BASELINE.json target).
-- 1 device (the tunnel setup): the ICI sweep is not measurable, so the
-  framework's host-path Allreduce runs 4 rank-threads against the real chip
-  and reports algorithm bandwidth against the HBM **roofline for the path's
-  actual traffic**: the fused fold reads nranks operands and writes one
-  result, so each op moves (nranks+1)*payload through HBM and the best
-  achievable algbw is HBM_bw/(nranks+1).
+- 1 device (the tunnel setup): two lanes + a same-session control block
+  (VERDICT r4 next #1/#7):
 
-  Measurement protocol (VERDICT r2 weak #1 — the round-2 number measured
-  async dispatch and exceeded HBM peak): iterations are chained
-  **data-dependently** — rank 0 feeds the combined result back as its next
-  contribution, so op k+1 cannot start before op k's output exists — and
-  each timed block ends with a one-element host readback, the only true
-  completion barrier through the device tunnel (``block_until_ready``
-  returns before execution completes there; verified empirically). The
-  chain grows linearly (out_{k+1} = out_k + (nranks-1)), so no overflow
-  and the readback doubles as a correctness check.
+  * **in-graph lane (headline)** — K data-dependently chained Allreduce
+    folds inside ONE jit (dynamic trip count), per-fold seconds from the
+    adaptive slope (t(2K)-t(K))/K with K grown until calls are
+    execution-dominated. Weather-immune: tunnel RTT cancels in the slope.
+    This is where a TPU framework's collectives actually live. algbw =
+    payload/t_fold vs the HBM roofline HBM/(nranks+1) (the fold reads
+    nranks operands + writes one).
+  * **host lane** — the deployment path: ``MPI.Allreduce`` over 4
+    rank-threads against the real chip, data-dependently chained with an
+    asserted readback per timed block; reported with a decomposition
+    against the in-graph fold (fold_exec_ms / overhead_ms /
+    vs_ingraph_fold = host op time over pure fold execution — the
+    overhead term bundles per-op Python dispatch AND irreducible tunnel
+    pipelining, which the chained protocol partially overlaps, so it is
+    an upper bound on the MPI layer's own cost).
+  * **control block** — null RTT, measured HBM GB/s, GEMM slope TFLOP/s,
+    captured in the same session so the artifact carries its own weather.
 - CPU fallback (no TPU visible): same host-path measurement, vs_baseline
   computed against the TPU roofline anyway (informational only).
 """
@@ -53,13 +57,9 @@ def _caps():
 
 
 def _gen_of(device) -> str:
-    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
-    if "v5lite" in kind:
-        return "v5e"
-    for key in sorted(_caps(), key=len, reverse=True):
-        if key in kind:
-            return key
-    return "v5e"
+    sys.path.insert(0, os.path.join(_REPO_DIR, "benchmarks"))
+    from common import gen_of   # canonical generation detection
+    return gen_of(device)
 
 
 def _bench_in_graph(jax, devices, n_elems: int = N_ELEMS) -> dict:
@@ -97,69 +97,6 @@ def _bench_in_graph(jax, devices, n_elems: int = N_ELEMS) -> dict:
     }
 
 
-def _control_rows(n_elems: int, nranks: int) -> "dict | None":
-    """Tunnel-floor control (VERDICT r3 next #1): per-op time of (a) a single
-    jitted elementwise op over the same payload, chained (the irreducible
-    per-dispatch floor at this operand size), and (b) the Allreduce fold
-    executed K-deep inside ONE jit (the measured execution roofline for
-    (nranks reads + 1 write) of HBM traffic, amortizing the tunnel away).
-    model_s = (a - b_exec_component) + fold_per_step: what a perfectly
-    overhead-free MPI layer could achieve per op through this tunnel.
-    Full breakdown: benchmarks/overhead_probe.py + BASELINE.md."""
-    try:
-        import jax
-        import jax.numpy as jnp
-        from common import time_chain
-        k = 8
-
-        def chain(f, x0, expect, iters, reps):
-            box = [x0]
-
-            def step():
-                box[0] = f(box[0])
-
-            def force(calls):
-                got, want = float(box[0].reshape(-1)[0]), expect(calls)
-                assert got == want, (got, want)
-
-            return time_chain(step, force, 2, iters, reps)
-
-        t_ew = chain(jax.jit(lambda x: x + 1.0),
-                     jnp.zeros(n_elems, jnp.float32),
-                     lambda c: float(c), iters=10, reps=3)
-        ones = [jnp.ones(n_elems, jnp.float32) for _ in range(nranks - 1)]
-
-        @jax.jit
-        def fused_fold(x):
-            def body(i, a):
-                acc = a
-                for o in ones:
-                    acc = acc + o
-                return acc
-            return jax.lax.fori_loop(0, k, body, x)
-
-        t_fold_step = chain(fused_fold, jnp.ones(n_elems, jnp.float32),
-                            lambda c: float(1 + (nranks - 1) * k * c),
-                            iters=3, reps=3) / k
-        # the elementwise control moves 2x payload; subtract its execution
-        # share (at the measured fold rate, scaled 2/(nranks+1)) to isolate
-        # the dispatch floor, then add one full fold execution.
-        floor = t_ew - t_fold_step * 2 / (nranks + 1)
-        model = floor + t_fold_step
-        return {
-            "elementwise_ms": round(t_ew * 1e3, 3),
-            "fused_fold_step_ms": round(t_fold_step * 1e3, 3),
-            "measured_hbm_gbps": round((nranks + 1) * n_elems * 4
-                                       / t_fold_step / 1e9, 1),
-            "dispatch_floor_ms": round(floor * 1e3, 3),
-            "model_ms": round(model * 1e3, 3),
-        }
-    except Exception as e:
-        print(f"bench: control row failed ({type(e).__name__}: {e})",
-              file=sys.stderr)
-        return None
-
-
 def _bench_host_path(device_kind: str, use_device: bool,
                      n_elems: int = N_ELEMS) -> dict:
     # the chained-execution protocol + aggregation live in benchmarks/common
@@ -185,7 +122,7 @@ def _bench_host_path(device_kind: str, use_device: bool,
     roofline = hbm / (nranks + 1)
     where = f"1x {gen} chip" if use_device else "cpu"
     log2 = n_elems.bit_length() - 1
-    out = {
+    return {
         "metric": f"Allreduce Float32[2^{log2}] algorithm bandwidth, host path, "
                   f"{nranks} ranks, {where} (vs HBM roofline "
                   f"{roofline:.0f} GB/s = {hbm:.0f}/{nranks + 1})",
@@ -193,17 +130,49 @@ def _bench_host_path(device_kind: str, use_device: bool,
         "unit": "GB/s",
         "vs_baseline": round(algbw / roofline, 4),
     }
-    if use_device:
-        control = _control_rows(n_elems, nranks)
-        if control is not None:
-            # vs_model: measured per-op time against the tunnel-floor +
-            # measured-execution model — <=1.1 means the MPI layer adds <=10%
-            # over what any single-dispatch-per-op implementation could do
-            # through this tunnel (VERDICT r3 #1 "Done" branch 2).
-            out["control"] = dict(control,
-                                  mpi_op_ms=round(dt * 1e3, 3),
-                                  vs_model=round(dt * 1e3 / control["model_ms"], 4))
-    return out
+
+
+def _bench_single_chip(gen: str, n_elems: int = N_ELEMS) -> dict:
+    """Single-real-chip headline (VERDICT r4 next #1): the in-graph lane —
+    K data-dependently chained Allreduce folds inside ONE jit, adaptive
+    slope timing — is the co-headline with the host path, because inside
+    jit is where a TPU framework's collectives actually live and the slope
+    is immune to tunnel weather. Both lanes + the same-session control
+    block ship in one record (VERDICT r4 next #7)."""
+    sys.path.insert(0, os.path.join(_REPO_DIR, "benchmarks"))
+    from common import (control_block, ingraph_collective_slope,
+                        measure_null_rtt)
+
+    nranks = 4
+    caps = _caps()
+    hbm_spec = caps.get(gen, {}).get("hbm_gbps", 819.0)
+    roofline = hbm_spec / (nranks + 1)
+
+    rtt = measure_null_rtt()
+    ig = ingraph_collective_slope("allreduce", n_elems, nranks, rtt=rtt)
+    control = control_block()
+    host = _bench_host_path(gen, use_device=True, n_elems=n_elems)
+    # host-lane decomposition: each host op executes the same fold the
+    # in-graph lane measured, plus per-op Python/MPI machinery and async
+    # tunnel dispatch; the difference IS that overhead, stated plainly.
+    host_ms = n_elems * 4 / (host["value"] * 1e9) * 1e3
+    fold_ms = ig["per_fold_us"] / 1e3
+    log2 = n_elems.bit_length() - 1
+    return {
+        "metric": f"Allreduce Float32[2^{log2}] algorithm bandwidth, "
+                  f"in-graph lane (K-chained jitted fold, adaptive slope), "
+                  f"{nranks} ranks, 1x {gen} (vs HBM roofline "
+                  f"{roofline:.0f} GB/s = {hbm_spec:.0f}/{nranks + 1})",
+        "value": ig["algbw_gbps"],
+        "unit": "GB/s",
+        "vs_baseline": round(ig["algbw_gbps"] / roofline, 4),
+        "ingraph": ig,
+        "control": control,
+        "host_lane": dict(host, lat_ms=round(host_ms, 3),
+                          fold_exec_ms=round(fold_ms, 3),
+                          overhead_ms=round(host_ms - fold_ms, 3),
+                          vs_ingraph_fold=round(host_ms / fold_ms, 3)),
+    }
 
 
 def _devices_with_watchdog(timeout_s: float = 240.0):
@@ -254,7 +223,7 @@ def main() -> None:
         if len(accel) >= 2:
             result = _bench_in_graph(jax, accel)
         elif len(accel) == 1:
-            result = _bench_host_path(_gen_of(accel[0]), use_device=True)
+            result = _bench_single_chip(_gen_of(accel[0]))
         elif len(devices) >= 2:
             # CPU-sim: keep the payload small enough to finish in seconds
             result = _bench_in_graph(jax, devices, n_elems=1 << 22)

@@ -6,6 +6,11 @@ hardware allows:
 - ``host``   — the framework's host-path ``MPI.Allreduce`` over rank threads
   (jitted fold + zero-copy DeviceBuffer rebind); runs everywhere, measures
   the deployment path a single-host user hits.
+- ``ingraph`` — the weather-immune lane (VERDICT r4 next #1): K-chained
+  in-jit Allreduce folds (+ reducescatter/allgather variants at three
+  sizes), adaptive-slope timed so tunnel RTT cancels; the lane that answers
+  the north-star question of what the collectives cost where they actually
+  run (inside compiled XLA code).
 - ``psum``   — in-graph ``lax.psum`` via ``tpu_mpi.xla.allreduce`` inside
   jit/shard_map (needs >= 2 XLA devices); the ICI lane. Reports ring bus
   bandwidth 2(n-1)/n * bytes / t.
@@ -93,6 +98,39 @@ def _bench_in_graph(sizes: list[int], fn_of_mesh, max_iters: int = 10 ** 9,
         print(f"graph {per_rank:>11d} B  {dt * 1e6:>10.1f} us  "
               f"{busbw:>8.3f} GB/s bus", file=sys.stderr)
     return rows
+
+
+def bench_ingraph(nranks: int, sizes: list[int],
+                  variants: tuple = ("allreduce",)) -> dict:
+    """The weather-immune lane (VERDICT r4 next #1): K-chained in-jit
+    collective folds, adaptive slope timing, closed-form readback asserted.
+    Runs on the real chip; see common.ingraph_collective_slope."""
+    from common import ingraph_collective_slope, measure_null_rtt
+
+    rtt = measure_null_rtt()
+    out: dict = {}
+    for variant in variants:
+        rows = []
+        for nbytes in sizes:
+            n = max(1, nbytes // 4)
+            try:
+                r = ingraph_collective_slope(variant, n, nranks, rtt=rtt)
+            except Exception as e:
+                print(f"ingraph {variant} {nbytes}B skipped: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+                continue
+            rows.append({"bytes": r["bytes"],
+                         "per_fold_us": r["per_fold_us"],
+                         "algbw_gbps": r["algbw_gbps"],
+                         "hbm_gbps_implied": r["hbm_gbps_implied"],
+                         "k": r["k"], "slope_spread": r["slope_spread"]})
+            print(f"ingraph:{variant} {r['bytes']:>11d} B  "
+                  f"{r['per_fold_us']:>10.1f} us/fold  "
+                  f"{r['algbw_gbps']:>8.3f} GB/s  "
+                  f"(HBM {r['hbm_gbps_implied']} GB/s, k={r['k']}, "
+                  f"spread {r['slope_spread']})", file=sys.stderr)
+        out[variant] = rows
+    return out
 
 
 def bench_psum(sizes: list[int]) -> list[dict]:
@@ -190,7 +228,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-bytes", type=int, default=1 << 30)
     ap.add_argument("--ranks", type=int, default=4)
-    ap.add_argument("--lanes", default="host,psum,pallas")
+    ap.add_argument("--lanes", default="host,ingraph,psum,pallas")
     ap.add_argument("--rows-out", default=None, help=argparse.SUPPRESS)
     ap.add_argument("-o", "--out", default="-")
     args = ap.parse_args()
@@ -208,6 +246,20 @@ def main() -> None:
     if "host" in lanes:
         use_device = plat["platform"] != "cpu"
         record["lanes"]["host"] = bench_host(args.ranks, sizes, use_device)
+    if "ingraph" in lanes:
+        # sampled sizes: the adaptive slope spends ~0.5-2 s per (size,
+        # variant); every 2nd size + the endpoints covers the curve
+        sub = sizes[::2] + ([sizes[-1]] if (len(sizes) - 1) % 2 else [])
+        ig = bench_ingraph(args.ranks, sub)
+        record["lanes"]["ingraph"] = ig.pop("allreduce", [])
+        for variant, rows in ig.items():
+            record["lanes"][f"ingraph_{variant}"] = rows
+        # rs/ag variants at three representative sizes
+        big = [s for s in sizes if s in (1 << 16, 1 << 22, 1 << 26)]
+        extra = bench_ingraph(args.ranks, big,
+                              variants=("reducescatter", "allgather"))
+        for variant, rows in extra.items():
+            record["lanes"][f"ingraph_{variant}"] = rows
     if "psum" in lanes and multi:
         record["lanes"]["psum"] = bench_psum(sizes)
     if "pallas" in lanes and multi:

@@ -48,15 +48,7 @@ ATTN_K_LO, ATTN_K_HI = 128, 1536
 REPEATS = 6
 
 
-def _gen_of(device) -> str:
-    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
-    if "v5lite" in kind:
-        return "v5e"
-    from tpu_mpi.implementations import CAPABILITIES
-    for key in sorted(CAPABILITIES, key=len, reverse=True):
-        if key in kind:
-            return key
-    return "v5e"
+from common import gen_of as _gen_of    # canonical generation detection
 
 
 def _best_call(f, x, sanity, repeats=REPEATS):

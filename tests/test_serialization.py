@@ -13,6 +13,7 @@ import pickle
 import numpy as np
 import pytest
 
+import tpu_mpi.testing          # noqa: F401 - the nprocs fixture needs it
 from tpu_mpi import serialization as S
 
 MODULE_CONST = 17

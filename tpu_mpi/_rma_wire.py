@@ -472,7 +472,12 @@ def _epoch_buffer(st: ProcWinState, world: int, op: tuple) -> bool:
             or nbytes + getattr(op[2], "nbytes", 0) > _EPOCH_MAX_BYTES):
         _materialize_lock(st, world)
         return False
-    ep["ops"].append(op)
+    # copy the payload: _origin_flat returns a VIEW for contiguous origins,
+    # and a deferred op ships at Win_unlock — without the copy, mutating
+    # the origin between Put/Accumulate and unlock would silently ship the
+    # mutated data (the eager path snapshots at call time; both paths must
+    # observe the same values)
+    ep["ops"].append(op[:2] + (np.array(op[2], copy=True),) + op[3:])
     return True
 
 

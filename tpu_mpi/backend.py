@@ -978,6 +978,7 @@ class ProcContext(SpmdContext):
         # cross-process flow control: peers that told us to stop blocking-
         # sending to them (choke/unchoke frames), and the peers WE choked
         self.choked_by: set[int] = set()
+        self.choke_count = 0               # monotonic; see _dispatch "choke"
         self._choke_cond = threading.Condition()
         self._choked_peers: set[int] = set()
         self._choke_high = config.load().send_highwater_bytes
@@ -1224,6 +1225,11 @@ class ProcContext(SpmdContext):
         elif kind == "choke":
             with self._choke_cond:
                 self.choked_by.add(src_world)
+                # sticky observability: choked_by empties the instant the
+                # receiver unchokes (e.g. it posted a recv), so transient
+                # membership is unobservable to a poller — tests and
+                # diagnostics read this monotonic counter instead
+                self.choke_count += 1
         elif kind == "unchoke":
             with self._choke_cond:
                 self.choked_by.discard(src_world)

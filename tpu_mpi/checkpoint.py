@@ -143,7 +143,12 @@ def save_sharded(path: str, tree: Any, comm: Comm) -> None:
 
 
 def load_sharded(path: str, comm: Comm) -> Any:
-    """Collectively restore this rank's tree from a save_sharded file."""
+    """Collectively restore this rank's tree from a save_sharded file.
+
+    Trust model: the header is a pickle — loading executes code, exactly
+    like ``np.load(allow_pickle=True)`` or a torch checkpoint. Only load
+    checkpoints your own job (or another trusted writer) produced.
+    """
     rank, size = comm.rank(), comm.size()
     fh = File.open(comm, path, read=True)
     head = np.zeros(16, np.uint8)
@@ -154,6 +159,15 @@ def load_sharded(path: str, comm: Comm) -> Any:
         raise MPIError(f"{path!r} is not a tpu_mpi sharded checkpoint",
                        code=_ec.ERR_FILE)
     hdr_cap = int.from_bytes(head[8:].tobytes(), "little")
+    # bound the header-capacity field by the actual file size before
+    # allocating: a truncated/corrupt file with valid magic must fail
+    # cleanly, not trigger an arbitrary-size allocation
+    fsize = File.get_size(fh)
+    if hdr_cap <= 0 or 16 + hdr_cap > fsize:
+        File.close(fh)
+        raise MPIError(
+            f"corrupt checkpoint header: capacity {hdr_cap} exceeds file "
+            f"size {fsize}", code=_ec.ERR_FILE)
     raw = np.zeros(hdr_cap, np.uint8)
     File.read_at(fh, 16, raw)
     header = pickle.loads(raw.tobytes())

@@ -139,7 +139,8 @@ def Abort(comm=None, errorcode: "int | None" = None) -> None:
     if env is None:
         raise SystemExit(1 if errorcode is None else errorcode)
     ctx, rank = env
-    err = AbortError(f"MPI.Abort called on rank {rank} with errorcode {errorcode}")
+    suffix = "" if errorcode is None else f" with errorcode {errorcode}"
+    err = AbortError(f"MPI.Abort called on rank {rank}{suffix}")
     if errorcode is not None:
         err.code = errorcode
     ctx.fail(err, rank)

@@ -717,8 +717,17 @@ class PartitionedRequest:
             raise MPIError(f"partition {i} already marked ready",
                            code=_ec.ERR_REQUEST)
         arr = extract_array(self.buffer)
-        flat = np.ascontiguousarray(arr).reshape(-1)
-        part = np.array(flat[i * self.plen:(i + 1) * self.plen], copy=True)
+        a, b = i * self.plen, (i + 1) * self.plen
+        # snapshot ONLY partition i (partition data is read at Pready time,
+        # not Start — the buffer may be filled partition-by-partition); a
+        # whole-buffer ascontiguousarray here would copy N elements per
+        # Pready and defeat the overlap purpose of partitioned sends
+        if isinstance(arr, np.ndarray):
+            part = (np.array(arr.reshape(-1)[a:b], copy=True)
+                    if arr.flags.c_contiguous else np.asarray(arr.flat[a:b]))
+        else:
+            # device array: slice on device, transfer only the partition
+            part = np.asarray(arr.reshape(-1)[a:b])
         _post(self.comm, self.peer, self.tag, (i, part), self.plen, None,
               "object", block=False)
         self._ready.add(i)
