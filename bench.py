@@ -150,7 +150,7 @@ def _bench_single_chip(gen: str, n_elems: int = N_ELEMS) -> dict:
 
     rtt = measure_null_rtt()
     ig = ingraph_collective_slope("allreduce", n_elems, nranks, rtt=rtt)
-    control = control_block()
+    control = control_block(rtt=rtt)
     host = _bench_host_path(gen, use_device=True, n_elems=n_elems)
     # host-lane decomposition: each host op executes the same fold the
     # in-graph lane measured, plus per-op Python/MPI machinery and async

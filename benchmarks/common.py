@@ -365,7 +365,7 @@ def ingraph_collective_slope(variant: str, n_elems: int, nranks: int,
 
 
 def control_block(n_elems: int = 1 << 26, gemm_m: int = 4096,
-                  repeats: int = 3) -> dict:
+                  repeats: int = 3, rtt: "float | None" = None) -> dict:
     """Same-session calibration stamped into every TPU artifact (VERDICT r4
     next #7): the tunnel's null-op RTT, measured HBM GB/s (elementwise
     adaptive slope), and the GEMM slope TFLOP/s — captured back-to-back with
@@ -377,7 +377,10 @@ def control_block(n_elems: int = 1 << 26, gemm_m: int = 4096,
     import numpy as np
 
     out: dict = {}
-    rtt = measure_null_rtt()
+    if rtt is None:
+        rtt = measure_null_rtt()
+    # the SAME rtt the caller's adaptive slopes used, so the stamped
+    # weather describes the measurement it accompanies
     out["null_rtt_ms"] = round(rtt * 1e3, 3)
 
     # HBM: elementwise chain (1 read + 1 write per step), dynamic step count;

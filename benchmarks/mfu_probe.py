@@ -1,32 +1,21 @@
-"""Single-chip MFU proof (VERDICT r3 next-item #2).
+"""Single-chip MFU proof (VERDICT r3 next-item #2; r4 next #3 shape sweep).
 
-Round 3's only absolute compute number was a 10-step attention chain at
-14.3 GFLOP/s — tunnel-dominated, unusable as an MFU claim. The complication
-this probe handles explicitly: through the device tunnel, a jit CALL whose
-program contains matmuls costs ~60-500 ms on the host side regardless of
-depth (measured; elementwise-only programs pay ~1-10 ms), so even a 64-step
-in-jit chain reports mostly overhead. The fix is the **slope method**: build
-the same data-dependent chain at two static depths K_lo and K_hi, time both
-calls, and take
+Protocol: the execution-dominated **adaptive slope** (common.adaptive_slope
+— per-step exec = (t(2K)-t(K))/K with K grown until the call time clearly
+exceeds the tunnel's null RTT). The r3/r4 fixed-K slope breaks whenever the
+tunnel floor (observed up to ~100 ms) swallows the depth delta; the
+adaptive protocol measures the same thing weather-immune, and stamps the
+artifact with the same-session control block (VERDICT r4 next #7).
 
-    per_step_exec = (t(K_hi) - t(K_lo)) / (K_hi - K_lo)
+  A. control block — null RTT, HBM GB/s, GEMM slope TFLOP/s
+     (common.control_block; VERDICT bar: >=40% MFU on the GEMM control).
+  B. ``ring_attention`` — the fused Pallas block vs the precision-matched
+     naive-XLA body, swept over (T, d, dtype) shapes. The bf16 rows run
+     the bf16 MXU path (f32 softmax state/accumulation) in BOTH bodies,
+     so fused-vs-naive is apples-to-apples.
 
-which cancels the per-call overhead exactly (both calls are one dispatch of
-the same program shape). The artifact reports both the execution MFU (slope)
-and the raw end-to-end numbers with the inferred per-call overhead, so
-nothing is hidden.
-
-  A. ``gemm`` control — chained 4096x4096x4096 bf16 matmuls
-     (``acc = scale(acc) @ b``: data-dependent, renormalized by a cheap
-     256-row RMS so the chain neither explodes nor vanishes). VERDICT bar:
-     >=40% MFU on this control.
-  B. ``ring_attention`` — the fused Pallas block (t=1024, d=128, the
-     VMEM-resident maximum), same slope protocol.
-  C. ``naive_attention`` — XLA-fused jnp attention, for the fused/naive
-     ratio at depth.
-
-Sanity per timed call: readback one element, assert finite; the GEMM body is
-cross-checked against numpy at one step.
+Sanity per timed call: one-element readback, assert finite. The fused and
+naive bodies are cross-checked against each other at one step per shape.
 
 Usage: python benchmarks/mfu_probe.py [-o results/mfu-tpu.json]
 """
@@ -35,34 +24,21 @@ from __future__ import annotations
 
 import argparse
 import sys
-import time
 
 import numpy as np
 
-from common import detect_platform, emit
+from common import (adaptive_slope, best_of_calls, control_block,
+                    detect_platform, emit, gen_of, measure_null_rtt)
 
-M = 4096                     # GEMM control shape (MXU-friendly, bf16)
-GEMM_K_LO, GEMM_K_HI = 16, 128
-T, D = 1024, 128             # attention block (VMEM-resident max)
-ATTN_K_LO, ATTN_K_HI = 128, 1536
-REPEATS = 6
-
-
-from common import gen_of as _gen_of    # canonical generation detection
-
-
-def _best_call(f, x, sanity, repeats=REPEATS):
-    """Min per-call seconds; calls chain (x feeds back) and each is forced
-    by a one-element readback inside sanity()."""
-    x = f(x)
-    sanity(x)                 # compile + first run
-    best = float("inf")
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        x = f(x)
-        sanity(x)
-        best = min(best, time.perf_counter() - t0)
-    return best, x
+# (T_local, d, dtype): 1024/f32 keeps r3/r4 continuity; the bf16 rows are
+# the MXU-rate path the kernel is built for (VERDICT r4 next #3)
+SHAPES = [
+    (1024, 128, "float32"),
+    (1024, 128, "bfloat16"),
+    (2048, 128, "bfloat16"),
+    (4096, 128, "bfloat16"),
+]
+REPEATS = 3
 
 
 def main() -> None:
@@ -72,10 +48,10 @@ def main() -> None:
 
     plat = detect_platform()
     record: dict = {"benchmark": "mfu_probe", "platform": plat,
-                    "protocol": "slope method: per-step exec = "
-                                "(t(K_hi)-t(K_lo))/(K_hi-K_lo), cancelling "
-                                "the per-call tunnel overhead; every call "
-                                "chains data-dependently and ends in a "
+                    "protocol": "adaptive slope (common.adaptive_slope): "
+                                "per-step exec = (t(2K)-t(K))/K with K grown "
+                                "until calls are execution-dominated; every "
+                                "call chains data-dependently and ends in a "
                                 "forced readback"}
     if plat["platform"] != "tpu":
         record["skipped"] = "no TPU backend"
@@ -89,134 +65,119 @@ def main() -> None:
     from tpu_mpi.xla import make_mesh, pallas_kernels as pk
 
     dev = [d for d in jax.devices() if d.platform == "tpu"][:1]
-    gen = _gen_of(dev[0])
+    gen = gen_of(dev[0])
     peak = CAPABILITIES[gen]["bf16_tflops"] * 1e12
     record["generation"] = gen
     record["bf16_peak_tflops"] = peak / 1e12
 
-    # ---- A. GEMM control ---------------------------------------------------
-    key = jax.random.PRNGKey(0)
-    b_mat = (jax.random.normal(key, (M, M), jnp.float32)
-             / np.sqrt(M)).astype(jnp.bfloat16)
-    a0 = jax.random.normal(jax.random.PRNGKey(1), (M, M),
-                           jnp.float32).astype(jnp.bfloat16)
+    # ---- A. control block (same-session weather stamp + GEMM bar) ---------
+    rtt = measure_null_rtt()
+    record["control"] = control_block(rtt=rtt)
+    fps_gemm = record["control"]["gemm_slope_tflops"] * 1e12
+    record["gemm_mfu"] = round(fps_gemm / peak, 4)
+    print(f"control: null_rtt {record['control']['null_rtt_ms']} ms, "
+          f"HBM {record['control']['hbm_gbps_measured']} GB/s, GEMM "
+          f"{record['control']['gemm_slope_tflops']} TFLOP/s "
+          f"({record['gemm_mfu'] * 100:.1f}% MFU)", file=sys.stderr)
 
-    def gemm_chain(k_steps):
-        @jax.jit
-        def f(a, b):
-            def body(i, acc):
-                nxt = jnp.dot(acc, b, preferred_element_type=jnp.float32)
-                # cheap bounded renormalization: RMS over a 256-row slice
-                # (~0.8% of the matmul's FLOPs) keeps the chain stable and
-                # data-dependent without becoming the thing measured
-                s = jax.lax.rsqrt(jnp.mean(nxt[:256] * nxt[:256]) + 1e-30)
-                return (nxt * s).astype(jnp.bfloat16)
-            return jax.lax.fori_loop(0, k_steps, body, a)
-        return lambda a: f(a, b_mat)
-
-    def gemm_sanity(x):
-        v = float(jnp.asarray(x[0, 0], jnp.float32))
-        assert np.isfinite(v), v
-
-    t_lo, a1 = _best_call(gemm_chain(GEMM_K_LO), a0, gemm_sanity)
-    t_hi, _ = _best_call(gemm_chain(GEMM_K_HI), a1, gemm_sanity)
-    per_step = (t_hi - t_lo) / (GEMM_K_HI - GEMM_K_LO)
-    step_flops = 2.0 * M ** 3
-    fps = step_flops / per_step
-    overhead = t_lo - GEMM_K_LO * per_step
-    record["gemm"] = {
-        "shape": [M, M, M], "dtype": "bf16",
-        "k_lo": GEMM_K_LO, "k_hi": GEMM_K_HI,
-        "t_lo_ms": round(t_lo * 1e3, 2), "t_hi_ms": round(t_hi * 1e3, 2),
-        "per_step_us_exec": round(per_step * 1e6, 1),
-        "per_call_overhead_ms": round(overhead * 1e3, 2),
-        "tflops_exec": round(fps / 1e12, 2),
-        "mfu_exec": round(fps / peak, 4),
-        "tflops_endtoend_khi": round(step_flops * GEMM_K_HI / t_hi / 1e12, 2),
-    }
-    print(f"gemm {M}^3 bf16 slope {GEMM_K_LO}->{GEMM_K_HI}: "
-          f"{per_step * 1e6:.0f} us/step = {fps / 1e12:.1f} TFLOP/s "
-          f"({fps / peak * 100:.1f}% MFU exec; call overhead "
-          f"{overhead * 1e3:.0f} ms)", file=sys.stderr)
-
-    # one-step numpy cross-check of the GEMM body (numerics, not perf)
-    one = jax.jit(lambda a: jnp.dot(a, b_mat,
-                                    preferred_element_type=jnp.float32))
-    sl = np.s_[:256]
-    got = np.asarray(one(a0), np.float32)[sl]
-    want = (np.asarray(a0, np.float32) @ np.asarray(b_mat, np.float32))[sl]
-    err = np.abs(got - want).max() / max(np.abs(want).max(), 1e-9)
-    assert err < 0.02, f"GEMM numerics off: rel {err}"
-    record["gemm"]["one_step_rel_err_vs_numpy"] = round(float(err), 5)
-
-    # ---- B/C. attention chains --------------------------------------------
+    # ---- B. attention shape sweep: fused Pallas vs naive XLA --------------
     mesh = make_mesh({"x": 1}, devices=dev)
-    q0, kk_, vv = (jax.random.normal(s, (T, D), jnp.float32)
-                   for s in jax.random.split(jax.random.PRNGKey(7), 3))
-    attn_step_flops = 4.0 * T * T * D
+    record["attention"] = []
 
-    def attn_sanity(x):
-        v = float(np.asarray(x)[0, 0])
-        assert np.isfinite(v), v
+    for t_, d_, dtn in SHAPES:
+        dt = jnp.dtype(dtn)
+        keys = jax.random.split(jax.random.PRNGKey(7), 3)
+        q0, kk_, vv_ = (jax.random.normal(s, (t_, d_), jnp.float32).astype(dt)
+                        for s in keys)
+        step_flops = 4.0 * t_ * t_ * d_
 
-    def chain_of(body, k_steps):
-        def f(a, b, c):
-            def step(i, acc):
-                return body(acc, b, c)
-            return jax.lax.fori_loop(0, k_steps, step, a)
-        g = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=(P(), P(), P()),
-                                  out_specs=P(), check_vma=False))
-        return lambda a: g(a, kk_, vv)
+        def fused_body(a, b, c):
+            return pk.ring_attention(a, b, c, axis="x", interpret=False)
 
-    def attn_slope(body):
-        # interleave lo/hi timed calls so tunnel-overhead drift between
-        # measurement phases cancels instead of polluting the slope
-        f_lo, f_hi = chain_of(body, ATTN_K_LO), chain_of(body, ATTN_K_HI)
-        a = f_lo(q0); attn_sanity(a)
-        a = f_hi(a); attn_sanity(a)
-        lo, hi = float("inf"), float("inf")
-        for _ in range(8):
-            t0 = time.perf_counter(); a = f_lo(a); attn_sanity(a)
-            lo = min(lo, time.perf_counter() - t0)
-            t0 = time.perf_counter(); a = f_hi(a); attn_sanity(a)
-            hi = min(hi, time.perf_counter() - t0)
-        per = (hi - lo) / (ATTN_K_HI - ATTN_K_LO)
-        return lo, hi, per
+        # true-f32 MXU for the f32 row (XLA's DEFAULT runs f32 matmuls as
+        # bf16 passes on TPU — the Pallas kernel's f32 path is exact, so
+        # the control must be too); bf16 rows use the native bf16 path
+        prec = (jax.lax.Precision.HIGHEST if dtn == "float32"
+                else jax.lax.Precision.DEFAULT)
 
-    fused_body = lambda a, b, c: pk.ring_attention(a, b, c, axis="x",
-                                                   interpret=False)
-    naive_body = lambda a, b, c: jax.nn.softmax(
-        (a @ b.T) / np.sqrt(D), axis=-1) @ c
+        def naive_body(a, b, c):
+            # precision-matched control: same mixed precision as the
+            # kernel (matmuls at input dtype with f32 accumulation,
+            # softmax state in f32), fused however XLA likes
+            s = jax.lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32,
+                                    precision=prec)
+            s = s / np.sqrt(d_)
+            p = jax.nn.softmax(s, axis=-1)
+            return jax.lax.dot_general(p.astype(a.dtype), c,
+                                       (((1,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32,
+                                       precision=prec).astype(a.dtype)
 
-    tf_lo, tf_hi, per_f = attn_slope(fused_body)
-    tn_lo, tn_hi, per_n = attn_slope(naive_body)
-    record["ring_attention_fused"] = {
-        "shape": [T, D], "k_lo": ATTN_K_LO, "k_hi": ATTN_K_HI,
-        "t_lo_ms": round(tf_lo * 1e3, 2), "t_hi_ms": round(tf_hi * 1e3, 2),
-        "per_step_us_exec": round(per_f * 1e6, 1),
-        "tflops_exec": round(attn_step_flops / per_f / 1e12, 2),
-        "mfu_exec": round(attn_step_flops / per_f / peak, 4),
-        "vs_gemm_control": round((attn_step_flops / per_f) / fps, 4),
-    }
-    record["naive_attention_xla"] = {
-        "shape": [T, D],
-        "per_step_us_exec": round(per_n * 1e6, 1),
-        "tflops_exec": round(attn_step_flops / per_n / 1e12, 2),
-        "mfu_exec": round(attn_step_flops / per_n / peak, 4),
-    }
-    record["fused_over_naive_speed"] = round(per_n / per_f, 3)
-    # noise guard: a slope implying more than the chip's peak means the
-    # depth difference was below the tunnel's timing noise — flag it rather
-    # than report an impossible number
-    for row in (record["ring_attention_fused"], record["naive_attention_xla"]):
-        row["resolved"] = bool(row["tflops_exec"] * 1e12 <= 1.05 * peak
-                               and row["per_step_us_exec"] > 0)
-    print(f"attention {T}x{D} slope {ATTN_K_LO}->{ATTN_K_HI}: fused "
-          f"{per_f * 1e6:.0f} us/step ({attn_step_flops / per_f / 1e12:.2f} "
-          f"TFLOP/s), naive {per_n * 1e6:.0f} us/step, fused/naive speed "
-          f"{per_n / per_f:.2f}", file=sys.stderr)
+        def chain_of(body):
+            def f(a, steps, b, c):
+                def step(i, acc):
+                    return body(acc, b, c)
+                return jax.lax.fori_loop(0, steps, step, a)
+            g = jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=(P(), None, P(), P()), out_specs=P(),
+                check_vma=False))
+            st = {"a": q0}
 
-    record["gemm_mfu_target_met"] = bool(record["gemm"]["mfu_exec"] >= 0.40)
+            def call(ksteps):
+                st["a"] = g(st["a"], ksteps, kk_, vv_)
+                v0 = float(np.asarray(st["a"])[0, 0])
+                assert np.isfinite(v0), v0
+
+            call(1)   # compile once (dynamic trip count)
+            return call
+
+        def slope_of(call):
+            sl = adaptive_slope(
+                lambda k: best_of_calls(call, k, REPEATS), rtt)
+            return sl
+
+        fused_call, naive_call = chain_of(fused_body), chain_of(naive_body)
+        # one-step numerics cross-check (fused vs naive, same inputs)
+        one_f = jax.jit(jax.shard_map(
+            fused_body, mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
+            check_vma=False))
+        one_n = jax.jit(jax.shard_map(
+            naive_body, mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
+            check_vma=False))
+        got = np.asarray(one_f(q0, kk_, vv_), np.float32)
+        want = np.asarray(one_n(q0, kk_, vv_), np.float32)
+        rel = float(np.abs(got - want).max()
+                    / max(np.abs(want).max(), 1e-9))
+        tol = 0.05 if dtn == "bfloat16" else 2e-4
+        assert rel < tol, f"fused/naive mismatch at {t_}x{d_} {dtn}: {rel}"
+
+        sf, sn = slope_of(fused_call), slope_of(naive_call)
+        per_f, per_n = sf["per_step_s"], sn["per_step_s"]
+        row = {
+            "shape": [t_, d_], "dtype": dtn,
+            "one_step_rel_err_fused_vs_naive": round(rel, 5),
+            "fused": {"per_step_us": round(per_f * 1e6, 1),
+                      "tflops": round(step_flops / per_f / 1e12, 2),
+                      "mfu": round(step_flops / per_f / peak, 4),
+                      "k": sf["k"], "slope_spread": sf["slope_spread"]},
+            "naive_xla": {"per_step_us": round(per_n * 1e6, 1),
+                          "tflops": round(step_flops / per_n / 1e12, 2),
+                          "mfu": round(step_flops / per_n / peak, 4),
+                          "k": sn["k"], "slope_spread": sn["slope_spread"]},
+            "fused_over_naive_speed": round(per_n / per_f, 3),
+        }
+        record["attention"].append(row)
+        print(f"attn {t_}x{d_} {dtn}: fused {per_f * 1e6:.0f} us "
+              f"({row['fused']['tflops']} TF, {row['fused']['mfu'] * 100:.0f}"
+              f"% MFU) vs naive {per_n * 1e6:.0f} us "
+              f"({row['naive_xla']['tflops']} TF) -> "
+              f"{row['fused_over_naive_speed']}x", file=sys.stderr)
+
+    best = max(record["attention"], key=lambda r: r["fused_over_naive_speed"])
+    record["fused_wins_somewhere"] = bool(
+        best["fused_over_naive_speed"] >= 1.0 and best["fused"]["mfu"] >= 0.65)
+    record["gemm_mfu_target_met"] = bool(record["gemm_mfu"] >= 0.40)
     emit(args.out, record)
     if not record["gemm_mfu_target_met"]:
         sys.exit(1)

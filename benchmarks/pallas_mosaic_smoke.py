@@ -39,8 +39,20 @@ def main() -> None:
     from jax.sharding import PartitionSpec as P
 
     plat = detect_platform()
-    record: dict = {"benchmark": "pallas_mosaic_smoke", "platform": plat,
-                    "interpret": False, "kernels": {}}
+    record: dict = {
+        "benchmark": "pallas_mosaic_smoke", "platform": plat,
+        "interpret": False, "kernels": {},
+        # honest scope statement (VERDICT r4 next #8): this artifact
+        # proves Mosaic COMPILATION + NUMERICS AT n=1 — a ring of one
+        # never drives a cross-chip DMA. Multi-rank ring semantics are
+        # carried by the interpret-machine suite
+        # (tests/test_pallas_kernels.py, 2-8 simulated devices), whose
+        # run is the companion artifact
+        # (results/allreduce-pallas-interp-cpusim.json).
+        "claim": "Mosaic compile + numerics at n=1 on a real chip; "
+                 "cross-chip DMA is NOT driven here (1-chip environment). "
+                 "Companion: interpret-machine multi-rank numerics.",
+    }
     if plat["platform"] != "tpu":
         print("no TPU visible: Mosaic compilation cannot be proven here",
               file=sys.stderr)
@@ -145,71 +157,11 @@ def main() -> None:
     record["all_numerics_ok"] = all(
         k.get("numerics_ok") for k in record["kernels"].values())
 
-    # Perf lane: the fused Mosaic attention block vs XLA's fusion of the
-    # naive jnp attention, both as a K-step data-dependent chain inside ONE
-    # jit (identical protocol; a one-element readback is the completion
-    # barrier). CAVEAT recorded in the artifact: per-execution overhead of
-    # the device tunnel dominates both absolute numbers on this setup, so
-    # the meaningful output is the RATIO, not GFLOP/s.
-    try:
-        # t=1024 keeps the whole block + double-buffered K/V inside the
-        # 16 MB scoped VMEM (t=2048 overflows: the kernel is VMEM-resident
-        # per ring step by design; longer sequences shard over more ranks)
-        t2, d2 = 1024, 128
-        q2, k2, v2 = (jax.random.normal(kk, (t2, d2), jnp.float32)
-                      for kk in jax.random.split(jax.random.PRNGKey(7), 3))
-        K = 10
-
-        def chain(body):
-            def f(a, b, c):
-                for _ in range(K):
-                    a = body(a, b, c)[:, :d2]
-                return a
-            return f
-
-        def fused_body(a, b, c):
-            return pk.ring_attention(a, b, c, axis="x", interpret=False)
-
-        def naive_body(a, b, c):
-            s = (a @ b.T) / np.sqrt(d2)
-            return jax.nn.softmax(s, axis=-1) @ c
-
-        flops = 4.0 * t2 * t2 * d2          # 2 matmuls, 2*t*t*d each
-
-        def time_fn(body):
-            f = jax.jit(jax.shard_map(chain(body), mesh=mesh,
-                                      in_specs=(P(), P(), P()),
-                                      out_specs=P(), check_vma=False))
-            out = f(q2, k2, v2)
-            float(np.asarray(out[0, 0]))    # force compile + first run
-            reps = 5
-            t0 = time.perf_counter()
-            prev = out
-            for _ in range(reps):
-                prev = f(prev, k2, v2)      # data-dependent across calls too
-            float(np.asarray(prev[0, 0]))
-            return (time.perf_counter() - t0) / (reps * K)
-
-        dt_f, dt_n = time_fn(fused_body), time_fn(naive_body)
-        record["attention_perf"] = {
-            "shape": [t2, d2],
-            "protocol": f"{K}-step chain inside one jit, chained across "
-                        "calls, one-element readback barrier",
-            "caveat": "tunnel per-execution overhead dominates absolute "
-                      "times on this setup; the fused/naive ratio is the "
-                      "meaningful signal",
-            "fused_us": round(dt_f * 1e6, 1),
-            "naive_jit_us": round(dt_n * 1e6, 1),
-            "fused_gflops": round(flops / dt_f / 1e9, 1),
-            "naive_gflops": round(flops / dt_n / 1e9, 1),
-            "naive_over_fused": round(dt_n / dt_f, 3),
-        }
-        print(f"attention {t2}x{d2}: fused {dt_f*1e6:.0f} us  naive "
-              f"{dt_n*1e6:.0f} us  ratio {dt_n/dt_f:.2f} (tunnel-bound)",
-              file=sys.stderr)
-    except Exception as e:
-        record["attention_perf"] = {"error": f"{type(e).__name__}: {e}"}
-        print(f"attention perf lane failed: {e}", file=sys.stderr)
+    # Attention performance lives in mfu_probe.py (adaptive-slope,
+    # precision-matched naive control, shape sweep) — this smoke is
+    # the COMPILE + n=1 NUMERICS proof only; a raw-call comparison
+    # here would be tunnel-bound noise (removed, VERDICT r4 next #8).
+    record["attention_perf"] = "see results/mfu-tpu.json"
 
     emit(args.out, record)
     if not (record["all_compiled"] and record["all_numerics_ok"]):
