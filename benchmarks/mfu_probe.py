@@ -167,6 +167,11 @@ def main() -> None:
                           "k": sn["k"], "slope_spread": sn["slope_spread"]},
             "fused_over_naive_speed": round(per_n / per_f, 3),
         }
+        # noise guard (kept from r4): a slope implying more than the chip's
+        # peak — or a non-positive one — means jitter beat the adaptive
+        # protocol; flag the row rather than assert an impossible number
+        for lane in (row["fused"], row["naive_xla"]):
+            lane["resolved"] = bool(0 < lane["tflops"] * 1e12 <= 1.05 * peak)
         record["attention"].append(row)
         print(f"attn {t_}x{d_} {dtn}: fused {per_f * 1e6:.0f} us "
               f"({row['fused']['tflops']} TF, {row['fused']['mfu'] * 100:.0f}"

@@ -26,7 +26,9 @@ import time
 from common import detect_platform, emit, iters_for, size_sweep
 
 WINDOW = 64
-REPEATS = 3
+REPEATS = 8     # this box's scheduler noise swings block averages ~60%;
+                # min-of-8 blocks recovers the capability number (the
+                # per-sample p5/p50 spread is recorded alongside)
 
 
 def _sweep_body(max_bytes: int, emit_row) -> None:
@@ -44,8 +46,13 @@ def _sweep_body(max_bytes: int, emit_row) -> None:
         rbuf = np.zeros(n, np.float32)
         warmup, iters = iters_for(nbytes)
 
-        # --- latency: ping-pong ---
+        # --- latency: ping-pong. Block averages feed lat (the OSU-style
+        # number); small sizes ALSO run a separate per-sample pass for
+        # percentiles (capability floor + scheduler-noise spread) — kept
+        # out of the timed blocks so the instrumentation cannot bias lat.
         lat = float("inf")
+        pcts = None
+        samples: list = []
         for rep in range(REPEATS + 1):   # first block is warmup
             it = warmup if rep == 0 else iters
             MPI.Barrier(comm)
@@ -60,6 +67,23 @@ def _sweep_body(max_bytes: int, emit_row) -> None:
             dt = (time.perf_counter() - t0) / it / 2
             if rep > 0:
                 lat = min(lat, dt)
+        if nbytes <= 4096:
+            MPI.Barrier(comm)
+            for _ in range(REPEATS * iters):
+                t1 = time.perf_counter()
+                if rank == 0:
+                    MPI.Send(buf, peer, 7, comm)
+                    MPI.Recv(rbuf, peer, 7, comm)
+                else:
+                    MPI.Recv(rbuf, peer, 7, comm)
+                    MPI.Send(buf, peer, 7, comm)
+                samples.append((time.perf_counter() - t1) / 2)
+        if samples:
+            s = sorted(samples)
+            pcts = {"min": round(s[0] * 1e6, 2),
+                    "p5": round(s[len(s) // 20] * 1e6, 2),
+                    "p50": round(s[len(s) // 2] * 1e6, 2),
+                    "p90": round(s[int(len(s) * 0.9)] * 1e6, 2)}
 
         # --- bandwidth: windowed Isend/Irecv + Waitall ---
         bw_iters = max(2, iters // 8)
@@ -83,8 +107,11 @@ def _sweep_body(max_bytes: int, emit_row) -> None:
                 bw = max(bw, n * 4 * WINDOW / dt / 1e9)
 
         if rank == 0:
-            emit_row({"bytes": n * 4, "lat_us": round(lat * 1e6, 2),
-                      "bw_gbps": round(bw, 3)})
+            row = {"bytes": n * 4, "lat_us": round(lat * 1e6, 2),
+                   "bw_gbps": round(bw, 3)}
+            if pcts is not None:
+                row["lat_pcts_us"] = pcts
+            emit_row(row)
 
 
 def run_threads(max_bytes: int) -> list[dict]:

@@ -306,21 +306,33 @@ class RmaEngine:
             self.respond(origin, reqid, None)
         elif kind == "lepoch":
             # a whole deferred lock epoch in one frame: acquire the lock
-            # (immediately or queued), apply every buffered op, release,
-            # ack. The grant callback runs wherever the lock manager fires
-            # it (this dispatch, or a later release's pump) — always a
-            # frame-pumping thread, never blocked.
+            # (immediately or queued), apply every buffered op IN PROGRAM
+            # ORDER — reads included — release, ack with the read results.
+            # This is what makes an uncontended lock/get/unlock epoch ONE
+            # round trip (VERDICT r4 next #6): Get / Fetch_and_op results
+            # are only valid after the closing synchronization per MPI, so
+            # they may legally travel in the unlock ack. The grant callback
+            # runs wherever the lock manager fires it (this dispatch, or a
+            # later release's pump) — always a frame-pumping thread, never
+            # blocked.
             _, _, _, reqid, origin, excl, ops = item
 
             def run_epoch():
+                reads: list = []
                 for op in ops:
                     if op[0] == "put":
                         st.apply_put(op[1], np.asarray(op[2]))
-                    else:               # ("acc", disp, arr, opspec)
+                    elif op[0] == "acc":
                         st.apply_acc(op[1], np.asarray(op[2]),
                                      _resolve_op(op[3]), fetch=False)
+                    elif op[0] == "get":
+                        reads.append(st.read(op[1], op[2]))
+                    else:               # ("facc", disp, arr, opspec)
+                        reads.append(st.apply_acc(op[1], np.asarray(op[2]),
+                                                  _resolve_op(op[3]),
+                                                  fetch=True))
                 st.lockmgr.release(origin, excl)
-                self.respond(origin, reqid, None)
+                self.respond(origin, reqid, reads or None)
 
             st.lockmgr.request(origin, excl, run_epoch)
         else:
@@ -430,16 +442,23 @@ def _origin_flat(origin: Any, count: int) -> np.ndarray:
     return np.ascontiguousarray(flat[:int(count)])
 
 
-# A deferred epoch stays batched while it is small and write-only; past
-# these bounds (or on any read) it materializes into a live wire lock.
+# A deferred epoch stays batched while it is small; past these bounds it
+# materializes into a live wire lock. Reads batch too — their results
+# travel back in the single unlock ack (MPI: Get / Fetch_and_op results
+# are valid only after the closing synchronization).
 _EPOCH_MAX_OPS = 16
 _EPOCH_MAX_BYTES = 1 << 20
+
+# deferred ops that carry an array payload to snapshot (reads carry a
+# count + an origin REFERENCE to fill at completion instead)
+_PAYLOAD_OPS = frozenset(("put", "acc", "facc"))
 
 
 def _materialize_lock(st: ProcWinState, world: int) -> None:
     """Turn a deferred epoch into a live one: take the wire lock for real
-    and replay the buffered ops as ordinary frames (FIFO keeps order).
-    Caller holds st.epoch_lock."""
+    and replay the buffered ops as ordinary frames (FIFO keeps order);
+    buffered reads complete HERE (their epoch is becoming live — e.g. a
+    Win_flush demands completion). Caller holds st.epoch_lock."""
     ctx, _ = require_env()
     ep = st.deferred.pop(world, None)
     if ep is None:
@@ -453,11 +472,40 @@ def _materialize_lock(st: ProcWinState, world: int) -> None:
             with st.lock:
                 st.dirty.add(world)
             eng.send(world, ("put", st.win_id, op[1], op[2]))
-        else:
+        elif op[0] == "acc":
             with st.lock:
                 st.dirty.add(world)
             eng.send(world, ("acc", st.win_id, op[1], op[2], op[3],
                              None, ctx.local_rank))
+        elif op[0] == "get":
+            _, disp, count, ref = op
+            rid = eng.new_reqid()
+            eng.send(world, ("get", st.win_id, disp, count, rid,
+                             ctx.local_rank))
+            write_flat(ref, np.asarray(eng.wait_resp(rid, "Get")), count)
+        else:                            # ("facc", disp, arr, opspec, ref)
+            _, disp, arr, opspec, ref = op
+            with st.lock:
+                st.dirty.add(world)
+            rid = eng.new_reqid()
+            eng.send(world, ("acc", st.win_id, disp, arr, opspec, rid,
+                             ctx.local_rank))
+            write_flat(ref, np.asarray(eng.wait_resp(rid, "Get_accumulate")),
+                       int(np.asarray(arr).size))
+
+
+def _op_bytes(op: tuple) -> int:
+    """Wire footprint of a deferred op: payload bytes for writes, the
+    RESULT size for reads (a batched Get's data rides the unlock ack — it
+    must count against the epoch bound too, or 16 huge reads would pickle
+    gigabytes into one response frame). Element size is conservatively 8
+    (the origin dtype is unknown here)."""
+    if op[0] == "get":
+        return int(op[2]) * 8
+    nb = int(getattr(op[2], "nbytes", 0))
+    if op[0] == "facc":
+        nb *= 2                          # payload out + fetched value back
+    return nb
 
 
 def _epoch_buffer(st: ProcWinState, world: int, op: tuple) -> bool:
@@ -467,17 +515,19 @@ def _epoch_buffer(st: ProcWinState, world: int, op: tuple) -> bool:
     ep = st.deferred.get(world)
     if ep is None:
         return False
-    nbytes = sum(getattr(o[2], "nbytes", 0) for o in ep["ops"])
+    nbytes = sum(_op_bytes(o) for o in ep["ops"])
     if (len(ep["ops"]) >= _EPOCH_MAX_OPS
-            or nbytes + getattr(op[2], "nbytes", 0) > _EPOCH_MAX_BYTES):
+            or nbytes + _op_bytes(op) > _EPOCH_MAX_BYTES):
         _materialize_lock(st, world)
         return False
-    # copy the payload: _origin_flat returns a VIEW for contiguous origins,
-    # and a deferred op ships at Win_unlock — without the copy, mutating
-    # the origin between Put/Accumulate and unlock would silently ship the
-    # mutated data (the eager path snapshots at call time; both paths must
-    # observe the same values)
-    ep["ops"].append(op[:2] + (np.array(op[2], copy=True),) + op[3:])
+    if op[0] in _PAYLOAD_OPS:
+        # copy the payload: _origin_flat returns a VIEW for contiguous
+        # origins, and a deferred op ships at Win_unlock — without the
+        # copy, mutating the origin between Put/Accumulate and unlock
+        # would silently ship the mutated data (the eager path snapshots
+        # at call time; both paths must observe the same values)
+        op = op[:2] + (np.array(op[2], copy=True),) + op[3:]
+    ep["ops"].append(op)
     return True
 
 
@@ -502,18 +552,21 @@ def rma_get(st: ProcWinState, origin: Any, count: int, target_rank: int,
     ctx, _ = require_env()
     world = _target_world(st, target_rank)
     if world == ctx.local_rank:
-        data = st.read(disp, int(count))
-    else:
-        # reads need the real lock + earlier ops applied (a Get must see
-        # this epoch's own Puts)
-        with st.epoch_lock:
-            _materialize_lock(st, world)
-        eng = _engine(ctx)
-        reqid = eng.new_reqid()
-        eng.send(world, ("get", st.win_id, int(disp), int(count), reqid,
-                         ctx.local_rank))
-        data = eng.wait_resp(reqid, "Get")
-    write_flat(origin, np.asarray(data), int(count))
+        write_flat(origin, np.asarray(st.read(disp, int(count))), int(count))
+        return
+    with st.epoch_lock:
+        # inside a deferred lock epoch the read BATCHES (VERDICT r4 #6):
+        # it executes at the owner in program order within the single
+        # unlock frame, and the result — valid only after the closing
+        # synchronization per MPI — fills ``origin`` at Win_unlock (or at
+        # Win_flush / epoch overflow, which materialize and complete it)
+        if _epoch_buffer(st, world, ("get", int(disp), int(count), origin)):
+            return
+    eng = _engine(ctx)
+    reqid = eng.new_reqid()
+    eng.send(world, ("get", st.win_id, int(disp), int(count), reqid,
+                     ctx.local_rank))
+    write_flat(origin, np.asarray(eng.wait_resp(reqid, "Get")), int(count))
 
 
 def rma_accumulate(st: ProcWinState, origin_flat: np.ndarray, target_rank: int,
@@ -539,8 +592,12 @@ def rma_accumulate(st: ProcWinState, origin_flat: np.ndarray, target_rank: int,
             eng.send(world, ("acc", st.win_id, int(disp), src, _op_spec(op),
                              None, ctx.local_rank))
     else:
-        with st.epoch_lock:             # fetching ops read: need real lock
-            _materialize_lock(st, world)
+        with st.epoch_lock:
+            # fetching ops batch like plain reads: the fetched value fills
+            # at Win_unlock (one frame, one round trip)
+            if _epoch_buffer(st, world, ("facc", int(disp), src,
+                                         _op_spec(op), fetch_into)):
+                return
         reqid = eng.new_reqid()
         eng.send(world, ("acc", st.win_id, int(disp), src, _op_spec(op),
                          reqid, ctx.local_rank))
@@ -639,11 +696,27 @@ def proc_unlock(st: ProcWinState, target_rank: int, exclusive: bool) -> None:
         ep = st.deferred.pop(world, None)
         if ep is not None:
             # whole deferred epoch in one frame; the ack means lock
-            # acquired, every op applied, lock released
+            # acquired, every op applied (reads included), lock released.
+            # Read ops keep their origin-buffer REFERENCES local — only
+            # (kind, disp, count/payload) travels; results return in the
+            # ack, in op order, and fill the origins here.
+            wire_ops = []
+            read_sinks: list = []
+            for op in ep["ops"]:
+                if op[0] == "get":
+                    wire_ops.append(op[:3])
+                    read_sinks.append((op[3], op[2]))
+                elif op[0] == "facc":
+                    wire_ops.append(op[:4])
+                    read_sinks.append((op[4], int(np.asarray(op[2]).size)))
+                else:
+                    wire_ops.append(op)
             reqid = eng.new_reqid()
             eng.send(world, ("lepoch", st.win_id, reqid, ctx.local_rank,
-                             ep["excl"], ep["ops"]))
-            eng.wait_resp(reqid, "Win_unlock")
+                             ep["excl"], wire_ops))
+            results = eng.wait_resp(reqid, "Win_unlock")
+            for (ref, count), data in zip(read_sinks, results or []):
+                write_flat(ref, np.asarray(data), count)
             with st.lock:
                 # the ack completed every earlier FIFO frame too — keep
                 # fence-mode dirty bookkeeping consistent with live unlock

@@ -1047,6 +1047,14 @@ class ProcContext(SpmdContext):
 
     def _unchoke_all(self) -> None:
         """Queue unchoke frames for every choked peer (also the
+        lock-free-peek fast path: this runs on EVERY posted receive —
+        taking the lock with nobody choked is per-message overhead)."""
+        if not self._choked_peers:
+            return
+        self._unchoke_all_locked()
+
+    def _unchoke_all_locked(self) -> None:
+        """Queue unchoke frames for every choked peer (also the
         pending-recv hook: a receiver waiting on an unmatched recv may be
         waiting for a choked sender's message — release them all, the
         cross-process analog of the thread tier's posted-receive
@@ -1131,15 +1139,19 @@ class ProcContext(SpmdContext):
         iff a frame was delivered or ``done()`` turned true while acquiring
         the lease (e.g. the drainer delivered our message during its last
         slice); False on idle socket or when a sibling holds the lease."""
-        if not self._pump_lock.acquire(timeout=0.001):
-            # the drainer holds the lease, possibly blocked deep in its poll
-            # slice: ask it to yield (tm_poke -> its non-direct recv returns
-            # as a timeout in microseconds), then wait for the handover
-            poke = getattr(self.transport, "poke", None)
-            if poke is not None:
-                poke()
-            if not self._pump_lock.acquire(timeout=timeout_s):
-                return False
+        # non-blocking first: the uncontended acquire (the per-message hot
+        # case — the drainer is parked) skips the timed-acquire setup cost
+        if not self._pump_lock.acquire(False):
+            if not self._pump_lock.acquire(timeout=0.001):
+                # the drainer holds the lease, possibly blocked deep in its
+                # poll slice: ask it to yield (tm_poke -> its non-direct
+                # recv returns as a timeout in microseconds), then wait for
+                # the handover
+                poke = getattr(self.transport, "poke", None)
+                if poke is not None:
+                    poke()
+                if not self._pump_lock.acquire(timeout=timeout_s):
+                    return False
         try:
             if done is not None and done():
                 return True                 # delivered while we waited

@@ -60,6 +60,7 @@ class Comm:
         self._fixed_ctx = ctx
         self.name = name
         self._freed = False
+        self._rank_cache: dict[int, int] = {}
 
     # -- context / group resolution -----------------------------------------
     @property
@@ -84,11 +85,18 @@ class Comm:
     def rank(self) -> int:
         self._check()
         _, world_rank = require_env()
-        try:
-            return self._group.index(world_rank)
-        except ValueError:
-            raise InvalidCommError(
-                f"world rank {world_rank} is not a member of {self.name}") from None
+        # per-world-rank cache: list.index() on every Send/Recv is
+        # measurable on the small-message latency lane
+        r = self._rank_cache.get(world_rank)
+        if r is None:
+            try:
+                r = self._group.index(world_rank)
+            except ValueError:
+                raise InvalidCommError(
+                    f"world rank {world_rank} is not a member of "
+                    f"{self.name}") from None
+            self._rank_cache[world_rank] = r
+        return r
 
     def size(self) -> int:
         self._check()

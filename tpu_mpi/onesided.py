@@ -361,7 +361,14 @@ def _origin_array(origin: Any) -> np.ndarray:
 
 def Get(origin: Any, *args) -> None:
     """``Get(origin, [count, target_rank, target_disp | target_rank], win)`` —
-    read from the target's window into origin (src/onesided.jl:150-166)."""
+    read from the target's window into origin (src/onesided.jl:150-166).
+
+    MPI completion semantics: inside a passive-target lock epoch the origin
+    buffer is valid only after the closing ``Win_unlock`` (or a
+    ``Win_flush``) — the multi-process tier batches the read into the
+    single unlock frame (1 round trip per uncontended epoch), so code that
+    consumes the value mid-epoch must flush first, exactly as the standard
+    requires."""
     if len(args) == 2:
         target_rank, win = args
         count, target_disp = element_count(origin), 0
@@ -453,7 +460,11 @@ def Get_accumulate(origin: Any, result: Any, count: int, target_rank: int,
 
 def Fetch_and_op(sourceval: Any, returnval: Any, target_rank: int,
                  target_disp: int, op: Any, win: Win) -> None:
-    """Single-element atomic fetch-and-combine (src/onesided.jl:186-195)."""
+    """Single-element atomic fetch-and-combine (src/onesided.jl:186-195).
+
+    Like :func:`Get`, the fetched value lands at the closing
+    synchronization (unlock/flush) in a passive-target epoch — the op
+    batches into the unlock frame on the multi-process tier."""
     win._check()
     src = _origin_array(sourceval).reshape(-1)[:1]
     _apply_op(win, target_rank, target_disp, src, as_op(op), fetch_into=returnval)

@@ -196,8 +196,10 @@ def _my_mailbox(comm: Comm):
 
 
 def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
-          dtype: Optional[Datatype], kind: str, block: bool = False) -> None:
-    ctx, _ = require_env()
+          dtype: Optional[Datatype], kind: str, block: bool = False,
+          mb: Any = None, ctx: Any = None) -> None:
+    if ctx is None:                      # _send_typed already resolved it
+        ctx, _ = require_env()
     ctx.check_failure()
     my_rank = comm.rank()
     # no seq stamp here: thread-tier delivery is atomic with ordering (one
@@ -208,7 +210,8 @@ def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
     msg = Message(my_rank,
                   tag if isinstance(tag, tuple) else int(tag),
                   comm.cid, payload, count, dtype, kind)
-    mb = ctx.mailboxes[_resolve(comm, dest)]
+    if mb is None:                       # _send_typed already resolved it
+        mb = ctx.mailboxes[_resolve(comm, dest)]
     if block and hasattr(mb, "post_blocking"):
         # Flow control for blocking sends. Thread tier: admission-checked
         # against the destination queue under its lock. Multi-process tier:
@@ -245,11 +248,11 @@ def _send_typed(buf: Any, dest: int, tag: int, comm: Comm, block: bool) -> None:
         arr = extract_array(buf)
         if isinstance(arr, np.ndarray):
             _post(comm, dest, tag, arr, count, to_datatype(arr.dtype),
-                  "typed", block=block)
+                  "typed", block=block, mb=mb, ctx=ctx)
             return
     arr = to_wire(buf, count)
     _post(comm, dest, tag, arr, count, to_datatype(arr.dtype), "typed",
-          block=block)
+          block=block, mb=mb, ctx=ctx)
 
 
 def Send(buf: Any, dest: int, tag: int, comm: Comm) -> None:
@@ -319,12 +322,11 @@ def Recv(buf_or_type: Any, src: int, tag: int, comm: Comm):
         return (tmp[0].item() if dt.np_dtype.fields is None else tmp[0]), st
     if src == PROC_NULL:
         return Status(source=PROC_NULL, tag=ANY_TAG, count=0)
-    # inline blocking path (no Request object): post the receive, wait on
-    # the mailbox (direct-drain capable), deliver — the small-message
-    # latency lane (VERDICT r3 #4)
+    # inline blocking path (no Request object): match-or-wait in one
+    # mailbox lock entry (direct-drain capable) — the small-message
+    # latency lane (VERDICT r3 #4, r4 #5)
     mb = _my_mailbox(comm)
-    pr = mb.post_recv(int(src), int(tag), comm.cid)
-    msg = mb.wait_recv(pr)
+    msg = mb.recv_blocking(int(src), int(tag), comm.cid)
     assert msg is not None            # blocking Recv exposes no cancel handle
     n = element_count(buf_or_type)
     if msg.count > n:
@@ -350,8 +352,7 @@ def recv(src: int, tag: int, comm: Comm):
     if src == PROC_NULL:
         return None, Status(source=PROC_NULL, tag=ANY_TAG, count=0)
     mb = _my_mailbox(comm)
-    pr = mb.post_recv(int(src), int(tag), comm.cid)
-    msg = mb.wait_recv(pr)
+    msg = mb.recv_blocking(int(src), int(tag), comm.cid)
     assert msg is not None
     return _object_of(msg), _status_of(msg)
 
