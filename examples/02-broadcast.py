@@ -33,4 +33,12 @@ B = {"foo": "bar"} if rank == root else None
 B = MPI.bcast(B, root, comm)
 print(f"rank = {rank}, B = {B}")
 
+# functions too — even closures — exactly like the reference's Julia
+# Serialization (test/test_bcast.jl:38-55): each rank gets its own copy,
+# by value, on the thread tier AND across OS processes (tpurun --procs)
+k = 10
+f = (lambda x: x + k) if rank == root else None
+f = MPI.bcast(f, root, comm)
+print(f"rank = {rank}, f(5) = {f(5)}")
+
 MPI.Finalize()
