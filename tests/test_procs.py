@@ -1331,3 +1331,42 @@ def test_rma_batched_read_epochs_under_contention():
     assert res.returncode == 0, (res.stdout, res.stderr)
     for r in range(4):
         assert f"RMA-BATCH-OK-{r}" in res.stdout, (res.stdout, res.stderr)
+
+
+def test_spawn_closure_worker_across_processes():
+    """Comm_spawn of a LOCALLY-DEFINED callable across OS processes: the
+    worker closure ships by value through tpu_mpi.serialization (round 5;
+    the reference spawns scripts — spawning closures is beyond-parity,
+    but the thread tier always allowed it and the tiers must agree)."""
+    res = _run_procs("""
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+
+        greeting = "spawned"                      # captured by the closure
+
+        def worker():
+            MPI.Init()
+            parent = MPI.Comm_get_parent()
+            assert parent is not MPI.COMM_NULL
+            assert MPI.Comm_size(MPI.COMM_WORLD) == 2
+            merged = MPI.Intercomm_merge(parent, True)
+            total = MPI.Allreduce(np.array([1.0]), MPI.SUM, merged)
+            assert total[0] == MPI.Comm_size(merged), total
+            assert greeting == "spawned"          # closure state arrived
+            MPI.Finalize()
+
+        errors = [None, None]
+        inter = MPI.Comm_spawn(worker, None, 2, comm, errors)
+        assert errors == [0, 0]
+        merged = MPI.Intercomm_merge(inter, False)
+        total = MPI.Allreduce(np.array([1.0]), MPI.SUM, merged)
+        assert total[0] == size + 2, total
+        print(f"SPAWN-CLOSURE-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """, nprocs=2, timeout=240.0)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    for r in range(2):
+        assert f"SPAWN-CLOSURE-OK-{r}" in res.stdout, (res.stdout, res.stderr)

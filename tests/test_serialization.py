@@ -237,3 +237,59 @@ def test_thread_tier_send_recv_of_closure_gives_copies(nprocs):
         assert g() == src
 
     run_spmd(body, nprocs)
+
+
+def test_randomized_nested_structures_roundtrip():
+    """Property test: random nested containers mixing plain data, arrays,
+    closures and local-class instances all round-trip by value."""
+    rng = np.random.RandomState(7)
+
+    @dataclasses.dataclass
+    class Leaf:
+        tag: str
+        fn: object
+
+        def apply(self, x):
+            return self.fn(x)
+
+    def rand_obj(depth):
+        kind = rng.randint(0, 7 if depth < 3 else 4)
+        if kind == 0:
+            return int(rng.randint(-1000, 1000))
+        if kind == 1:
+            return rng.randn(int(rng.randint(1, 5)))
+        if kind == 2:
+            k = int(rng.randint(0, 100))
+            return lambda x, k=k: x + k
+        if kind == 3:
+            k = int(rng.randint(0, 100))
+            return Leaf(f"leaf{k}", functools.partial(lambda a, b: a * b, k))
+        if kind == 4:
+            return [rand_obj(depth + 1) for _ in range(int(rng.randint(1, 4)))]
+        if kind == 5:
+            return {f"k{i}": rand_obj(depth + 1)
+                    for i in range(int(rng.randint(1, 4)))}
+        return tuple(rand_obj(depth + 1) for _ in range(int(rng.randint(1, 3))))
+
+    def check(a, b):
+        assert type(a).__name__ == type(b).__name__, (a, b)
+        if isinstance(a, np.ndarray):
+            assert np.array_equal(a, b)
+        elif isinstance(a, (list, tuple)):
+            assert len(a) == len(b)
+            for x, y in zip(a, b):
+                check(x, y)
+        elif isinstance(a, dict):
+            assert a.keys() == b.keys()
+            for k in a:
+                check(a[k], b[k])
+        elif hasattr(a, "apply"):            # Leaf instance
+            assert a.tag == b.tag and a.apply(3) == b.apply(3)
+        elif callable(a) and not isinstance(a, type):
+            assert a(5) == b(5)
+        else:
+            assert a == b
+
+    for _ in range(25):
+        obj = rand_obj(0)
+        check(obj, S.loads(S.dumps(obj)))
