@@ -127,3 +127,21 @@ def test_odd_primitives_and_coalescing():
     dtc = to_datatype(np.dtype([("a", np.int8), ("b", np.int8)]))
     assert len(dtc.blocks) == 1
     assert dtc.blocks[0][2] == 2
+
+
+def test_dispatch_union_tuples():
+    """MPIInteger/MPIFloatingPoint/MPIComplex/MPIDatatype isinstance tuples
+    (ref src/buffers.jl:1-11; native Python scalars deliberately included —
+    the typed send path accepts them)."""
+    import numpy as np
+    import tpu_mpi as MPI
+    assert isinstance(3, MPI.MPIInteger)
+    assert isinstance(np.uint16(3), MPI.MPIInteger)
+    assert isinstance(2.5, MPI.MPIFloatingPoint)
+    assert isinstance(np.float32(2.5), MPI.MPIFloatingPoint)
+    assert isinstance(1j, MPI.MPIComplex)
+    assert isinstance(np.complex128(1j), MPI.MPIComplex)
+    assert isinstance(True, MPI.MPIDatatype)
+    assert isinstance(np.float64(1.0), MPI.MPIDatatype)
+    for bad in ("s", None, [1], object()):
+        assert not isinstance(bad, MPI.MPIDatatype), bad

@@ -39,6 +39,7 @@ from .comm import (COMM_NULL, COMM_SELF, COMM_TYPE_SHARED, COMM_WORLD,
 # Object model
 from .info import INFO_NULL, Info, infoval
 from .buffers import (BUFFER_NULL, Buffer, Buffer_send, DeviceBuffer, IN_PLACE,
+                      MPIComplex, MPIDatatype, MPIFloatingPoint, MPIInteger,
                       assert_minlength)
 from .datatypes import (BFLOAT16, BOOL, BYTE, CHAR, COMPLEX64, COMPLEX128,
                         Datatype, FLOAT16, FLOAT32, FLOAT64, Get_address,

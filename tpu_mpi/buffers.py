@@ -317,3 +317,16 @@ def to_wire(x: Any, count: Optional[int] = None) -> Any:
         flat = arr.reshape(-1)
         return flat if flat.size == count else flat[:count]
     return arr
+
+
+# The reference's dispatch unions (src/buffers.jl:1-11) as isinstance()
+# tuples. Deliberate divergences from the Julia unions: native Python
+# scalars (int/float/complex/bool) are included — the typed send path
+# accepts them — and numpy bools are in MPIDatatype (BOOL is a predefined
+# datatype here) while Julia's Char has no scalar Python analog (1-char
+# strings travel on the object path instead).
+MPIInteger = (int, np.int8, np.uint8, np.int16, np.uint16,
+              np.int32, np.uint32, np.int64, np.uint64)
+MPIFloatingPoint = (float, np.float32, np.float64, np.float16)
+MPIComplex = (complex, np.complex64, np.complex128)
+MPIDatatype = (bool, np.bool_) + MPIInteger + MPIFloatingPoint + MPIComplex
