@@ -345,6 +345,7 @@ def ingraph_collective_slope(variant: str, n_elems: int, nranks: int,
         "variant": variant,
         "bytes": nbytes,
         "nranks": nranks,
+        "per_fold_s": per_fold,          # unrounded, for derived math
         "k": sl["k"],
         "t_k_ms": sl["t_k_ms"], "t_2k_ms": sl["t_2k_ms"],
         "null_rtt_ms": round(rtt * 1e3, 2),
@@ -401,6 +402,7 @@ def control_block(n_elems: int = 1 << 26, gemm_m: int = 4096,
 
     ew_call(1)
     sl = adaptive_slope(lambda k: best_of_calls(ew_call, k, repeats), rtt)
+    out["hbm_per_step_s"] = sl["per_step_s"]   # unrounded, for derived math
     out["hbm_gbps_measured"] = round(2 * n_elems * 4 / sl["per_step_s"] / 1e9, 1)
     out["hbm_slope_spread"] = sl["slope_spread"]
 

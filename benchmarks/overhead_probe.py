@@ -19,13 +19,16 @@ per-op time of the device lane on the real chip into:
   D. ``fold4``             — the Allreduce combine itself, outside all MPI
                              machinery: one jitted 4-operand left-fold sum
                              (4 reads + 1 write = 5x payload), chained.
-  E. ``fused_elementwise`` — K=64 ``x+1`` steps inside ONE jit via fori_loop:
-                             amortizes the tunnel away; measures the chip's
-                             actual HBM rate under this harness (2x traffic).
-  F. ``fused_fold4``       — K=16 4-operand folds inside ONE jit (5x traffic
-                             per step): the *measured* execution roofline for
-                             the Allreduce fold, replacing the spec-sheet
-                             819 GB/s in the breakdown model.
+  E. ``fused_elementwise`` — in-jit chained ``x+1`` steps, ADAPTIVE slope
+                             (common.adaptive_slope via control_block):
+                             the chip's actual HBM rate under this harness
+                             (2x traffic). r5: the old fixed K=64 under a
+                             ~100 ms tunnel RTT dissolves into the floor.
+  F. ``fused_fold4``       — in-jit chained 4-operand folds, adaptive slope
+                             (common.ingraph_collective_slope — the bench
+                             headline lane): the *measured* execution
+                             roofline for the Allreduce fold, replacing the
+                             spec-sheet 819 GB/s in the breakdown model.
   G. ``mpi_allreduce``     — the full MPI.Allreduce device lane, 4 rank
                              threads (exactly bench.py's headline protocol,
                              shared impl in benchmarks/common.py).
@@ -61,8 +64,9 @@ for p in (_REPO, _HERE):
     if p not in sys.path:
         sys.path.insert(0, p)
 
-from common import (best_block, detect_platform, emit, host_allreduce_times,
-                    time_chain as _time_chain)
+from common import (best_block, control_block, detect_platform, emit,
+                    host_allreduce_times, ingraph_collective_slope,
+                    measure_null_rtt, time_chain as _time_chain)
 
 N_ELEMS = 1 << 26           # Float32[2^26] = 256 MiB, the headline payload
 NBYTES = N_ELEMS * 4
@@ -125,46 +129,6 @@ def case_fold4(jax, jnp) -> float:
     return _time_chain(step, force, WARMUP, ITERS, REPEATS)
 
 
-def case_fused_elementwise(jax, jnp, k: int = 64) -> float:
-    @jax.jit
-    def f(x):
-        return jax.lax.fori_loop(0, k, lambda i, a: a + 1.0, x)
-
-    box = [jnp.zeros(N_ELEMS, jnp.float32)]
-
-    def step():
-        box[0] = f(box[0])
-
-    def force(calls):
-        got = float(box[0][0])
-        assert got == float(calls * k), (got, calls)
-
-    per_call = _time_chain(step, force, 2, 3, 4)
-    return per_call / k
-
-
-def case_fused_fold4(jax, jnp, k: int = 16) -> float:
-    o1, o2, o3 = (jnp.ones(N_ELEMS, jnp.float32) for _ in range(3))
-
-    @jax.jit
-    def f(x, o1, o2, o3):
-        def body(i, a):
-            return a + o1 + o2 + o3     # 4 distinct reads + 1 write = 5x
-        return jax.lax.fori_loop(0, k, body, x)
-
-    box = [jnp.ones(N_ELEMS, jnp.float32)]
-
-    def step():
-        box[0] = f(box[0], o1, o2, o3)
-
-    def force(calls):
-        got = float(box[0][0])
-        assert got == float(1 + 3 * k * calls), (got, calls)
-
-    per_call = _time_chain(step, force, 2, 3, 4)
-    return per_call / k
-
-
 def case_floor_vs_size(jax, jnp) -> list[dict]:
     """Map the tunnel floor's operand-size step structure (the r3 sweep shows
     plateaus ~2 ms / ~10.7 ms / ~22 ms with jumps at 8 MiB and 128 MiB)."""
@@ -194,10 +158,13 @@ def main() -> None:
     _log(f"C elementwise_donate = {t_ewd * 1e3:.3f} ms")
     t_fold = case_fold4(jax, jnp)
     _log(f"D fold4              = {t_fold * 1e3:.3f} ms")
-    t_few = case_fused_elementwise(jax, jnp)
-    _log(f"E fused_elementwise  = {t_few * 1e3:.3f} ms/step")
-    t_ffold = case_fused_fold4(jax, jnp)
-    _log(f"F fused_fold4        = {t_ffold * 1e3:.3f} ms/step")
+    rtt = measure_null_rtt()
+    ctl = control_block(n_elems=N_ELEMS, rtt=rtt)
+    t_few = ctl["hbm_per_step_s"]           # unrounded slope
+    _log(f"E fused_elementwise  = {t_few * 1e3:.3f} ms/step (adaptive)")
+    ig = ingraph_collective_slope("allreduce", N_ELEMS, 4, rtt=rtt)
+    t_ffold = ig["per_fold_s"]              # unrounded slope
+    _log(f"F fused_fold4        = {t_ffold * 1e3:.3f} ms/step (adaptive)")
     size_rows = case_floor_vs_size(jax, jnp)
 
     _log("G mpi_allreduce (4 rank threads, device lane) ...")
@@ -211,8 +178,12 @@ def main() -> None:
         "tunnel_floor_ms": round(floor * 1e3, 3),
         "alloc_churn_ms": round((t_ew - t_ewd) * 1e3, 3),
         "mpi_overhead_ms": round((t_mpi - t_fold) * 1e3, 3),
-        "hbm_gbps_measured_elementwise": round(2 * NBYTES / t_few / 1e9, 1),
-        "hbm_gbps_measured_fold": round(5 * NBYTES / t_ffold / 1e9, 1),
+        "hbm_gbps_measured_elementwise": ctl["hbm_gbps_measured"],
+        # "implied": the 5x traffic model's rate; when the fold's working
+        # set stays VMEM-resident the model stops binding and this may
+        # legitimately exceed HBM peak — hbm_model_binds says which
+        "hbm_gbps_implied_fold": ig["hbm_gbps_implied"],
+        "hbm_model_binds": ig["hbm_model_binds"],
         "model_ms": round(model * 1e3, 3),
         "mpi_vs_model": round(t_mpi / model, 4),
         "mpi_algbw_gbps": round(NBYTES / t_mpi / 1e9, 3),
@@ -235,6 +206,9 @@ def main() -> None:
         },
         "floor_vs_size": size_rows,
         "derived": derived,
+        "control": ctl,
+        "ingraph_slope": {k: ig[k] for k in
+                          ("k", "slope_spread", "hbm_model_binds")},
     })
 
 

@@ -133,9 +133,10 @@ def test_dispatch_union_tuples():
     """MPIInteger/MPIFloatingPoint/MPIComplex/MPIDatatype isinstance tuples
     (ref src/buffers.jl:1-11; native Python scalars deliberately included —
     the typed send path accepts them)."""
-    import numpy as np
-    import tpu_mpi as MPI
     assert isinstance(3, MPI.MPIInteger)
+    # Python-ism, pinned: bool subclasses int, so it matches MPIInteger
+    # (unlike Julia's Bool) — dispatch must check bools first
+    assert isinstance(True, MPI.MPIInteger)
     assert isinstance(np.uint16(3), MPI.MPIInteger)
     assert isinstance(2.5, MPI.MPIFloatingPoint)
     assert isinstance(np.float32(2.5), MPI.MPIFloatingPoint)

@@ -324,7 +324,10 @@ def to_wire(x: Any, count: Optional[int] = None) -> Any:
 # scalars (int/float/complex/bool) are included — the typed send path
 # accepts them — and numpy bools are in MPIDatatype (BOOL is a predefined
 # datatype here) while Julia's Char has no scalar Python analog (1-char
-# strings travel on the object path instead).
+# strings travel on the object path instead). Python-ism to know: bool
+# subclasses int, so isinstance(True, MPIInteger) is True (Julia's Bool
+# is not in its MPIInteger) — dispatch that must distinguish bools checks
+# them BEFORE the integer union.
 MPIInteger = (int, np.int8, np.uint8, np.int16, np.uint16,
               np.int32, np.uint32, np.int64, np.uint64)
 MPIFloatingPoint = (float, np.float32, np.float64, np.float16)
