@@ -293,3 +293,28 @@ def test_randomized_nested_structures_roundtrip():
     for _ in range(25):
         obj = rand_obj(0)
         check(obj, S.loads(S.dumps(obj)))
+
+
+def test_local_class_inheritance_and_super():
+    """Local class hierarchies (incl. diamond MRO) ship by value with
+    ``super()`` intact — the zero-arg super relies on the ``__class__``
+    cell, which travels with the method's closure."""
+    class A:
+        def f(self):
+            return "A"
+
+    class B(A):
+        def f(self):
+            return "B" + super().f()
+
+    class C(A):
+        def f(self):
+            return "C" + super().f()
+
+    class D(B, C):
+        def f(self):
+            return "D" + super().f()
+
+    d = S.loads(S.dumps(D()))
+    assert d.f() == "DBCA"
+    assert [c.__name__ for c in type(d).__mro__[:4]] == ["D", "B", "C", "A"]
