@@ -233,6 +233,37 @@ def adaptive_slope(time_of: Callable[[int], float], rtt: float,
             "slopes_us": [round(s * 1e6, 2) for s in slopes]}
 
 
+def _fused_fold_impl():
+    """``pallas_kernels.fused_multi_reduce`` as a fold combine, when it can
+    run here: on a real TPU (Mosaic), or anywhere when the ``fused_fold``
+    config knob is "interp" (test-only — the interpreter is slow). Returns
+    None when the chained XLA fold should be used instead, which is the
+    fallback path the CPU-sim CI smoke exercises."""
+    import jax
+    from tpu_mpi import config
+    mode = config.load().fused_fold
+    if mode == "off":
+        return None
+    if mode != "interp" and jax.default_backend() != "tpu":
+        return None
+    from tpu_mpi.xla import pallas_kernels as pk
+    return lambda streams: pk.fused_multi_reduce(streams, "sum")
+
+
+# Human-readable HBM traffic model per in-graph variant, stated beside
+# hbm_model_binds in every row (ISSUE-1 satellite): what one fold reads and
+# writes, hence what "implied HBM" divides by.
+_TRAFFIC_MODELS = {
+    "allreduce": "(n+1)*bytes: n operand-stream reads + 1 result write",
+    "allreduce_fused": "(n+1)*bytes: n streams read once in a single fused "
+                       "pass + 1 result write",
+    "reducescatter": "(n+1)/n*bytes: n shard-slice reads + 1 shard write",
+    "allgather": "2*shard*n bytes: shard read + full concat write",
+    "ceiling_control": "(n+1)*bytes: same streams, best schedule, no MPI "
+                       "rank-order semantics",
+}
+
+
 def ingraph_collective_slope(variant: str, n_elems: int, nranks: int,
                              repeats: int = 3, rtt: "float | None" = None,
                              k_cap: int = 1 << 20) -> dict:
@@ -244,12 +275,16 @@ def ingraph_collective_slope(variant: str, n_elems: int, nranks: int,
 
     ``variant``:
 
-    - ``allreduce``     — the same rank-ordered left fold the host path's
+    - ``allreduce``       — the same rank-ordered left fold the host path's
       ``collective._jitted_fold`` compiles (nranks operand reads + 1 result
       write of the payload; roofline algbw = HBM/(nranks+1));
-    - ``reducescatter`` — this chip computes rank 0's shard: nranks
+    - ``allreduce_fused`` — identical fold semantics, combined by the
+      single-pass Pallas ``fused_multi_reduce`` kernel on TPU (the ISSUE-1
+      tentpole); off-TPU it runs the chained fallback and the row records
+      ``fused: false`` (the path the CPU-sim CI smoke checks);
+    - ``reducescatter``   — this chip computes rank 0's shard: nranks
       shard-slice reads + one shard write ((nranks+1)/nranks * payload);
-    - ``allgather``     — shard in, full concat out (~2x payload).
+    - ``allgather``       — shard in, full concat out (~2x payload).
 
     Honesty guards: contributions are runtime jit arguments (never
     constant-foldable); every fold adds a loop-index-derived term
@@ -267,15 +302,27 @@ def ingraph_collective_slope(variant: str, n_elems: int, nranks: int,
     opfn = MPI.SUM.fn
     shard = max(1, n_elems // nranks)
     nbytes = n_elems * 4
-    if variant == "allreduce":
+    fallback_fold = None                  # set for variants with two impls
+    fused_used = False
+    if variant in ("allreduce", "allreduce_fused"):
         peer_elems, acc_elems = n_elems, n_elems
         traffic = (nranks + 1) * nbytes
 
-        def one_fold(acc, peers, jf):
+        def chained_fold(acc, peers, jf):
             a = acc
             for o in peers:
                 a = opfn(a, o + jf)       # +j%2: iteration-dep., no LICM
             return a
+
+        one_fold = chained_fold
+        if variant == "allreduce_fused":
+            fused = _fused_fold_impl()
+            if fused is not None:
+                def one_fold(acc, peers, jf):
+                    # same rank-ordered left fold, single kernel pass
+                    return fused((acc,) + tuple(o + jf for o in peers))
+                fallback_fold = chained_fold
+                fused_used = True
 
         def expect_of(k):                 # closed-form value after k folds
             return float(1 + (nranks - 1) * (k + k // 2))
@@ -310,12 +357,15 @@ def ingraph_collective_slope(variant: str, n_elems: int, nranks: int,
     peers = tuple(jnp.ones(peer_elems, jnp.float32)
                   for _ in range(nranks - 1))
 
-    @jax.jit
-    def f(x, k, *ps):
-        def body(j, acc):
-            return one_fold(acc, ps, jnp.asarray(j % 2, jnp.float32))
-        return jax.lax.fori_loop(0, k, body, x)
+    def _make(fold):
+        @jax.jit
+        def f(x, k, *ps):
+            def body(j, acc):
+                return fold(acc, ps, jnp.asarray(j % 2, jnp.float32))
+            return jax.lax.fori_loop(0, k, body, x)
+        return f
 
+    f = _make(one_fold)
     x0 = jnp.ones(acc_elems, jnp.float32)
 
     def call(k):
@@ -329,13 +379,20 @@ def ingraph_collective_slope(variant: str, n_elems: int, nranks: int,
     def time_of(k):
         return best_of_calls(call, k, repeats)
 
-    call(1)                               # compile (dynamic k: one program)
+    try:
+        call(1)                           # compile (dynamic k: one program)
+    except Exception:
+        if fallback_fold is None:
+            raise
+        # fused kernel refused to compile here — chained fold, same numbers
+        f, fused_used = _make(fallback_fold), False
+        call(1)
     if rtt is None:
         rtt = measure_null_rtt()
     # keep the closed-form chain value float32-EXACT at the largest k the
     # slope can evaluate (2*k_cap): 1 + (nranks-1)*(2k + k) must stay under
     # 2^24, or the readback assert fires spuriously at high rank counts
-    if variant in ("allreduce", "reducescatter"):
+    if variant in ("allreduce", "allreduce_fused", "reducescatter"):
         k_cap = min(k_cap, ((1 << 24) - 2) // (3 * max(1, nranks - 1)))
     sl = adaptive_slope(time_of, rtt, k_cap=k_cap)
     per_fold = sl["per_step_s"]
@@ -353,6 +410,7 @@ def ingraph_collective_slope(variant: str, n_elems: int, nranks: int,
         "slopes_us": sl["slopes_us"],
         "per_fold_us": round(per_fold * 1e6, 2),
         "traffic_model_bytes": traffic,
+        "traffic_model": _TRAFFIC_MODELS[variant],
         "hbm_gbps_implied": round(implied, 1),
         # implied > HBM peak does NOT mean the timing lies — it means the
         # HBM traffic model stops binding at this size (the while-loop's
@@ -362,7 +420,135 @@ def ingraph_collective_slope(variant: str, n_elems: int, nranks: int,
         "hbm_model_binds": bool(implied <= 1.05 * hbm_spec),
         "algbw_gbps": round(nbytes / per_fold / 1e9, 3),
     }
+    if variant == "allreduce_fused":
+        out["fused"] = fused_used
     return out
+
+
+def ceiling_control_slope(n_elems: int, nranks: int, repeats: int = 3,
+                          rtt: "float | None" = None,
+                          k_cap: int = 1 << 20) -> dict:
+    """Best-achievable same-traffic ceiling (the ISSUE-1 control): a tuned
+    nranks-stream read-reduce-write with NO MPI semantics — the reduction
+    need not honor rank order, so any schedule XLA likes is fair — timed
+    under the IDENTICAL K-chained adaptive-slope protocol as the headline
+    fold. ``fold_vs_ceiling = headline algbw / ceiling algbw`` then says how
+    much of what this chip can physically do at this traffic pattern the
+    MPI-semantics fold achieves.
+
+    Candidate schedules: the rank-ordered left chain (what the fold itself
+    does) and a balanced pairwise tree (shorter dependence chain, same
+    traffic). The ceiling is the faster candidate. Honesty guards are the
+    headline lane's own: contributions are runtime jit arguments, every fold
+    adds the ``j mod 2`` iteration term, the fold count is a dynamic
+    argument of one compiled while-loop, and every call ends in a host
+    readback asserted against the closed-form chain value — which is
+    schedule-independent because the chain stays inside float32's
+    exact-integer range."""
+    import jax
+    import jax.numpy as jnp
+
+    nbytes = n_elems * 4
+    traffic = (nranks + 1) * nbytes
+
+    def chain(acc, peers, jf):
+        a = acc
+        for o in peers:
+            a = a + (o + jf)
+        return a
+
+    def tree(acc, peers, jf):
+        vals = [acc] + [o + jf for o in peers]
+        while len(vals) > 1:
+            nxt = [vals[i] + vals[i + 1] for i in range(0, len(vals) - 1, 2)]
+            if len(vals) % 2:
+                nxt.append(vals[-1])
+            vals = nxt
+        return vals[0]
+
+    peers = tuple(jnp.ones(n_elems, jnp.float32) for _ in range(nranks - 1))
+    x0 = jnp.ones(n_elems, jnp.float32)
+    if rtt is None:
+        rtt = measure_null_rtt()
+    # same float32-exactness clamp as the headline lane (values identical)
+    k_cap = min(k_cap, ((1 << 24) - 2) // (3 * max(1, nranks - 1)))
+
+    candidates = {}
+    for name, fold in (("chain", chain), ("tree", tree)):
+        @jax.jit
+        def f(x, k, *ps, _fold=fold):
+            def body(j, acc):
+                return _fold(acc, ps, jnp.asarray(j % 2, jnp.float32))
+            return jax.lax.fori_loop(0, k, body, x)
+
+        def call(k, _f=f):
+            y = _f(x0, k, *peers)
+            got, want = float(y[0]), float(1 + (nranks - 1) * (k + k // 2))
+            assert got == want, (
+                f"ceiling {name} chain readback {got} != {want} "
+                f"— the timed folds did not execute correctly")
+
+        call(1)
+        sl = adaptive_slope(lambda k: best_of_calls(call, k, repeats), rtt,
+                            k_cap=k_cap)
+        candidates[name] = {
+            "per_fold_s": sl["per_step_s"],
+            "per_fold_us": round(sl["per_step_s"] * 1e6, 2),
+            "k": sl["k"], "slope_spread": sl["slope_spread"],
+            "algbw_gbps": round(nbytes / sl["per_step_s"] / 1e9, 3),
+        }
+    best = min(candidates, key=lambda n: candidates[n]["per_fold_s"])
+    win = candidates[best]
+    return {
+        "variant": "ceiling_control",
+        "bytes": nbytes, "nranks": nranks,
+        "schedule": best,
+        "candidates": candidates,
+        "per_fold_s": win["per_fold_s"],
+        "per_fold_us": win["per_fold_us"],
+        "k": win["k"], "slope_spread": win["slope_spread"],
+        "null_rtt_ms": round(rtt * 1e3, 2),
+        "traffic_model_bytes": traffic,
+        "traffic_model": _TRAFFIC_MODELS["ceiling_control"],
+        "algbw_gbps": win["algbw_gbps"],
+        "readback_asserted": True,
+        "protocol": "adaptive_slope_chained",
+    }
+
+
+def fold_vs_ceiling(headline_algbw: float, ceiling: dict) -> float:
+    """The acceptance ratio: headline MPI-semantics fold algbw over the
+    same-traffic no-semantics ceiling's algbw."""
+    return round(headline_algbw / ceiling["algbw_gbps"], 4)
+
+
+def assert_artifact_schema(record: dict) -> None:
+    """Artifact-hygiene gate (CI bench-smoke; every sweep emit): fails
+    loudly on the regressions ISSUE-1 flags — duplicate per-size rows
+    within a lane, in-graph rows missing their honesty/traffic fields, or a
+    missing/incomplete ceiling-control block when the in-graph lane ran."""
+    lanes = record.get("lanes")
+    assert isinstance(lanes, dict) and lanes, "record has no lanes"
+    for name, rows in lanes.items():
+        if not isinstance(rows, list):
+            continue
+        sizes = [r["bytes"] for r in rows]
+        dup = sorted({b for b in sizes if sizes.count(b) > 1})
+        assert not dup, f"lane {name!r} has duplicate rows for bytes {dup}"
+        if name.startswith("ingraph"):
+            for r in rows:
+                for field in ("slope_spread", "traffic_model",
+                              "hbm_gbps_implied", "algbw_gbps"):
+                    assert field in r, f"lane {name!r} row missing {field!r}"
+    if any(n.startswith("ingraph") for n, r in lanes.items()
+           if isinstance(r, list) and r):
+        cc = record.get("ceiling_control")
+        assert isinstance(cc, dict), "missing ceiling_control block"
+        for field in ("schedule", "candidates", "slope_spread",
+                      "algbw_gbps", "readback_asserted"):
+            assert field in cc, f"ceiling_control missing {field!r}"
+        assert cc["readback_asserted"] is True
+        assert "fold_vs_ceiling" in record, "missing fold_vs_ceiling ratio"
 
 
 def control_block(n_elems: int = 1 << 26, gemm_m: int = 4096,

@@ -1287,6 +1287,9 @@ def test_rma_batched_read_epochs_under_contention():
                 MPI.Win_unlock(0, win)
                 assert a[0] == b[0], (a[0], b[0])
         MPI.Barrier(comm)   # phase boundary: the counter reuses cell 0
+        if rank == 0:
+            buf[:] = 0      # reset: the counter phase starts from known zero
+        MPI.Barrier(comm)
 
         # fetch-and-op counter: every rank adds its randomized series; the
         # fetched pre-values are only read AFTER unlock (batched)
@@ -1303,9 +1306,10 @@ def test_rma_batched_read_epochs_under_contention():
         my_tot = MPI.Allreduce(np.array([total], np.int64), MPI.SUM, comm)
         MPI.Barrier(comm)
         if rank == 0:
-            # cell 0 accumulated every rank's series on top of the last
-            # writer value; verify by resetting and replaying determinism
-            pass
+            # element-wise atomicity: cell 0 accumulated EXACTLY every
+            # rank's series (no fetch-add lost or doubled under the
+            # batched 1-RTT epochs) — it equals the Allreduce'd total
+            assert buf[0] == my_tot[0], (buf[0], my_tot)
         MPI.Barrier(comm)
 
         # flush mid-epoch completes batched reads (conforming RMW)
@@ -1370,3 +1374,84 @@ def test_spawn_closure_worker_across_processes():
     assert res.returncode == 0, (res.stdout, res.stderr)
     for r in range(2):
         assert f"SPAWN-CLOSURE-OK-{r}" in res.stdout, (res.stdout, res.stderr)
+
+
+def test_p2p_small_band_single_frame_mechanism():
+    """Regression pin for the 8 B - 4 KiB p50 cliff (ISSUE-1 tentpole d):
+    every typed payload in the band must encode to ONE joined fast-lane
+    buffer that fits the transport's single-recv window — so the whole band
+    moves with one writev and one tm_recv FFI call, and the p50 ladder has
+    no protocol step anywhere inside it. (Wall-clock monotonicity itself is
+    unassertable on a 1-core CI box; this pins the mechanism that produced
+    the cliff.)"""
+    import numpy as np
+    from tpu_mpi import backend
+    from tpu_mpi._native import NativeTransport
+    from tpu_mpi._runtime import Message
+
+    for nbytes in (8, 16, 64, 256, 512, 1024, 2048, 4096):
+        payload = np.arange(max(1, nbytes // 4), dtype=np.float32)
+        msg = Message(0, 7, 1, payload, int(payload.size), None, "typed")
+        parts = backend._fast_p2p_parts(msg, None)
+        assert parts is not None and len(parts) == 1, (nbytes, parts)
+        assert len(parts[0]) <= NativeTransport._RBUF_CAP, nbytes
+        dec = backend._fast_p2p_decode(memoryview(parts[0]))
+        assert dec is not None and dec.count == payload.size, nbytes
+        assert dec.src == 0 and dec.tag == 7 and dec.cid == 1
+        np.testing.assert_array_equal(np.asarray(dec.payload), payload)
+
+
+def test_rma_put_bulk_one_lepoch_frame_via_shm():
+    """Regression pin for RMA bulk-path unification (ISSUE-1 tentpole c): a
+    lock / Put(1 MiB) / unlock epoch to a same-host peer ships as exactly
+    ONE lepoch frame (no live lock round trip, no separate put frame) and
+    its payload takes the one-copy shm lane (exactly one segment spill)."""
+    res = _run_procs("""
+        import numpy as np
+        import tpu_mpi as MPI
+        from tpu_mpi import backend, _rma_wire
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+
+        n = (1 << 20) // 8
+        target = np.zeros(n, np.float64)
+        win = MPI.Win_create(target, comm)
+        src = np.ones(n, np.float64)
+        MPI.Barrier(comm)
+
+        if rank == 0:
+            ctx = _rma_wire.require_env()[0]
+            eng = _rma_wire._engine(ctx)
+            kinds = []
+            real_send = eng.send
+            def send_spy(world, item):
+                kinds.append(item[0])
+                real_send(world, item)
+            eng.send = send_spy
+            spills = [0]
+            real_spill = backend._shm_spill
+            def spill_spy(mv):
+                spills[0] += 1
+                return real_spill(mv)
+            backend._shm_spill = spill_spy
+            try:
+                MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 1, 0, win)
+                MPI.Put(src, n, 1, 0, win)
+                MPI.Win_unlock(1, win)
+            finally:
+                backend._shm_spill = real_spill
+                eng.send = real_send
+            assert kinds == ["lepoch"], kinds
+            assert spills[0] == 1, spills
+        MPI.Barrier(comm)
+        if rank == 1:
+            assert np.all(target == 1.0), target[:4]
+        MPI.Barrier(comm)
+        win.free()
+        print(f"RMA-SHM-FRAMES-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """, nprocs=2)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    for r in range(2):
+        assert f"RMA-SHM-FRAMES-OK-{r}" in res.stdout, (res.stdout, res.stderr)

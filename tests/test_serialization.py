@@ -318,3 +318,42 @@ def test_local_class_inheritance_and_super():
     d = S.loads(S.dumps(D()))
     assert d.f() == "DBCA"
     assert [c.__name__ for c in type(d).__mro__[:4]] == ["D", "B", "C", "A"]
+
+
+def test_shared_module_globals_one_dict_per_payload():
+    """Two by-value functions over the same source namespace reconstruct
+    onto ONE shared __globals__ dict, so a module-global one of them writes
+    is visible to the other — like functions sharing a module."""
+    src = ("state = {'n': 0}\n"
+           "def bump():\n"
+           "    state['n'] += 1\n"
+           "    return state['n']\n"
+           "def peek():\n"
+           "    return state['n']\n")
+    ns = {"__name__": "__main__"}
+    exec(src, ns)
+    bump, peek = pickle.loads(S.dumps((ns["bump"], ns["peek"])))
+    assert bump.__globals__ is peek.__globals__
+    bump()
+    bump()
+    assert peek() == 2
+    # a SECOND payload gets its own fresh namespace (no cross-payload leak)
+    bump2, peek2 = pickle.loads(S.dumps((ns["bump"], ns["peek"])))
+    assert bump2.__globals__ is not bump.__globals__
+    assert peek2() == 0
+
+
+def test_marshal_magic_tag_rejects_foreign_bytecode():
+    """Marshalled code carries the interpreter's pyc magic; a blob from a
+    different CPython raises a diagnosable MPIError instead of marshal's
+    opaque 'bad marshal data' ValueError."""
+    import importlib.util
+    from tpu_mpi.error import MPIError
+
+    blob = S._dump_code(compile("40 + 2", "<t>", "eval"))
+    assert blob[:len(importlib.util.MAGIC_NUMBER)] == \
+        importlib.util.MAGIC_NUMBER
+    assert eval(S._load_code(blob)) == 42
+    forged = b"\xde\xad\xbe\xef" + blob[4:]
+    with pytest.raises(MPIError, match="different interpreter"):
+        S._load_code(forged)

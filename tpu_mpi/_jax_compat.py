@@ -1,0 +1,83 @@
+"""Version shims for the jax API surface this package targets.
+
+The code base is written against the modern spelling ``jax.shard_map(f,
+mesh=..., in_specs=..., out_specs=..., check_vma=...)``.  Older jax
+releases (< 0.5) only ship ``jax.experimental.shard_map.shard_map`` with the
+replication check spelled ``check_rep``.  Importing this module arranges for
+a keyword-translating alias to appear at ``jax.shard_map`` when the
+top-level name is missing, so the rest of the package (and its
+tests/benchmarks, which import ``tpu_mpi`` before tracing) runs unmodified
+on either generation.
+
+``import tpu_mpi`` deliberately does not import jax (keeps the CLI/launcher
+import light), so the shim installs lazily: immediately when jax is already
+loaded, otherwise from a one-shot meta-path hook that fires as ``import
+jax`` completes.  Deliberately tiny: one attribute, added only when absent,
+delegating to the same underlying transform — not a reimplementation.
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib.abc
+import importlib.util
+import sys
+
+
+def _install_shims(jax) -> None:
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _legacy
+
+        @functools.wraps(_legacy)
+        def shard_map(f, /, *args, **kw):
+            if "check_vma" in kw:      # renamed from check_rep in newer jax
+                kw["check_rep"] = kw.pop("check_vma")
+            return _legacy(f, *args, **kw)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            # pre-0.5 spelling: core.axis_frame yields the static size of a
+            # bound axis (an int at trace time, same as lax.axis_size)
+            frame = jax.core.axis_frame(axis_name)
+            return frame if isinstance(frame, int) else frame.size
+
+        jax.lax.axis_size = axis_size
+
+
+class _ShimLoader(importlib.abc.Loader):
+    """Delegating loader that runs the shim after jax finishes executing."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def create_module(self, spec):
+        return self._inner.create_module(spec)
+
+    def exec_module(self, module):
+        self._inner.exec_module(module)
+        _install_shims(module)
+
+
+class _JaxImportHook(importlib.abc.MetaPathFinder):
+    def find_spec(self, name, path=None, target=None):
+        if name != "jax":
+            return None
+        sys.meta_path.remove(self)     # one-shot; also breaks the recursion
+        spec = importlib.util.find_spec("jax")
+        if spec is not None and spec.loader is not None:
+            spec.loader = _ShimLoader(spec.loader)
+        return spec
+
+
+def ensure() -> None:
+    """Install the shim now (if jax is loaded) or on jax import."""
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        _install_shims(jax)
+    elif not any(isinstance(f, _JaxImportHook) for f in sys.meta_path):
+        sys.meta_path.insert(0, _JaxImportHook())
+
+
+ensure()

@@ -106,7 +106,9 @@ class NativeTransport:
     # Frames at or under this size land in a reusable receive buffer via a
     # SINGLE tm_recv call (no tm_peek round trip, no per-frame allocation)
     # and are copied out; larger frames take the exact-size zero-copy path.
-    _RBUF_CAP = 4096
+    # 16 KiB (not 4): a 4 KiB payload plus fast-lane header must fit, or the
+    # 4 KiB ladder point pays a second FFI round trip and its p50 steps up.
+    _RBUF_CAP = 16384
 
     def __init__(self, rank: int, size: int):
         self._lib = load()
@@ -116,6 +118,7 @@ class NativeTransport:
         self.rank = rank
         self.size = size
         self._rbuf = None
+        self._rbuf_ptr = None
 
     @property
     def port(self) -> int:
@@ -190,9 +193,12 @@ class NativeTransport:
         rb = self._rbuf
         if rb is None:
             rb = self._rbuf = np.empty(self._RBUF_CAP, np.uint8)
+            # one ctypes cast for the life of the endpoint: data_as() builds
+            # a fresh c_void_p per call, measurable on the latency path
+            self._rbuf_ptr = rb.ctypes.data_as(ctypes.c_void_p)
         src = ctypes.c_int()
         length = ctypes.c_longlong()
-        rc = self._lib.tm_recv(self._h, rb.ctypes.data_as(ctypes.c_void_p),
+        rc = self._lib.tm_recv(self._h, self._rbuf_ptr,
                                self._RBUF_CAP, ctypes.byref(src),
                                ctypes.byref(length), timeout_ms,
                                1 if direct else 0)

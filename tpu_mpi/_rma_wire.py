@@ -494,18 +494,25 @@ def _materialize_lock(st: ProcWinState, world: int) -> None:
                        int(np.asarray(arr).size))
 
 
-def _op_bytes(op: tuple) -> int:
-    """Wire footprint of a deferred op: payload bytes for writes, the
+def _op_bytes(op: tuple, shm_min: int = 0) -> int:
+    """TCP-frame footprint of a deferred op: payload bytes for writes, the
     RESULT size for reads (a batched Get's data rides the unlock ack — it
     must count against the epoch bound too, or 16 huge reads would pickle
-    gigabytes into one response frame). Element size is conservatively 8
-    (the origin dtype is unknown here)."""
+    gigabytes into one response frame). Buffers at or above the shm
+    threshold never join a TCP frame — ``backend.dumps_oob_parts`` spills
+    them to the one-copy shm lane in BOTH directions (lepoch out, ack
+    back) — so they cost the frame bound nothing: a 4 MiB Put stays
+    deferred and ships as ONE lepoch frame instead of materializing a live
+    two-round-trip lock (ISSUE-1 bulk-path unification). Element size is
+    conservatively 8 (the origin dtype is unknown here)."""
+    def frame_cost(nb: int) -> int:
+        return 0 if (shm_min and nb >= shm_min) else nb
     if op[0] == "get":
-        return int(op[2]) * 8
+        return frame_cost(int(op[2]) * 8)
     nb = int(getattr(op[2], "nbytes", 0))
     if op[0] == "facc":
-        nb *= 2                          # payload out + fetched value back
-    return nb
+        return frame_cost(nb) * 2        # payload out + fetched value back
+    return frame_cost(nb)
 
 
 def _epoch_buffer(st: ProcWinState, world: int, op: tuple) -> bool:
@@ -515,18 +522,29 @@ def _epoch_buffer(st: ProcWinState, world: int, op: tuple) -> bool:
     ep = st.deferred.get(world)
     if ep is None:
         return False
-    nbytes = sum(_op_bytes(o) for o in ep["ops"])
+    ctx, _ = require_env()
+    from .backend import _shm_min_bytes  # deferred: backend imports us
+    shm_ok = getattr(ctx, "shm_ok", None)
+    shm_min = _shm_min_bytes() if (shm_ok is not None and shm_ok(world)) else 0
+    nbytes = sum(_op_bytes(o, shm_min) for o in ep["ops"])
     if (len(ep["ops"]) >= _EPOCH_MAX_OPS
-            or nbytes + _op_bytes(op) > _EPOCH_MAX_BYTES):
+            or nbytes + _op_bytes(op, shm_min) > _EPOCH_MAX_BYTES):
         _materialize_lock(st, world)
         return False
     if op[0] in _PAYLOAD_OPS:
-        # copy the payload: _origin_flat returns a VIEW for contiguous
-        # origins, and a deferred op ships at Win_unlock — without the
-        # copy, mutating the origin between Put/Accumulate and unlock
-        # would silently ship the mutated data (the eager path snapshots
-        # at call time; both paths must observe the same values)
-        op = op[:2] + (np.array(op[2], copy=True),) + op[3:]
+        nb = int(getattr(op[2], "nbytes", 0))
+        if not (shm_min and nb >= shm_min):
+            # snapshot small payloads: _origin_flat returns a VIEW for
+            # contiguous origins, and a deferred op ships at Win_unlock —
+            # without the copy, mutating the origin between Put/Accumulate
+            # and unlock would silently ship the mutated data (the eager
+            # path snapshots at call time; both paths should observe the
+            # same values when the user plays by MPI's rules)
+            op = op[:2] + (np.array(op[2], copy=True),) + op[3:]
+        # shm-lane payloads stay REFERENCED: MPI forbids modifying the
+        # origin until the epoch's closing synchronization, and the shm
+        # spill at unlock copies straight from the origin into the
+        # segment — the lane's single copy, not copy + pickle + socket
     ep["ops"].append(op)
     return True
 

@@ -405,8 +405,22 @@ class Mailbox(_Waitable):
         trip per message is measurable on 1-core hosts). Semantically
         identical to post_recv followed by wait_recv; blocking receives
         expose no cancel handle, so None is only a failure surface."""
-        pr = PendingRecv(src, tag, cid)
         with self.cond:
+            # exact-(src, tag) head match: the already-arrived case (the
+            # receiver runs behind the sender) completes with no PendingRecv
+            # allocation and no matches() calls. Only the queue HEAD is
+            # eligible — FIFO matching means an exact receive may not
+            # overtake an older queued message it also matches.
+            if self.queue and src >= 0 and not isinstance(tag, tuple):
+                m = self.queue[0]
+                if m.cid == cid and m.src == src and m.tag == tag:
+                    self.queue.pop(0)
+                    self.queued_bytes -= self._nbytes(m)
+                    self.cond.notify_all()   # senders blocked on capacity
+                    if self.drain_hook is not None:
+                        self.drain_hook(self.queued_bytes)
+                    return m
+            pr = PendingRecv(src, tag, cid)
             if self._match_or_subscribe_locked(pr):
                 return pr.msg
             return self._await_locked(pr)

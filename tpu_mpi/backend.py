@@ -233,25 +233,31 @@ def _is_jax(x: Any) -> bool:
 # ---------------------------------------------------------------------------
 
 _FAST_MAGIC = b"\x02TMP"
-# src, tag, cid-form (0: plain int in c1 | 1: the proc-tier tuple
+# magic, src, tag, cid-form (0: plain int in c1 | 1: the proc-tier tuple
 # ("c", rank, counter) in (c1, c2)), c1, c2, count, seq (-1 = unstamped),
-# kind (0 typed / 1 object-bytes), dtype tag length
-_FAST_HDR = struct.Struct("<iiBqqqqBB")
-_FAST_JOIN_MAX = 2048        # below this, join into ONE buffer: a single
+# kind (0 typed / 1 object-bytes), dtype tag length. The magic is part of
+# the struct so the header packs in ONE call (no bytes concat per message).
+_FAST_HDR = struct.Struct("<4siiBqqqqBB")
+_FAST_JOIN_MAX = 8192        # below this, join into ONE buffer: a single
                              # FFI call + write beats per-part view setup
+                             # (matches the transport's single-recv window)
 
-_fast_dt_cache: dict = {}    # dtype tag bytes -> Datatype (handful of entries)
+_fast_dt_tag: dict = {}      # np.dtype -> tag bytes (send side)
+_fast_dt_cache: dict = {}    # tag bytes -> (np.dtype, Datatype) (recv side)
 
 
 def _fast_p2p_parts(msg: Message, seq: Optional[int]) -> Optional[list]:
     """Encode a P2P message on the fast lane, or None if ineligible."""
     payload = msg.payload
     if msg.kind == "typed" and isinstance(payload, np.ndarray):
-        if payload.dtype.names is not None or payload.dtype.hasobject:
-            return None          # structured/object dtypes: .str is lossy
+        dt = _fast_dt_tag.get(payload.dtype)
+        if dt is None:
+            if payload.dtype.names is not None or payload.dtype.hasobject:
+                return None      # structured/object dtypes: .str is lossy
+            dt = payload.dtype.str.encode()
+            _fast_dt_tag[payload.dtype] = dt
         if not payload.flags.c_contiguous:
             payload = np.ascontiguousarray(payload)
-        dt = payload.dtype.str.encode()
         kind = 0
     elif msg.kind == "object" and isinstance(payload, (bytes, bytearray)):
         dt = b""
@@ -270,10 +276,9 @@ def _fast_p2p_parts(msg: Message, seq: Optional[int]) -> Optional[list]:
         cform, c1, c2 = 1, cid[1], cid[2]
     else:
         return None
-    hdr = (_FAST_MAGIC
-           + _FAST_HDR.pack(msg.src, msg.tag, cform, c1, c2, msg.count,
-                            -1 if seq is None else seq, kind, len(dt))
-           + dt)
+    hdr = _FAST_HDR.pack(_FAST_MAGIC, msg.src, msg.tag, cform, c1, c2,
+                         msg.count, -1 if seq is None else seq, kind,
+                         len(dt)) + dt
     if kind == 0:
         nbytes = payload.nbytes
         if nbytes <= _FAST_JOIN_MAX:
@@ -286,20 +291,22 @@ def _fast_p2p_parts(msg: Message, seq: Optional[int]) -> Optional[list]:
 
 def _fast_p2p_decode(frame) -> Optional[Message]:
     """Decode a fast-lane frame (memoryview) into a Message, or None."""
-    if bytes(frame[:4]) != _FAST_MAGIC:
+    if frame[:4] != _FAST_MAGIC:     # memoryview == bytes: no copy
         return None
-    (src, tag, cform, c1, c2, count, seq, kind,
-     dtlen) = _FAST_HDR.unpack_from(frame, 4)
+    (_, src, tag, cform, c1, c2, count, seq, kind,
+     dtlen) = _FAST_HDR.unpack_from(frame, 0)
     cid = c1 if cform == 0 else ("c", c1, c2)
-    off = 4 + _FAST_HDR.size
+    off = _FAST_HDR.size
     if kind == 0:
         dts = bytes(frame[off:off + dtlen])
-        dtype = _fast_dt_cache.get(dts)
-        if dtype is None:
+        cached = _fast_dt_cache.get(dts)
+        if cached is None:
             from .datatypes import to_datatype
-            dtype = to_datatype(np.dtype(dts.decode()))
-            _fast_dt_cache[dts] = dtype
-        payload = np.frombuffer(frame[off + dtlen:], dtype=dts.decode(),
+            np_dt = np.dtype(dts.decode())
+            cached = (np_dt, to_datatype(np_dt))
+            _fast_dt_cache[dts] = cached
+        np_dt, dtype = cached
+        payload = np.frombuffer(frame[off + dtlen:], dtype=np_dt,
                                 count=count)
         return Message(src, tag, cid, payload, count, dtype, "typed",
                        seq=None if seq < 0 else seq)
@@ -399,8 +406,10 @@ class _RemoteMailbox:
         # unless the payload should ride the shm lane instead (large +
         # same-host — the generic codec handles the spill)
         nbytes = getattr(msg.payload, "nbytes", None)
-        shm_wins = (nbytes is not None and ctx.shm_ok(self.world_rank)
-                    and (m := _shm_min_bytes()) and nbytes >= m)
+        # cheapest test first: small payloads (the latency path) resolve the
+        # whole predicate on the threshold compare alone
+        shm_wins = (nbytes is not None and (m := _shm_min_bytes())
+                    and nbytes >= m and ctx.shm_ok(self.world_rank))
         if not shm_wins:
             try:
                 parts = _fast_p2p_parts(msg, seq)

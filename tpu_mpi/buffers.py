@@ -227,6 +227,11 @@ def write_flat(dest: Any, src: Any, count: Optional[int] = None) -> Any:
             # strided-safe elementwise assignment
             dest[...] = srcarr.reshape(dest.shape).astype(dest.dtype, copy=False) \
                 if srcarr.shape != dest.shape else srcarr.astype(dest.dtype, copy=False)
+        elif dest.flags.c_contiguous:
+            # contiguous: reshape(-1) is a VIEW, and direct slice assignment
+            # is a memcpy — ndarray.flat's iterator assignment is ~8x slower
+            # at MiB sizes, which dominates the RMA bulk path
+            dest.reshape(-1)[:n] = srcarr.reshape(-1)[:n]
         else:
             # ndarray.flat is a logical C-order view regardless of the
             # underlying strides, so partial writes land at the right logical
@@ -254,9 +259,16 @@ def write_range(buf: Any, off: int, new: np.ndarray) -> None:
         arr = extract_array(buf)
         if arr is None:
             raise MPIError(f"cannot write into {type(buf).__name__}")
-        # .flat is a logical C-order view regardless of strides — reshape(-1)
-        # on a non-contiguous view would copy and silently drop the write
-        np.asarray(arr).flat[off:off + n] = new
+        tgt = np.asarray(arr)
+        if tgt.flags.c_contiguous:
+            # contiguous: reshape(-1) is a VIEW and slice assignment is a
+            # memcpy; .flat's iterator assignment is ~8x slower at MiB sizes
+            tgt.reshape(-1)[off:off + n] = new
+        else:
+            # .flat is a logical C-order view regardless of strides —
+            # reshape(-1) on a non-contiguous view would copy and silently
+            # drop the write
+            tgt.flat[off:off + n] = new
 
 
 def resolve_attached(attached, addr: int, who: str):

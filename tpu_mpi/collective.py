@@ -130,22 +130,55 @@ def _jitted_fold(arrs: Sequence[Any], op: Op, mode: str):
             for x in xs[1:]:
                 acc = op.fn(acc, x)
             return acc
+        # the Pallas single-pass kernel first (same left fold, explicit
+        # HBM schedule), the chained XLA fold as the compile fallback
+        candidates = [c for c in (_fused_reduce_candidate(op, arrs), fold)
+                      if c is not None]
     else:  # scan: all inclusive prefixes
         def fold(*xs):
             outs = [xs[0]]
             for x in xs[1:]:
                 outs.append(op.fn(outs[-1], x))
             return tuple(outs)
-    try:
-        jitted = jax.jit(fold)
-        out = jitted(*arrs)  # traces now; host-only ops raise here
-    except Exception:
-        jitted, out = _NOT_JITTABLE, _NOT_JITTABLE
+        candidates = [fold]
+    jitted = out = _NOT_JITTABLE
+    for cand in candidates:
+        try:
+            j = jax.jit(cand)
+            out = j(*arrs)  # traces now; host-only ops raise here
+            jitted = j
+            break
+        except Exception:
+            jitted, out = _NOT_JITTABLE, _NOT_JITTABLE
     with _fold_lock:
         _fold_compiled[key] = jitted
         while len(_fold_compiled) > _FOLD_CAP:
             _fold_compiled.popitem(last=False)
     return out
+
+
+def _fused_reduce_candidate(op: Op, arrs: Sequence[Any]):
+    """The Pallas fused multi-operand fold as a jit candidate for
+    mode="reduce" (the ISSUE-1 tentpole): one traversal reads all nranks
+    HBM streams and writes one output, replacing the chained elementwise
+    fold when the ``fused_fold`` config gate allows it. Returns None when
+    gated off or the operands don't fit the kernel's contract; any trace
+    failure falls back to the chained fold in the caller."""
+    from . import config
+    mode = config.load().fused_fold
+    if mode == "off":
+        return None
+    if len({(a.shape, str(a.dtype)) for a in arrs}) != 1:
+        return None                 # kernel folds same-shape streams only
+    import jax
+    if mode != "interp" and jax.default_backend() != "tpu":
+        return None                 # interpret machine is test-only slow
+
+    from .xla import pallas_kernels as pk
+
+    def fused(*xs):
+        return pk.fused_multi_reduce(xs, op)
+    return fused
 
 
 def _reduce_arrays(arrs: Sequence[Any], op: Op) -> Any:

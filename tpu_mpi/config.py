@@ -65,6 +65,12 @@ class Config:
     # per-(sender, dest, cid) sequence number and fail loudly on any
     # reordering/duplication/loss at delivery.
     debug_sequence_check: bool = False
+    # fused multi-operand reduction fold (xla.pallas_kernels
+    # .fused_multi_reduce) in the collective fold paths: "auto" = Pallas
+    # kernel on real TPU, chained XLA fold elsewhere; "off" = always the
+    # chained XLA fold; "interp" = force the kernel through the Pallas
+    # interpreter off-TPU too (test/debug only — orders of magnitude slow).
+    fused_fold: str = "auto"
 
     def replace(self, **kw: Any) -> "Config":
         d = {f.name: getattr(self, f.name) for f in fields(self)}
@@ -85,6 +91,7 @@ _ENV_MAP = {
     "shm_min_bytes": "TPU_MPI_SHM_MIN_BYTES",
     "send_highwater_bytes": "TPU_MPI_SEND_HIGHWATER_BYTES",
     "debug_sequence_check": "TPU_MPI_DEBUG_SEQUENCE",
+    "fused_fold": "TPU_MPI_FUSED_FOLD",
 }
 
 _lock = threading.Lock()

@@ -368,7 +368,8 @@ def Get(origin: Any, *args) -> None:
     ``Win_flush``) — the multi-process tier batches the read into the
     single unlock frame (1 round trip per uncontended epoch), so code that
     consumes the value mid-epoch must flush first, exactly as the standard
-    requires."""
+    requires. See ``docs/performance.md`` ("Batched read epochs") for the
+    epoch model and ("The shm bulk lane") for how large payloads travel."""
     if len(args) == 2:
         target_rank, win = args
         count, target_disp = element_count(origin), 0
@@ -464,7 +465,8 @@ def Fetch_and_op(sourceval: Any, returnval: Any, target_rank: int,
 
     Like :func:`Get`, the fetched value lands at the closing
     synchronization (unlock/flush) in a passive-target epoch — the op
-    batches into the unlock frame on the multi-process tier."""
+    batches into the unlock frame on the multi-process tier. See
+    ``docs/performance.md`` ("Batched read epochs")."""
     win._check()
     src = _origin_array(sourceval).reshape(-1)[:1]
     _apply_op(win, target_rank, target_disp, src, as_op(op), fetch_into=returnval)

@@ -55,7 +55,12 @@ def _psum_fwd(x, axis):
 
 
 def _psum_bwd(axis, _res, g):
-    from jax._src.lax.parallel import pvary
+    try:
+        from jax._src.lax.parallel import pvary
+    except ImportError:
+        # pre-vma jax has no varying-axes type system; the identity
+        # cotangent is already correct there.
+        return (g,)
     # the cotangent flows back identically to every tp rank; mark it varying
     # to match the primal input's type.
     return (pvary(g, axis),)

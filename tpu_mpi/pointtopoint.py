@@ -227,7 +227,11 @@ def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
 # ---------------------------------------------------------------------------
 
 def _send_typed(buf: Any, dest: int, tag: int, comm: Comm, block: bool) -> None:
-    count = element_count(buf)
+    arr0 = extract_array(buf)
+    if arr0 is None:
+        raise MPIError(f"not a communication buffer: {type(buf).__name__}",
+                       code=_ec.ERR_BUFFER)
+    count = int(arr0.size)
     if isinstance(buf, np.ndarray) and is_wire_snapshot(buf):
         # already a private to_wire snapshot (Sendrecv_replace /
         # Isendrecv_replace made it): re-snapshotting would just copy again
@@ -245,9 +249,8 @@ def _send_typed(buf: Any, dest: int, tag: int, comm: Comm, block: bool) -> None:
         # shm-lane bandwidth); pass the user's array straight to the codec.
         # Same-process destinations still snapshot: there the payload
         # object itself outlives the call inside the peer's mailbox.
-        arr = extract_array(buf)
-        if isinstance(arr, np.ndarray):
-            _post(comm, dest, tag, arr, count, to_datatype(arr.dtype),
+        if isinstance(arr0, np.ndarray):
+            _post(comm, dest, tag, arr0, count, to_datatype(arr0.dtype),
                   "typed", block=block, mb=mb, ctx=ctx)
             return
     arr = to_wire(buf, count)
@@ -310,15 +313,20 @@ def isend(obj: Any, dest: int, tag: int, comm: Comm) -> Request:
 # Blocking / nonblocking receive
 # ---------------------------------------------------------------------------
 
-def Recv(buf_or_type: Any, src: int, tag: int, comm: Comm):
+def Recv(buf_or_type: Any, src: int, tag: int, comm: Comm,
+         status: Optional[Status] = None):
     """``Recv(buf, src, tag, comm) -> Status`` fills an existing buffer
     (ref ``Recv!`` :271-281); ``Recv(T, src, tag, comm) -> (value, Status)``
-    receives one scalar of type T (:296-302)."""
+    receives one scalar of type T (:296-302).
+
+    ``status``: a caller-owned Status to fill IN PLACE and return instead of
+    allocating a fresh one per call (mpi4py's ``status=`` shape) — the
+    tight-receive-loop lane."""
     if isinstance(buf_or_type, type) or isinstance(buf_or_type, Datatype):
         import numpy as np
         dt = to_datatype(buf_or_type)
         tmp = np.zeros(1, dtype=dt.np_dtype)
-        st = Recv(tmp, src, tag, comm)
+        st = Recv(tmp, src, tag, comm, status)
         return (tmp[0].item() if dt.np_dtype.fields is None else tmp[0]), st
     if src == PROC_NULL:
         return Status(source=PROC_NULL, tag=ANY_TAG, count=0)
@@ -333,6 +341,13 @@ def Recv(buf_or_type: Any, src: int, tag: int, comm: Comm):
         raise TruncationError(
             f"message of {msg.count} elements truncated to {n}")
     write_flat(buf_or_type, msg.payload, msg.count)
+    if status is not None:
+        status.source = msg.src
+        status.tag = msg.tag
+        status.error = 0
+        status.count = msg.count
+        status.dtype = msg.dtype
+        return status
     return _status_of(msg)
 
 

@@ -31,6 +31,7 @@ from __future__ import annotations
 import builtins
 import dis
 import importlib
+import importlib.util
 import io
 import marshal
 import pickle
@@ -38,10 +39,38 @@ import sys
 import types
 from typing import Any, Callable, Optional
 
+from .error import ERR_TYPE, MPIError
+
 __all__ = ["dumps", "loads", "Pickler", "dumps_oob"]
 
 
 _GLOBAL_OPS = frozenset(("LOAD_GLOBAL", "STORE_GLOBAL", "DELETE_GLOBAL"))
+
+
+# -- marshal'd bytecode, tagged with the interpreter's magic -----------------
+# marshal's format is only stable within ONE CPython bytecode version; a
+# mixed-interpreter job would otherwise die in marshal.loads with a cryptic
+# "bad marshal data". The pyc magic number identifies the bytecode version
+# exactly, so prepending it turns that crash into a diagnosable error.
+
+_MAGIC = importlib.util.MAGIC_NUMBER
+
+
+def _dump_code(code: types.CodeType) -> bytes:
+    return _MAGIC + marshal.dumps(code)
+
+
+def _load_code(blob: bytes) -> types.CodeType:
+    n = len(_MAGIC)
+    if bytes(blob[:n]) != _MAGIC:
+        raise MPIError(
+            "by-value function was marshalled by a different interpreter "
+            f"(bytecode magic {bytes(blob[:n])!r}, this interpreter "
+            f"{_MAGIC!r}, Python {sys.version.split()[0]}): marshal'd "
+            "bytecode only round-trips between identical CPython versions — "
+            "run every rank of the job with the same interpreter",
+            code=ERR_TYPE)
+    return marshal.loads(blob[n:])
 
 
 def _global_names(code: types.CodeType) -> set:
@@ -111,9 +140,15 @@ def _reduce_cell(cell: types.CellType):
 # -- function reconstruction -------------------------------------------------
 
 def _make_function(code_bytes: bytes, name: str,
-                   cells: Optional[tuple]):
-    code = marshal.loads(code_bytes)
-    fglobals: dict = {"__builtins__": builtins}
+                   cells: Optional[tuple], fglobals: Optional[dict] = None):
+    code = _load_code(code_bytes)
+    # ``fglobals`` is the per-source-module namespace dict the Pickler
+    # threaded through every function from that module — pickle's memo makes
+    # all of them reconstruct to the SAME dict, so a global one function
+    # writes is visible to its siblings, like functions sharing a module.
+    if fglobals is None:
+        fglobals = {}
+    fglobals.setdefault("__builtins__", builtins)
     return types.FunctionType(code, fglobals, name, None, cells or None)
 
 
@@ -130,7 +165,7 @@ def _set_function_state(fn, st) -> None:
         fn.__annotations__ = st["annotations"]
 
 
-def _reduce_function(fn: types.FunctionType):
+def _reduce_function(fn: types.FunctionType, shared_globals: dict):
     code = fn.__code__
     fglobals = fn.__globals__
     globs = {name: fglobals[name]
@@ -146,7 +181,7 @@ def _reduce_function(fn: types.FunctionType):
         "annotations": dict(getattr(fn, "__annotations__", None) or {}),
     }
     return (_make_function,
-            (marshal.dumps(code), fn.__name__, fn.__closure__),
+            (_dump_code(code), fn.__name__, fn.__closure__, shared_globals),
             st, None, None, _set_function_state)
 
 
@@ -254,17 +289,33 @@ class Pickler(pickle.Pickler):
     so frames decode with plain :func:`pickle.loads` on the peer.
     """
 
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # id(fn.__globals__) -> the placeholder dict every by-value function
+        # from that namespace reconstructs its __globals__ into. Pickling the
+        # SAME dict object for each of them lets the memo share it, so two
+        # siblings from one module see each other's globals on the peer
+        # (one dict per source module per payload, fresh per payload).
+        self._shared_globals: dict = {}
+
+    def _globals_anchor(self, fn: types.FunctionType) -> dict:
+        key = id(fn.__globals__)
+        anchor = self._shared_globals.get(key)
+        if anchor is None:
+            anchor = self._shared_globals[key] = {}
+        return anchor
+
     def reducer_override(self, obj: Any):
         if isinstance(obj, types.FunctionType):
             if _by_value(obj):
-                return _reduce_function(obj)
+                return _reduce_function(obj, self._globals_anchor(obj))
             return NotImplemented
         if isinstance(obj, type):
             if _by_value(obj) and obj.__module__ != "builtins":
                 return _reduce_class(obj)
             return NotImplemented
         if isinstance(obj, types.CodeType):
-            return (marshal.loads, (marshal.dumps(obj),))
+            return (_load_code, (_dump_code(obj),))
         if isinstance(obj, types.ModuleType):
             return (importlib.import_module, (obj.__name__,))
         if isinstance(obj, property):

@@ -67,16 +67,34 @@ def _replicate(x: Any, axis: str):
 
 
 def _gather_reduce(x: Any, op: Op, axis: str):
-    """Generic rank-ordered reduction: all_gather + unrolled combine.
-    The per-rank unroll is static (axis size is known at trace time) and XLA
-    fuses it; this is the custom-op path (SURVEY.md: 'custom ops are strictly
-    easier on TPU')."""
+    """Generic rank-ordered reduction: all_gather + combine.
+    The combine is the single-pass Pallas fused fold when the ``fused_fold``
+    config gate allows it (one traversal over all n gathered streams — the
+    ISSUE-1 tentpole kernel), else an unrolled chained fold. The unroll is
+    static (axis size is known at trace time) and XLA fuses it; this is the
+    custom-op path (SURVEY.md: 'custom ops are strictly easier on TPU')."""
     lax = _lax()
     g = lax.all_gather(x, axis)          # (n, ...)
-    acc = g[0]
-    for i in range(1, g.shape[0]):
-        acc = op(acc, g[i])
+    acc = _fold_gathered(g, op)
     return _replicate(acc, axis)
+
+
+def _fold_gathered(g: Any, op: Op):
+    """Left fold over the leading (per-rank) axis of a gathered array —
+    fused Pallas kernel when gated on, chained combine otherwise. Both are
+    the same rank-ordered left fold, so results are bit-identical."""
+    streams = [g[i] for i in range(g.shape[0])]
+    from ..collective import _fused_reduce_candidate
+    fused = _fused_reduce_candidate(op, streams)
+    if fused is not None:
+        try:
+            return fused(*streams)
+        except Exception:
+            pass                         # Mosaic/interpret failure → chained
+    acc = streams[0]
+    for s in streams[1:]:
+        acc = op(acc, s)
+    return acc
 
 
 def _prod_native(x: Any, axis: Axis):
