@@ -1,0 +1,29 @@
+# nprocs: 2
+#
+# Clean fixture: uniform collective sequence with agreeing signatures.
+# Rank branches only do local work; every rank reaches the same
+# collectives in the same order with the same root/op/dtype. Must
+# produce zero lint and zero trace diagnostics.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+size = MPI.Comm_size(comm)
+
+data = np.full(4, float(rank + 1))
+if rank == 0:
+    local_note = "root prepares"
+else:
+    local_note = "worker prepares"
+
+MPI.Bcast(data, 0, comm)
+acc = np.zeros(4)
+MPI.Allreduce(data, acc, MPI.SUM, comm)
+MPI.Barrier(comm)
+total = np.zeros(4)
+MPI.Reduce(acc, total, MPI.SUM, 0, comm)
+gathered = np.zeros(4 * size)
+MPI.Allgather(data, gathered, 4, comm)
+MPI.Barrier(comm)

@@ -1,0 +1,31 @@
+# nprocs: 2
+#
+# Clean fixture: well-synchronized one-sided traffic. Puts in the same
+# fence epoch target disjoint ranges; the overlapping rewrite happens in
+# a later epoch (ordered by the fence); reads of one range are
+# concurrent Get/Get (no conflict); the shared counter is updated with
+# Accumulate (element-wise atomic, ordered). Must produce zero lint and
+# zero trace diagnostics.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+win = MPI.Win_create(np.zeros(8), comm)
+
+MPI.Win_fence(0, win)
+if rank == 0:
+    MPI.Put(np.ones(2), 2, 0, 0, win)
+else:
+    MPI.Put(np.full(2, 2.0), 2, 0, 4, win)
+MPI.Win_fence(0, win)
+if rank == 1:
+    MPI.Put(np.full(4, 3.0), 4, 0, 0, win)
+MPI.Win_fence(0, win)
+
+snapshot = np.zeros(4)
+MPI.Get(snapshot, 4, 0, 0, win)
+MPI.Accumulate(np.ones(2), 2, 1, 6, MPI.SUM, win)
+MPI.Win_fence(0, win)
+win.free()

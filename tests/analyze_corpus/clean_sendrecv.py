@@ -1,0 +1,33 @@
+# nprocs: 2
+#
+# Clean fixture: fully matched point-to-point traffic — a blocking
+# exchange ordered so one side sends first, a Sendrecv swap, and a
+# correctly synchronized Isend (buffer untouched until Wait). Must
+# produce zero lint and zero trace diagnostics.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+peer = 1 - rank
+
+out = np.full(4, float(rank))
+inbox = np.zeros(4)
+if rank == 0:
+    MPI.Send(out, peer, 10, comm)
+    MPI.Recv(inbox, peer, 10, comm)
+else:
+    MPI.Recv(inbox, peer, 10, comm)
+    MPI.Send(out, peer, 10, comm)
+
+swap_in = np.zeros(4)
+MPI.Sendrecv(out, peer, 20, swap_in, peer, 20, comm)
+
+payload = np.full(4, 7.0)
+req = MPI.Isend(payload, peer, 30, comm)
+nb_in = np.zeros(4)
+MPI.Recv(nb_in, peer, 30, comm)
+MPI.Wait(req)
+payload[0] = 0.0
+MPI.Barrier(comm)

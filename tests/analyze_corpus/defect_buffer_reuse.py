@@ -1,0 +1,21 @@
+# nprocs: 2
+#
+# Defect class: Isend buffer mutated before the Wait. The nonblocking
+# send only snapshots the buffer at Wait/consume time here, so the
+# in-flight message is corrupted — MPI forbids touching the buffer until
+# the request completes.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+if rank == 0:
+    payload = np.ones(4)
+    req = MPI.Isend(payload, 1, 3, comm)     # trace: T206
+    payload[0] = 99.0                        # lint: L106
+    MPI.Wait(req)
+else:
+    out = np.zeros(4)
+    MPI.Recv(out, 0, 3, comm)
+MPI.Barrier(comm)

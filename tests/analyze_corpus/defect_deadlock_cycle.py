@@ -1,0 +1,19 @@
+# nprocs: 4
+# raises: DeadlockError
+#
+# Defect class: blocking send/recv cycle. Every rank posts a blocking
+# receive from its left neighbour before any rank sends — a classic ring
+# deadlock. The traced runtime watchdog dumps each rank's pending
+# operation and the wait-for cycle.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+size = MPI.Comm_size(comm)
+left = (rank - 1) % size
+right = (rank + 1) % size
+inbox = np.zeros(1)
+MPI.Recv(inbox, left, 0, comm)           # lint: L107
+MPI.Send(np.ones(1), right, 0, comm)

@@ -1,0 +1,17 @@
+# nprocs: 2
+#
+# Defect class: same collective, disagreeing element dtype. Rank 0
+# broadcasts float32, rank 1 posts a float64 receive buffer — silent
+# precision mixups like this corrupt data without ever raising.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+if rank == 0:
+    msg32 = np.zeros(4, dtype=np.float32)
+    MPI.Bcast(msg32, 0, comm)        # trace: T202
+else:
+    msg64 = np.zeros(4, dtype=np.float64)
+    MPI.Bcast(msg64, 0, comm)        # lint: L103

@@ -1,0 +1,17 @@
+# nprocs: 2
+# raises: CollectiveMismatchError
+#
+# Defect class: rank-divergent collective sequence. Rank 0 enters Bcast
+# while rank 1 enters Barrier on the same communicator — the classic
+# "collective inside a rank branch" bug.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+buf = np.zeros(4)
+if rank == 0:
+    MPI.Bcast(buf, 0, comm)          # lint: L101
+else:
+    MPI.Barrier(comm)                # trace: T201

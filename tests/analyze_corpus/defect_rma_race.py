@@ -1,0 +1,20 @@
+# nprocs: 2
+#
+# Defect class: concurrent overlapping RMA inside one exposure epoch.
+# Both ranks Put into rank 1's window between the same pair of fences —
+# ranges [0, 4) and [2, 6) overlap on [2, 4) with no ordering, so the
+# final contents are timing-dependent.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+win = MPI.Win_create(np.zeros(8), comm)
+MPI.Win_fence(0, win)
+if rank == 0:
+    MPI.Put(np.ones(4), 4, 1, 0, win)            # trace: R301
+else:
+    MPI.Put(np.full(4, 2.0), 4, 1, 2, win)       # lint: L108  trace: R301
+MPI.Win_fence(0, win)
+win.free()

@@ -1,0 +1,18 @@
+# nprocs: 2
+# raises: CollectiveMismatchError
+#
+# Defect class: same collective, disagreeing root. Every rank reaches the
+# Bcast, but each names itself as the root, so the broadcast source is
+# ambiguous. The lint flags the branch disagreement statically; the trace
+# verifier flags the recorded root signatures cross-rank.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+buf = np.arange(4.0)
+if rank == 0:
+    MPI.Bcast(buf, 0, comm)          # trace: T202
+else:
+    MPI.Bcast(buf, 1, comm)          # lint: L102

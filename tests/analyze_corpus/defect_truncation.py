@@ -1,0 +1,18 @@
+# nprocs: 2
+# raises: TruncationError
+#
+# Defect class: receive-count truncation. The sender ships 8 elements on
+# tag 5 but the matching receive posts a 4-element buffer — real MPI
+# either truncates or errors (MPI_ERR_TRUNCATE); this runtime raises.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+if rank == 0:
+    big = np.ones(8)
+    MPI.Send(big, 1, 5, comm)
+else:
+    small = np.zeros(4)
+    MPI.Recv(small, 0, 5, comm)      # lint: L104

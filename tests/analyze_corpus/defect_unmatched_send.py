@@ -1,0 +1,18 @@
+# nprocs: 2
+#
+# Defect class: a send whose tag no receive ever matches. The tag-11
+# message is buffered by the eager protocol and silently lost; only the
+# tag-22 message is consumed.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+if rank == 0:
+    MPI.Send(np.ones(3), 1, 11, comm)    # lint: L105  trace: T203
+    MPI.Send(np.ones(3), 1, 22, comm)
+else:
+    out = np.zeros(3)
+    MPI.Recv(out, 0, 22, comm)
+MPI.Barrier(comm)

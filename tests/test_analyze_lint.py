@@ -1,0 +1,91 @@
+"""Static communication lint (tpu_mpi.analyze.lint) against the seeded
+defect corpus (tests/analyze_corpus/): every defect file must report
+exactly the codes marked by its ``# lint: Lxxx`` comments at exactly
+those lines, and the clean fixtures — plus the shipped examples and the
+tpu_mpi.parallel package — must produce zero diagnostics."""
+
+import glob
+import os
+import re
+
+import pytest
+
+from tpu_mpi.analyze import lint as alint
+from tpu_mpi.analyze.diagnostics import CODES
+
+CORPUS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "analyze_corpus")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFECTS = sorted(glob.glob(os.path.join(CORPUS, "defect_*.py")))
+CLEAN = sorted(glob.glob(os.path.join(CORPUS, "clean_*.py")))
+
+
+def marked(path, kind):
+    """Expected (code, line) pairs from ``# lint:`` / ``# trace:`` markers."""
+    out = []
+    with open(path) as f:
+        for lineno, text in enumerate(f, 1):
+            for m in re.finditer(r"(lint|trace):\s*([A-Z]\d+)", text):
+                if m.group(1) == kind:
+                    out.append((m.group(2), lineno))
+    return sorted(out)
+
+
+def test_corpus_is_complete():
+    # the seeded corpus must cover at least 8 distinct defect classes
+    assert len(DEFECTS) >= 8 and len(CLEAN) >= 2
+    codes = {c for p in DEFECTS for c, _ in marked(p, "lint")}
+    assert len(codes) >= 8, f"corpus exercises only {sorted(codes)}"
+
+
+@pytest.mark.parametrize("path", DEFECTS, ids=os.path.basename)
+def test_defect_is_flagged_at_marked_lines(path):
+    got = sorted((d.code, d.line) for d in alint.lint_paths([path]))
+    assert got == marked(path, "lint")
+
+
+@pytest.mark.parametrize("path", DEFECTS, ids=os.path.basename)
+def test_defect_diagnostics_carry_location_and_code(path):
+    for d in alint.lint_paths([path]):
+        assert os.path.abspath(d.file) == os.path.abspath(path)
+        assert d.line > 0
+        assert d.code in CODES
+        assert d.code in str(d) and f":{d.line}:" in str(d)
+        assert d.mpi_code > 0          # maps onto an MPI error class
+
+
+@pytest.mark.parametrize("path", CLEAN, ids=os.path.basename)
+def test_clean_fixture_has_zero_diagnostics(path):
+    assert alint.lint_paths([path]) == []
+
+
+def test_examples_are_clean():
+    diags = alint.lint_paths([os.path.join(REPO, "examples")])
+    assert diags == [], "\n".join(map(str, diags))
+
+
+def test_parallel_package_is_clean():
+    diags = alint.lint_paths([os.path.join(REPO, "tpu_mpi", "parallel")])
+    assert diags == [], "\n".join(map(str, diags))
+
+
+def test_syntax_error_reports_l100(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def oops(:\n")
+    (diag,) = alint.lint_paths([str(bad)])
+    assert diag.code == "L100"
+
+
+def test_cli_exit_codes(capsys):
+    assert alint.main([DEFECTS[0]]) == 1
+    text = capsys.readouterr().out
+    code = marked(DEFECTS[0], "lint")[0][0]
+    assert code in text and "diagnostic(s)" in text
+    assert alint.main([CLEAN[0]]) == 0
+
+
+def test_cli_shim_importable():
+    # `python -m tpu_mpi.lint` goes through this shim
+    from tpu_mpi import lint as shim
+    assert shim.main is alint.main and shim.lint_paths is alint.lint_paths

@@ -1,0 +1,208 @@
+"""RMA race detector (tpu_mpi.analyze.races): deterministic vector-clock
+unit tests on hand-built event streams, plus forced-interleaving SPMD
+runs (a threading.Barrier pins the schedule) exercising the fence and
+lock happens-before protocols end to end."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import tpu_mpi as MPI
+from tpu_mpi import analyze, config
+from tpu_mpi.analyze.events import Event, Tracer
+from tpu_mpi.analyze.races import detect_races
+from tpu_mpi.testing import run_spmd
+
+
+# ---------------------------------------------------------------------------
+# Unit tier: explicit vector clocks, no runtime involved
+# ---------------------------------------------------------------------------
+
+def _ev(origin, op, lo, hi, vc, t, target=1, win=7):
+    return Event("rma", origin, op=op, win=win, peer=target, lo=lo, hi=hi,
+                 vc=dict(vc), origin=origin, file=f"r{origin}.py",
+                 line=10 + origin, t=float(t))
+
+
+def _tracer(*events):
+    tr = Tracer(2, 64)
+    tr.rma_events.extend(events)
+    return tr
+
+
+def test_concurrent_overlapping_puts_race():
+    tr = _tracer(_ev(0, "Put", 0, 4, {0: 1}, 1.0),
+                 _ev(1, "Put", 2, 6, {1: 1}, 2.0))
+    (d,) = detect_races(tr)
+    assert d.code == "R301"
+    assert "[2, 4)" in d.message          # the actual overlap
+    assert d.related                       # points at the other access
+
+
+def test_ordered_puts_do_not_race():
+    # second access's clock dominates the first's component: happens-after
+    tr = _tracer(_ev(0, "Put", 0, 4, {0: 1}, 1.0),
+                 _ev(1, "Put", 2, 6, {0: 1, 1: 1}, 2.0))
+    assert detect_races(tr) == []
+
+
+def test_disjoint_ranges_do_not_race():
+    tr = _tracer(_ev(0, "Put", 0, 4, {0: 1}, 1.0),
+                 _ev(1, "Put", 4, 8, {1: 1}, 2.0))
+    assert detect_races(tr) == []
+
+
+def test_get_get_does_not_race_but_put_get_does():
+    tr = _tracer(_ev(0, "Get", 0, 4, {0: 1}, 1.0),
+                 _ev(1, "Get", 0, 4, {1: 1}, 2.0))
+    assert detect_races(tr) == []
+    tr = _tracer(_ev(0, "Put", 0, 4, {0: 1}, 1.0),
+                 _ev(1, "Get", 0, 4, {1: 1}, 2.0))
+    (d,) = detect_races(tr)
+    assert d.code == "R301"
+
+
+def test_accumulate_accumulate_is_ordered_by_definition():
+    tr = _tracer(_ev(0, "Accumulate", 0, 4, {0: 1}, 1.0),
+                 _ev(1, "Accumulate", 0, 4, {1: 1}, 2.0),
+                 _ev(1, "Fetch_and_op", 0, 1, {1: 2}, 3.0))
+    assert detect_races(tr) == []
+
+
+def test_accumulate_put_races():
+    tr = _tracer(_ev(0, "Accumulate", 0, 4, {0: 1}, 1.0),
+                 _ev(1, "Put", 0, 4, {1: 1}, 2.0))
+    (d,) = detect_races(tr)
+    assert d.code == "R301"
+
+
+def test_same_origin_never_races_with_itself():
+    tr = _tracer(_ev(0, "Put", 0, 4, {0: 1}, 1.0),
+                 _ev(0, "Put", 0, 4, {0: 2}, 2.0))
+    assert detect_races(tr) == []
+
+
+def test_different_windows_and_targets_do_not_race():
+    tr = _tracer(_ev(0, "Put", 0, 4, {0: 1}, 1.0, win=7),
+                 _ev(1, "Put", 0, 4, {1: 1}, 2.0, win=8))
+    assert detect_races(tr) == []
+    tr = _tracer(_ev(0, "Put", 0, 4, {0: 1}, 1.0, target=0),
+                 _ev(1, "Put", 0, 4, {1: 1}, 2.0, target=1))
+    assert detect_races(tr) == []
+
+
+def test_duplicate_pairs_are_deduped():
+    # same source lines racing twice -> one diagnostic, not four
+    tr = _tracer(_ev(0, "Put", 0, 4, {0: 1}, 1.0),
+                 _ev(0, "Put", 0, 4, {0: 2}, 2.0),
+                 _ev(1, "Put", 0, 4, {1: 1}, 3.0),
+                 _ev(1, "Put", 0, 4, {1: 2}, 4.0))
+    assert len(detect_races(tr)) == 1
+
+
+# ---------------------------------------------------------------------------
+# Integration tier: forced interleavings through the real runtime
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def traced(monkeypatch):
+    monkeypatch.setenv("TPU_MPI_TRACE", "1")
+    config.load(refresh=True)
+    yield
+    config.load(refresh=True)
+
+
+def _races_after(body, nprocs=2):
+    run_spmd(body, nprocs=nprocs)
+    return detect_races(analyze.last_trace())
+
+
+def test_fence_epoch_overlap_is_raced_exactly_once(traced):
+    step = threading.Barrier(2)        # pins both Puts inside one epoch
+
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        win = MPI.Win_create(np.zeros(8), comm)
+        MPI.Win_fence(0, win)
+        if rank == 0:
+            MPI.Put(np.ones(4), 4, 1, 0, win)
+        step.wait()
+        if rank == 1:
+            MPI.Put(np.full(4, 2.0), 4, 1, 2, win)
+        MPI.Win_fence(0, win)
+        win.free()
+
+    races = _races_after(body)
+    assert len(races) == 1 and races[0].code == "R301"
+
+
+def test_fence_separated_epochs_are_ordered(traced):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        win = MPI.Win_create(np.zeros(8), comm)
+        MPI.Win_fence(0, win)
+        if rank == 0:
+            MPI.Put(np.ones(4), 4, 1, 0, win)
+        MPI.Win_fence(0, win)
+        if rank == 1:
+            MPI.Put(np.full(4, 2.0), 4, 1, 2, win)
+        MPI.Win_fence(0, win)
+        win.free()
+
+    assert _races_after(body) == []
+
+
+def test_exclusive_locks_order_both_interleavings(traced):
+    # rank 0 always locks first (the barrier forces the schedule), so the
+    # detector must derive rank1-after-rank0 from the lock protocol alone.
+    turn = threading.Event()
+
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        win = MPI.Win_create(np.zeros(8), comm)
+        MPI.Win_fence(0, win)
+        if rank == 0:
+            MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 1, 0, win)
+            MPI.Put(np.ones(4), 4, 1, 0, win)
+            MPI.Win_unlock(1, win)
+            turn.set()
+        else:
+            turn.wait(timeout=30)
+            MPI.Win_lock(MPI.LOCK_EXCLUSIVE, 1, 0, win)
+            MPI.Put(np.full(4, 2.0), 4, 1, 2, win)
+            MPI.Win_unlock(1, win)
+        MPI.Win_fence(0, win)
+        win.free()
+
+    assert _races_after(body) == []
+
+
+def test_shared_locks_do_not_order_writers(traced):
+    # both writers under SHARED locks: lock protocol adds no cross edge,
+    # the overlap must still be flagged.
+    turn = threading.Event()
+
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        win = MPI.Win_create(np.zeros(8), comm)
+        MPI.Win_fence(0, win)
+        if rank == 0:
+            MPI.Win_lock(MPI.LOCK_SHARED, 1, 0, win)
+            MPI.Put(np.ones(4), 4, 1, 0, win)
+            MPI.Win_unlock(1, win)
+            turn.set()
+        else:
+            turn.wait(timeout=30)
+            MPI.Win_lock(MPI.LOCK_SHARED, 1, 0, win)
+            MPI.Put(np.full(4, 2.0), 4, 1, 2, win)
+            MPI.Win_unlock(1, win)
+        MPI.Win_fence(0, win)
+        win.free()
+
+    races = _races_after(body)
+    assert len(races) == 1 and races[0].code == "R301"

@@ -1,0 +1,159 @@
+"""Cross-rank trace verifier (tpu_mpi.analyze): run each corpus file on
+simulated ranks with tracing on, then check the verifier reports every
+``# trace: Txxx`` marker at its marked file:line (as the anchor or a
+related location) — and nothing at all on the clean fixtures. Also
+drives the 4-rank deliberate deadlock and asserts the watchdog dump
+names the blocked ranks, their pending operations, and the wait-for
+cycle."""
+
+import glob
+import os
+import re
+import runpy
+
+import numpy as np
+import pytest
+
+import tpu_mpi as MPI
+from tpu_mpi import analyze, config
+from tpu_mpi.error import DeadlockError
+from tpu_mpi.testing import run_spmd
+
+CORPUS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "analyze_corpus")
+DEFECTS = sorted(glob.glob(os.path.join(CORPUS, "defect_*.py")))
+CLEAN = sorted(glob.glob(os.path.join(CORPUS, "clean_*.py")))
+
+
+@pytest.fixture
+def traced(monkeypatch):
+    monkeypatch.setenv("TPU_MPI_TRACE", "1")
+    monkeypatch.setenv("TPU_MPI_DEADLOCK_TIMEOUT", "2.0")
+    config.load(refresh=True)
+    yield
+    config.load(refresh=True)
+
+
+def corpus_header(path):
+    """(nprocs, expected-exception-name-or-None) from the file header."""
+    nprocs, raises = 2, None
+    with open(path) as f:
+        for line in f:
+            m = re.match(r"#\s*nprocs:\s*(\d+)", line)
+            if m:
+                nprocs = int(m.group(1))
+            m = re.match(r"#\s*raises:\s*(\w+)", line)
+            if m:
+                raises = m.group(1)
+    return nprocs, raises
+
+
+def trace_marks(path):
+    out = []
+    with open(path) as f:
+        for lineno, text in enumerate(f, 1):
+            for m in re.finditer(r"trace:\s*([A-Z]\d+)", text):
+                out.append((m.group(1), lineno))
+    return out
+
+
+def run_corpus_file(path):
+    """Execute one corpus file per rank; returns (exception name, diags)."""
+    nprocs, _ = corpus_header(path)
+    err = None
+    try:
+        run_spmd(lambda: runpy.run_path(path, run_name="__main__"),
+                 nprocs=nprocs)
+    except Exception as e:          # noqa: BLE001 — corpus files are defects
+        err = e
+    return err, analyze.verify_trace(analyze.last_trace())
+
+
+def _hits(diags, path, code, line):
+    for d in diags:
+        if d.code != code:
+            continue
+        if os.path.abspath(d.file) == path and d.line == line:
+            return True
+        if any(os.path.abspath(f) == path and ln == line
+               for f, ln, _ in d.related):
+            return True
+    return False
+
+
+@pytest.mark.parametrize("path", DEFECTS, ids=os.path.basename)
+def test_defect_trace_markers(traced, path):
+    marks = trace_marks(path)
+    err, diags = run_corpus_file(path)
+    _, raises = corpus_header(path)
+    if raises is not None:
+        assert err is not None and type(err).__name__ == raises
+    else:
+        assert err is None, f"unexpected failure: {err!r}"
+    missing = [(c, ln) for c, ln in marks if not _hits(diags, path, c, ln)]
+    assert not missing, (f"expected {missing} in\n"
+                         + "\n".join(str(d) for d in diags))
+
+
+@pytest.mark.parametrize("path", CLEAN, ids=os.path.basename)
+def test_clean_fixture_traces_clean(traced, path):
+    err, diags = run_corpus_file(path)
+    assert err is None
+    assert diags == [], "\n".join(str(d) for d in diags)
+
+
+def test_tracing_off_records_nothing(monkeypatch):
+    monkeypatch.delenv("TPU_MPI_TRACE", raising=False)
+    config.load(refresh=True)
+    contexts = []
+
+    def body():
+        comm = MPI.COMM_WORLD
+        contexts.append(comm.ctx)
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs=2)
+    assert getattr(contexts[0], "_tracer", None) is None
+    config.load(refresh=True)
+
+
+def test_trace_ring_is_bounded(traced, monkeypatch):
+    monkeypatch.setenv("TPU_MPI_TRACE_BUFFER", "32")
+    config.load(refresh=True)
+
+    def body():
+        comm = MPI.COMM_WORLD
+        for _ in range(100):
+            MPI.Barrier(comm)
+
+    run_spmd(body, nprocs=2)
+    tr = analyze.last_trace()
+    assert len(tr.events(0)) <= 32
+    assert tr.dropped[0] > 0        # eviction is tracked, not silent
+
+
+def test_four_rank_deadlock_dump_names_ranks_ops_and_cycle(traced):
+    path = os.path.join(CORPUS, "defect_deadlock_cycle.py")
+
+    with pytest.raises(DeadlockError) as exc:
+        run_spmd(lambda: runpy.run_path(path, run_name="__main__"), nprocs=4)
+    msg = str(exc.value)
+    assert "per-rank pending operations:" in msg
+    for r in range(4):               # every blocked rank is named…
+        assert f"world rank {r}: blocked" in msg
+    assert "Recv(" in msg            # …with its pending operation…
+    assert "defect_deadlock_cycle.py" in msg     # …and the source line
+    assert "wait-for cycle: rank" in msg
+    ranks = re.findall(r"rank (\d)", msg.split("wait-for cycle:")[1])
+    assert len(ranks) == 5 and ranks[0] == ranks[-1]   # closed 4-cycle
+
+
+def test_deadlock_dump_absent_when_untraced(monkeypatch):
+    monkeypatch.delenv("TPU_MPI_TRACE", raising=False)
+    monkeypatch.setenv("TPU_MPI_DEADLOCK_TIMEOUT", "1.5")
+    config.load(refresh=True)
+    path = os.path.join(CORPUS, "defect_deadlock_cycle.py")
+    with pytest.raises(DeadlockError) as exc:
+        run_spmd(lambda: runpy.run_path(path, run_name="__main__"), nprocs=4)
+    assert "per-rank pending operations:" not in str(exc.value)
+    config.load(refresh=True)

@@ -19,9 +19,14 @@ from .implementations import Get_library_version, Get_version
 # Wildcards / sentinels
 from ._runtime import (ANY_SOURCE, ANY_TAG, PROC_NULL, UNDEFINED,
                        SpmdContext, spmd_run)
-from .error import (AbortError, CollectiveMismatchError, DeadlockError,
-                    Error_string, InvalidCommError, MPIError,
-                    TruncationError)
+from .error import (AbortError, AnalyzerError, CollectiveMismatchError,
+                    DeadlockError, Error_string, Get_error_string,
+                    InvalidCommError, MPIError, TruncationError)
+
+# Communication-correctness analysis (docs/analysis.md): static lint,
+# cross-rank trace verifier, RMA race detector.
+from . import analyze
+from .analyze import Diagnostic
 
 # Environment / lifecycle (src/environment.jl)
 from .environment import (Abort, Finalize, Finalized, Init, Init_thread,

@@ -44,7 +44,11 @@ def deadlock_timeout() -> float:
     (env var first for test-time overrides, then the config module) so a
     runtime change takes effect without re-importing. Cached on the exact
     env string + config generation (P2P hot path: this runs once per
-    blocking receive)."""
+    blocking receive).
+
+    When event tracing is on (config knob ``trace`` / env ``TPU_MPI_TRACE``),
+    the raised DeadlockError carries the tpu_mpi.analyze dump of per-rank
+    pending operations and the wait-for cycle — see docs/analysis.md."""
     global _dt_cache
     from . import config
     raw = os.environ.get("TPU_MPI_DEADLOCK_TIMEOUT")
@@ -64,6 +68,21 @@ def deadlock_timeout() -> float:
 
 
 _POLL = 0.02
+
+
+def raise_deadlock(ctx, msg: str) -> None:
+    """Raise DeadlockError, appending the tpu_mpi.analyze dump of per-rank
+    pending operations + the wait-for cycle when tracing recorded one
+    (docs/analysis.md). Never fails for a reason other than the deadlock."""
+    try:
+        from .analyze.matcher import deadlock_report
+        report = deadlock_report(ctx)
+    except Exception:
+        report = ""
+    if report:
+        msg = f"{msg}\n{report}"
+    raise DeadlockError(msg)
+
 
 _tls = threading.local()
 
@@ -126,7 +145,8 @@ class _Waitable:
             if remaining <= 0:
                 if timeout is not None:
                     return False
-                raise DeadlockError(f"deadlock suspected: blocked >{limit}s in {what}")
+                raise_deadlock(self.ctx,
+                               f"deadlock suspected: blocked >{limit}s in {what}")
             self.cond.wait(min(_POLL, remaining))
         return True
 
@@ -166,8 +186,8 @@ def pump_wait(ctx, cond, pred: Callable[[], bool], what: str, *,
             if time.monotonic() >= deadline:
                 if timeout is not None:
                     return False
-                raise DeadlockError(
-                    f"deadlock suspected: blocked >{budget}s in {what}")
+                raise_deadlock(
+                    ctx, f"deadlock suspected: blocked >{budget}s in {what}")
             cond.release()
             try:
                 pumped = ctx._direct_pump(0.02, pred)
@@ -619,6 +639,10 @@ class SpmdContext:
             ch = self._channels.get(cid)
             if ch is None:
                 ch = CollectiveChannel(self, size)
+                # identity for diagnostics (analyze.matcher reads the live
+                # contribs to name missing ranks in the deadlock dump)
+                ch.cid = cid
+                ch.group = group
                 self._channels[cid] = ch
             return ch
 

@@ -1,0 +1,47 @@
+"""tpu_mpi.analyze: communication-correctness tooling (docs/analysis.md).
+
+Three cooperating passes over one shared event IR:
+
+- **static lint** (:mod:`.lint`, CLI ``python -m tpu_mpi.lint file.py …``):
+  a CPython-``ast`` pass over SPMD programs flagging rank-divergent
+  collective sequences, root/op/dtype mismatches, recv truncation, unmatched
+  sends, Isend-buffer reuse before Wait, blocking cycles and static RMA
+  races — no runtime needed;
+- **trace verifier** (:mod:`.events` + :mod:`.matcher`): a low-overhead
+  tracing hook (config knob ``trace`` / env ``TPU_MPI_TRACE``) records
+  per-rank events from ``comm``/``collective``/``pointtopoint``/``onesided``
+  into ring buffers; the cross-rank matcher checks collective order and
+  signature agreement, pairs sends with receives, and renders the
+  DeadlockError dump of per-rank pending operations + the wait-for cycle;
+- **RMA race detector** (:mod:`.races`): vector-clock happens-before over
+  window epochs (Win_fence / Win_lock), flagging concurrent overlapping
+  Put/Put and Put/Get ranges inside one exposure epoch.
+
+This package stays import-light (stdlib + numpy): the lint CLI must start
+without touching jax, and the runtime hooks only pay for what they call.
+"""
+
+from __future__ import annotations
+
+from .diagnostics import CODES, Diagnostic
+
+__all__ = ["CODES", "Diagnostic", "lint_paths", "lint_source", "verify_trace",
+           "detect_races", "deadlock_report", "last_trace"]
+
+
+def __getattr__(name):
+    # lazy re-exports: keep `import tpu_mpi` from paying for the ast pass
+    # and keep hot modules' `from .analyze import events` cheap.
+    if name in ("lint_paths", "lint_source"):
+        from . import lint as _lint
+        return getattr(_lint, name)
+    if name in ("verify_trace", "deadlock_report"):
+        from . import matcher as _matcher
+        return getattr(_matcher, name)
+    if name == "detect_races":
+        from .races import detect_races
+        return detect_races
+    if name == "last_trace":
+        from .events import last_trace
+        return last_trace
+    raise AttributeError(f"module 'tpu_mpi.analyze' has no attribute {name!r}")

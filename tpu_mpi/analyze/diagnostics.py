@@ -1,0 +1,68 @@
+"""The shared diagnostic record every analyzer pass emits.
+
+One code space across the three passes (docs/analysis.md):
+
+- ``Lxxx`` — static lint (:mod:`tpu_mpi.analyze.lint`)
+- ``Txxx`` — cross-rank trace verifier (:mod:`tpu_mpi.analyze.matcher`)
+- ``Rxxx`` — RMA race detector (:mod:`tpu_mpi.analyze.races`)
+
+Each diagnostic projects onto an MPI error class
+(:data:`tpu_mpi.error.DIAGNOSTIC_CODES`), so ``Error_string`` /
+``MPIError.Get_error_string`` cover analyzer findings exactly like
+runtime-raised errors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+# code -> one-line description of the defect class.
+CODES = {
+    "L100": "source file could not be parsed",
+    "L101": "rank-divergent collective call sequence",
+    "L102": "collective root argument differs across rank branches",
+    "L103": "collective op/dtype argument differs across rank branches",
+    "L104": "receive count smaller than the matching send (truncation)",
+    "L105": "send with no matching receive",
+    "L106": "Isend buffer mutated before its Wait",
+    "L107": "blocking send/recv cycle pattern (deadlock)",
+    "L108": "overlapping RMA accesses in one exposure epoch",
+    "T201": "ranks called different collectives in the same round",
+    "T202": "collective signature (root/dtype/count) disagrees across ranks",
+    "T203": "sent message was never received",
+    "T206": "Isend buffer was modified before its Wait completed",
+    "R301": "concurrent overlapping RMA accesses (vector-clock race)",
+}
+
+
+@dataclass
+class Diagnostic:
+    """One analyzer finding, printable as ``file:line: CODE message``."""
+
+    code: str
+    message: str
+    file: str = "<unknown>"
+    line: int = 0
+    rank: Optional[int] = None
+    # rank-condition context (lint) or op detail (trace), human-readable.
+    context: str = ""
+    # related sites: (file, line, note) triples (e.g. the other racing access).
+    related: Tuple[tuple, ...] = field(default=())
+
+    @property
+    def mpi_code(self) -> int:
+        """The MPI error class this diagnostic projects onto."""
+        from ..error import diagnostic_error_code
+        return diagnostic_error_code(self.code)
+
+    def error(self):
+        """This diagnostic as a raisable :class:`tpu_mpi.error.AnalyzerError`."""
+        from ..error import AnalyzerError
+        return AnalyzerError(str(self), diag_code=self.code)
+
+    def __str__(self) -> str:
+        ctx = f" [{self.context}]" if self.context else ""
+        rel = "".join(f"\n    related: {f}:{ln}: {note}"
+                      for f, ln, note in self.related)
+        return f"{self.file}:{self.line}: {self.code} {self.message}{ctx}{rel}"

@@ -1,0 +1,245 @@
+"""Cross-rank trace verification + the DeadlockError wait-for dump.
+
+:func:`verify_trace` consumes one :class:`tpu_mpi.analyze.events.Tracer` and
+checks what no single rank can check alone:
+
+- **T201** — ranks of one communicator called *different* collectives in the
+  same round (aligned by absolute per-communicator round ordinals, so ring
+  eviction cannot misalign the comparison);
+- **T202** — same collective, disagreeing signature: root ranks, or
+  dtype/count where the caller supplied a precise signature (reductions,
+  Bcast — per-rank-varying Gatherv/Alltoallv counts are deliberately not
+  compared);
+- **T203** — a sent message that was never received (suppressed when the
+  receiver's ring overflowed: absence of evidence is not evidence);
+- plus any online findings the hooks queued (T206 Isend buffer mutation) and
+  the RMA race pass (:func:`tpu_mpi.analyze.races.detect_races`).
+
+:func:`deadlock_report` renders the per-rank pending operations and the
+wait-for cycle appended to DeadlockError messages by the runtime watchdog
+(``_runtime.raise_deadlock``).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+from .diagnostics import Diagnostic
+
+
+def _tracer_of(obj: Any) -> Optional[Any]:
+    from .events import Tracer, last_trace
+    if obj is None:
+        return last_trace()
+    if isinstance(obj, Tracer):
+        return obj
+    return getattr(obj, "_tracer", None)       # an SpmdContext
+
+
+def verify_trace(obj: Any = None) -> List[Diagnostic]:
+    """All trace-verifier diagnostics for ``obj`` (a Tracer, a context, or
+    None for the most recent traced run)."""
+    tr = _tracer_of(obj)
+    if tr is None:
+        return []
+    with tr.lock:
+        out = list(tr.diagnostics)
+    out += _check_collectives(tr)
+    out += _check_p2p(tr)
+    from .races import detect_races
+    out += detect_races(tr)
+    out.sort(key=lambda d: (d.file, d.line, d.code))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Collective order + signature agreement (T201 / T202)
+# ---------------------------------------------------------------------------
+
+def _check_collectives(tr) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    # (cid, group, round ordinal) names one rendezvous across ranks; the
+    # group tuple keeps same-cid-different-group comms (COMM_SELF is cid 1
+    # on every rank) from being cross-checked.
+    rounds: Dict[tuple, list] = defaultdict(list)
+    for ev in tr.events():
+        if ev.kind == "coll":
+            rounds[(ev.cid, ev.grp, ev.seq)].append(ev)
+    for (cid, grp, seq), evs in sorted(rounds.items(),
+                                       key=lambda kv: (kv[0][0], kv[0][2])):
+        if len(evs) < 2:
+            continue                 # size-1 groups have nothing to agree on
+        ops = {ev.op for ev in evs}
+        if len(ops) > 1:
+            by_op: Dict[str, list] = defaultdict(list)
+            for ev in evs:
+                by_op[ev.op].append(ev)
+            majority = max(by_op, key=lambda op: len(by_op[op]))
+            minority = [ev for ev in evs if ev.op != majority]
+            anchor = min(minority, key=lambda ev: ev.rank)
+            out.append(Diagnostic(
+                "T201",
+                f"world rank {anchor.rank} called {anchor.op!r} while "
+                f"rank(s) {sorted(ev.rank for ev in by_op[majority])} called "
+                f"{majority!r} in collective round {seq} of comm {cid}",
+                file=anchor.file, line=anchor.line, rank=anchor.rank,
+                context=f"group {list(grp)}"))
+            continue                 # signature checks presume one op
+        roots = {ev.root for ev in evs if ev.root is not None}
+        if len(roots) > 1:
+            anchor = min((ev for ev in evs if ev.root is not None),
+                         key=lambda ev: ev.rank)
+            out.append(Diagnostic(
+                "T202",
+                f"root argument disagrees across ranks in {anchor.op}: "
+                f"{sorted(roots)} (collective round {seq} of comm {cid})",
+                file=anchor.file, line=anchor.line, rank=anchor.rank,
+                context=f"group {list(grp)}"))
+        # dtype/count agreement is only meaningful for events carrying a
+        # precise signature (reductions and Bcast set one; Gatherv-family
+        # counts legitimately differ per rank and carry none).
+        sigged = [ev for ev in evs if ev.dtype is not None]
+        if len(sigged) > 1 and len({ev.dtype for ev in sigged}) > 1:
+            anchor = min(sigged, key=lambda ev: ev.rank)
+            out.append(Diagnostic(
+                "T202",
+                f"dtype disagrees across ranks in {anchor.op}: "
+                f"{sorted({ev.dtype for ev in sigged})} "
+                f"(collective round {seq} of comm {cid})",
+                file=anchor.file, line=anchor.line, rank=anchor.rank))
+        counted = [ev for ev in evs if ev.count is not None]
+        if len(counted) > 1 and len({ev.count for ev in counted}) > 1:
+            anchor = min(counted, key=lambda ev: ev.rank)
+            out.append(Diagnostic(
+                "T202",
+                f"element count disagrees across ranks in {anchor.op}: "
+                f"{sorted({ev.count for ev in counted})} "
+                f"(collective round {seq} of comm {cid})",
+                file=anchor.file, line=anchor.line, rank=anchor.rank))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Send/recv pairing (T203)
+# ---------------------------------------------------------------------------
+
+def _check_p2p(tr) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    # (cid, sender world rank, receiver world rank, delivered tag) ->
+    # per-direction counts. Recv events record the *delivered* message's
+    # concrete tag, so wildcard receives still land in the right bucket.
+    sends: Dict[tuple, list] = defaultdict(list)
+    recvs: Dict[tuple, int] = defaultdict(int)
+    dropped = dict(tr.dropped)
+    for ev in tr.events():
+        if ev.kind == "send":
+            sends[(ev.cid, ev.rank, ev.peer, ev.tag)].append(ev)
+        elif ev.kind == "recv" and ev.peer is not None:
+            recvs[(ev.cid, ev.peer, ev.rank, ev.tag)] += 1
+    for key, evs in sorted(sends.items(),
+                           key=lambda kv: (str(kv[0][0]), kv[0][1])):
+        cid, src, dst, tag = key
+        unmatched = len(evs) - recvs.get(key, 0)
+        if unmatched <= 0:
+            continue
+        if dropped.get(dst):
+            continue        # receiver's ring overflowed: recv may be evicted
+        for ev in evs[-unmatched:]:
+            out.append(Diagnostic(
+                "T203",
+                f"message sent by world rank {src} to world rank {dst} "
+                f"(tag={tag}, comm {cid}) was never received",
+                file=ev.file, line=ev.line, rank=src,
+                context=f"{len(evs)} send(s), {recvs.get(key, 0)} receive(s) "
+                        f"for this (source, destination, tag)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DeadlockError dump: per-rank pending operations + the wait-for cycle
+# ---------------------------------------------------------------------------
+
+def _waits_for(ctx, ev, blocked: Dict[int, Any]) -> List[int]:
+    """World ranks ``ev``'s blocked operation is waiting on."""
+    if ev.kind in ("send", "recv", "lock"):
+        if ev.peer is None:      # ANY_SOURCE: anyone blocked could unblock it
+            return [r for r in blocked if r != ev.rank]
+        return [ev.peer]
+    if ev.kind == "coll" and ev.grp:
+        # missing contributors of this round, read off the live channel
+        try:
+            from .._runtime import _EMPTY
+            ch = ctx._channels.get(ev.cid)
+            if ch is not None and len(ch.contribs) == len(ev.grp):
+                return [wr for i, wr in enumerate(ev.grp)
+                        if wr != ev.rank and ch.contribs[i] is _EMPTY]
+        except Exception:
+            pass
+        return [wr for wr in ev.grp if wr != ev.rank]
+    return []
+
+
+def _find_cycle(edges: Dict[int, List[int]]) -> Optional[List[int]]:
+    """One directed cycle in the wait-for graph, as a rank list."""
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {r: WHITE for r in edges}
+    path: List[int] = []
+
+    def dfs(r: int) -> Optional[List[int]]:
+        color[r] = GREY
+        path.append(r)
+        for nxt in edges.get(r, ()):
+            if nxt not in edges:
+                continue
+            if color[nxt] == GREY:
+                return path[path.index(nxt):]
+            if color[nxt] == WHITE:
+                cyc = dfs(nxt)
+                if cyc is not None:
+                    return cyc
+        path.pop()
+        color[r] = BLACK
+        return None
+
+    for r in sorted(edges):
+        if color[r] == WHITE:
+            cyc = dfs(r)
+            if cyc is not None:
+                return cyc
+    return None
+
+
+def deadlock_report(ctx: Any) -> str:
+    """Multi-line dump of per-rank pending operations and the wait-for
+    cycle, appended to DeadlockError messages when tracing is on. Returns
+    "" when there is nothing useful to say — never raises (this runs while
+    the job is already failing)."""
+    try:
+        tr = getattr(ctx, "_tracer", None)
+        if tr is None:
+            return ""
+        with tr.lock:
+            blocked = dict(tr.blocked)
+        if not blocked:
+            return ""
+        now = time.monotonic()
+        lines = ["per-rank pending operations:"]
+        edges: Dict[int, List[int]] = {}
+        for r in sorted(blocked):
+            ev = blocked[r]
+            lines.append(f"  world rank {r}: blocked {now - ev.t:.1f}s in "
+                         f"{ev.describe()} at {ev.file}:{ev.line}")
+            edges[r] = _waits_for(ctx, ev, blocked)
+        idle = [r for r in range(getattr(ctx, "size", 0)) if r not in blocked]
+        if idle:
+            lines.append(f"  rank(s) {idle} not blocked in any traced "
+                         f"operation")
+        cyc = _find_cycle(edges)
+        if cyc:
+            lines.append("wait-for cycle: "
+                         + " -> ".join(f"rank {r}" for r in cyc + [cyc[0]]))
+        return "\n".join(lines)
+    except Exception:
+        return ""

@@ -45,17 +45,32 @@ from .error import CollectiveMismatchError, MPIError
 from .operators import Op, as_op
 
 
-def _run(comm: Comm, contrib: Any, combine, opname: str, plan=None) -> Any:
+def _run(comm: Comm, contrib: Any, combine, opname: str, plan=None,
+         _sig=None) -> Any:
     # _ordered_run (defined with the nonblocking machinery below) keeps a
     # blocking collective from racing this rank's in-flight nonblocking
     # ones to the rendezvous: with outstanding work it runs through the
     # same single worker, preserving program order.
-    return _ordered_run(comm, lambda: comm.channel().run(
-        comm.rank(), contrib, combine, opname, plan=plan))
+    # ``_sig`` is the trace verifier's precise cross-rank-checkable
+    # signature (root/dtype/count) when the caller knows one.
+    from .analyze import events as _ev
+    if not _ev.enabled():
+        return _ordered_run(comm, lambda: comm.channel().run(
+            comm.rank(), contrib, combine, opname, plan=plan))
+    _ev.record_collective(comm, opname, sig=_sig)
+    from ._runtime import require_env
+    ctx, _ = require_env()
+    bev = _ev.blocked_event(comm, "coll", opname)
+    _ev.set_blocked(ctx, bev)
+    try:
+        return _ordered_run(comm, lambda: comm.channel().run(
+            comm.rank(), contrib, combine, opname, plan=plan))
+    finally:
+        _ev.clear_blocked(ctx, bev)
 
 
 def _run_rooted(comm: Comm, root: int, contrib: Any, combine, opname: str,
-                plan=None) -> Any:
+                plan=None, _sig=None) -> Any:
     """Rendezvous for rooted collectives: every rank ships its claimed root
     inside its contribution, and divergent roots raise CollectiveMismatchError
     on all ranks instead of silently electing whoever arrives first (the
@@ -74,7 +89,9 @@ def _run_rooted(comm: Comm, root: int, contrib: Any, combine, opname: str,
                 f"ranks disagree on the root of {opname}: {roots}")
         return combine([c for _, c in cs], roots[0])
 
-    return _run(comm, (root, contrib), outer, opname, plan=plan)
+    sig = dict(_sig or {})
+    sig.setdefault("root", root)
+    return _run(comm, (root, contrib), outer, opname, plan=plan, _sig=sig)
 
 
 _NOT_JITTABLE = object()
@@ -346,7 +363,10 @@ def Bcast(buf: Any, *args) -> Any:
         return [val] * len(cs)
 
     val = _run_rooted(comm, root, payload, combine, f"Bcast@{comm.cid}",
-                      plan=("bcast", root))
+                      plan=("bcast", root),
+                      _sig={"count": int(n),
+                            "dtype": str(getattr(extract_array(buf), "dtype",
+                                                 None))})
     if rank != root:
         write_flat(buf, val, n)
     return buf
@@ -787,13 +807,17 @@ def _reduce_family(args, has_root: bool, mode: str, name: str) -> Any:
             return [None, *_scan_arrays(cs[:-1], op)]
         raise AssertionError(mode)
 
+    sig = {"count": int(count),
+           "dtype": str(getattr(payload, "dtype", None))}
     if has_root:
-        result = _run_rooted(comm, root, payload, combine, f"{name}@{comm.cid}")
+        result = _run_rooted(comm, root, payload, combine, f"{name}@{comm.cid}",
+                             _sig=sig)
     else:
         # The multi-process tier runs large commutative Allreduce as a ring
         # reduce-scatter + allgather; order-sensitive modes stay on the star.
         plan = ("allreduce", op) if mode == "reduce" else None
-        result = _run(comm, payload, combine, f"{name}@{comm.cid}", plan=plan)
+        result = _run(comm, payload, combine, f"{name}@{comm.cid}", plan=plan,
+                      _sig=sig)
     i_get_result = (not has_root) or rank == root
     if mode == "exscan" and result is None:
         # rank 0's Exscan output is undefined (src/collective.jl:834-855);

@@ -232,8 +232,17 @@ def Comm_size(comm: Comm) -> int:
     return comm.size()
 
 
+def _record_coll(comm: Comm, opname: str) -> None:
+    """Trace hook for the comm-management collectives, which rendezvous
+    directly on the channel rather than through collective._run."""
+    from .analyze import events as _ev
+    if _ev.enabled():
+        _ev.record_collective(comm, opname)
+
+
 def Comm_dup(comm: Comm) -> Comm:
     """Collective: duplicate comm with a fresh context id (src/comm.jl:78-84)."""
+    _record_coll(comm, f"Comm_dup@{comm.cid}")
     my_rank = comm.rank()
     group = comm.group
 
@@ -249,6 +258,7 @@ def Comm_dup(comm: Comm) -> Comm:
 def Comm_split(comm: Comm, color: Optional[int], key: int) -> Comm:
     """Collective: partition ranks by color, order by (key, rank)
     (src/comm.jl:92-99). ``color=None`` (UNDEFINED) returns COMM_NULL."""
+    _record_coll(comm, f"Comm_split@{comm.cid}")
     my_rank = comm.rank()
     group = comm.group
     c = UNDEFINED if color is None else int(color)
@@ -408,6 +418,7 @@ def Comm_spawn(command, argv=None, maxprocs: int = 1, comm: Comm = COMM_WORLD,
     OS-process spawn has no ICI analog (SURVEY.md §2.2): new ranks join the
     same controller process as fresh rank-threads with their own COMM_WORLD,
     the host-level emulation the survey prescribes."""
+    _record_coll(comm, f"Comm_spawn@{comm.cid}")
     my_rank = comm.rank()
     parent_group = comm.group
     ctx = comm.ctx
@@ -486,6 +497,7 @@ def Intercomm_merge(intercomm: Intercomm, high: bool) -> Comm:
     if not isinstance(intercomm, Intercomm):
         raise MPIError("Intercomm_merge requires an intercommunicator",
                        code=_ec.ERR_COMM)
+    _record_coll(intercomm, f"Intercomm_merge@{intercomm.cid}")
     ctx = intercomm.ctx
     a, b, slot = intercomm.two_group_slots()
     _, world_rank = require_env()

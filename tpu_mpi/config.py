@@ -71,6 +71,13 @@ class Config:
     # chained XLA fold; "interp" = force the kernel through the Pallas
     # interpreter off-TPU too (test/debug only — orders of magnitude slow).
     fused_fold: str = "auto"
+    # communication-event tracing (tpu_mpi.analyze, docs/analysis.md):
+    # record per-rank event ring buffers consumed by the cross-rank trace
+    # verifier, the RMA race detector, and the DeadlockError dump of
+    # per-rank pending operations + the wait-for cycle.
+    trace: bool = False
+    # per-rank event ring-buffer capacity while tracing is on.
+    trace_buffer: int = 4096
 
     def replace(self, **kw: Any) -> "Config":
         d = {f.name: getattr(self, f.name) for f in fields(self)}
@@ -92,6 +99,8 @@ _ENV_MAP = {
     "send_highwater_bytes": "TPU_MPI_SEND_HIGHWATER_BYTES",
     "debug_sequence_check": "TPU_MPI_DEBUG_SEQUENCE",
     "fused_fold": "TPU_MPI_FUSED_FOLD",
+    "trace": "TPU_MPI_TRACE",
+    "trace_buffer": "TPU_MPI_TRACE_BUFFER",
 }
 
 _lock = threading.Lock()
@@ -102,16 +111,68 @@ def _toml_path() -> str:
     return os.path.expanduser(os.environ.get("TPU_MPI_CONFIG", _DEFAULT_TOML))
 
 
+def _parse_mini_toml(text: str) -> dict:
+    """Vendored minimal TOML reader for Python < 3.11 without tomli: flat
+    ``key = value`` pairs with string/bool/int/float values — exactly the
+    subset :func:`persist` writes. Tables, arrays and multi-line strings are
+    out of scope and rejected loudly rather than misread."""
+    out: dict[str, Any] = {}
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("["):
+            raise ValueError(f"line {lineno}: TOML tables are not supported "
+                             "by the vendored reader (install tomli)")
+        if "=" not in line:
+            raise ValueError(f"line {lineno}: expected 'key = value'")
+        key, _, val = line.partition("=")
+        key, val = key.strip(), val.strip()
+        if not key:
+            raise ValueError(f"line {lineno}: empty key")
+        if val.startswith('"'):
+            if len(val) < 2 or not val.endswith('"'):
+                raise ValueError(f"line {lineno}: unterminated string")
+            body = val[1:-1]
+            # unescape the two sequences persist() emits (plus common ones)
+            out[key] = (body.replace('\\"', '"').replace("\\\\", "\\")
+                        .replace("\\n", "\n").replace("\\t", "\t"))
+        elif val in ("true", "false"):
+            out[key] = val == "true"
+        else:
+            # strip an inline comment on non-string values
+            val = val.split("#", 1)[0].strip()
+            try:
+                out[key] = int(val)
+            except ValueError:
+                out[key] = float(val)   # ValueError propagates to the caller
+    return out
+
+
 def _read_toml(path: str) -> dict:
     try:
-        import tomllib
-    except ImportError:                      # py<3.11
-        return {}
+        import tomllib as _toml              # py>=3.11
+    except ImportError:
+        try:
+            import tomli as _toml            # the PyPI backport, if present
+        except ImportError:
+            _toml = None
+    if _toml is not None:
+        try:
+            with open(path, "rb") as f:
+                return _toml.load(f)
+        except FileNotFoundError:
+            return {}
+        except Exception as e:
+            raise MPIError(f"malformed config file {path!r}: {e}") from None
+    # py3.10 without tomli: the vendored flat-key reader
     try:
-        with open(path, "rb") as f:
-            return tomllib.load(f)
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
     except FileNotFoundError:
         return {}
+    try:
+        return _parse_mini_toml(text)
     except Exception as e:
         raise MPIError(f"malformed config file {path!r}: {e}") from None
 

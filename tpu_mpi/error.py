@@ -55,6 +55,8 @@ ERR_WIN = 53
 ERR_DEADLOCK = 64
 ERR_COLLECTIVE_MISMATCH = 65
 ERR_ABORTED = 66
+ERR_RMA_RACE = 67
+ERR_ANALYZE = 68
 
 _ERROR_STRINGS = {
     SUCCESS: "MPI_SUCCESS: no error",
@@ -101,7 +103,37 @@ _ERROR_STRINGS = {
                              "same round",
     ERR_ABORTED: "TPU_ERR_ABORTED: job fate-shared down by MPI.Abort or a "
                  "failing rank",
+    ERR_RMA_RACE: "TPU_ERR_RMA_RACE: concurrent overlapping RMA accesses in "
+                  "one exposure epoch (tpu_mpi.analyze race detector)",
+    ERR_ANALYZE: "TPU_ERR_ANALYZE: communication-correctness diagnostic "
+                 "(tpu_mpi.analyze)",
 }
+
+# tpu_mpi.analyze diagnostic code -> MPI error class. The analyzer's own
+# code space (Lxxx static lint, Txxx trace verifier, Rxxx race detector —
+# docs/analysis.md) projects onto the MPI classes above so FFI-shaped
+# callers can Error_string any Diagnostic.mpi_code.
+DIAGNOSTIC_CODES = {
+    "L100": ERR_ARG,                    # unparseable source
+    "L101": ERR_COLLECTIVE_MISMATCH,    # rank-divergent collective sequence
+    "L102": ERR_ROOT,                   # root mismatch across rank branches
+    "L103": ERR_TYPE,                   # op/dtype mismatch across branches
+    "L104": ERR_TRUNCATE,               # recv-count truncation
+    "L105": ERR_PENDING,                # send with no matching receive
+    "L106": ERR_BUFFER,                 # send-buffer reuse before Wait
+    "L107": ERR_DEADLOCK,               # blocking send/recv cycle pattern
+    "L108": ERR_RMA_RACE,               # static RMA epoch race
+    "T201": ERR_COLLECTIVE_MISMATCH,    # collective order mismatch (traced)
+    "T202": ERR_COLLECTIVE_MISMATCH,    # collective signature mismatch
+    "T203": ERR_PENDING,                # sent message never received
+    "T206": ERR_BUFFER,                 # Isend buffer modified before Wait
+    "R301": ERR_RMA_RACE,               # vector-clock RMA race
+}
+
+
+def diagnostic_error_code(diag_code: str) -> int:
+    """MPI error class for a tpu_mpi.analyze diagnostic code."""
+    return DIAGNOSTIC_CODES.get(str(diag_code), ERR_ANALYZE)
 
 
 class MPIError(RuntimeError):
@@ -118,6 +150,13 @@ class MPIError(RuntimeError):
 
     def __str__(self) -> str:  # pretty-print like src/error.jl:21-23
         return f"{self.args[0]} (code {self.code})"
+
+    def Get_error_string(self) -> str:
+        """The MPI_Error_string of this exception's error class — covers the
+        standard table, the runtime-specific classes, and every
+        tpu_mpi.analyze diagnostic (whose codes project onto MPI classes via
+        ``DIAGNOSTIC_CODES``)."""
+        return Error_string(self.code)
 
 
 class AbortError(MPIError):
@@ -162,8 +201,29 @@ class InvalidCommError(MPIError):
     CODE = ERR_COMM
 
 
+class AnalyzerError(MPIError):
+    """A communication-correctness diagnostic escalated to an exception.
+
+    Raised when tpu_mpi.analyze findings are surfaced as errors; ``code``
+    is the diagnostic's MPI error class (``diagnostic_error_code``), so
+    ``Get_error_string`` describes the underlying defect class."""
+
+    CODE = ERR_ANALYZE
+
+    def __init__(self, msg: str = "analyzer diagnostic",
+                 code: "int | None" = None, diag_code: "str | None" = None):
+        if code is None and diag_code is not None:
+            code = diagnostic_error_code(diag_code)
+        super().__init__(msg, code=code)
+        self.diag_code = diag_code
+
+
 def Error_string(code: int) -> str:
     """Human-readable description of an error code (src/error.jl:11-19
     ``error_string``). Covers every code the package raises — the full MPI
     error-class table plus the runtime-specific classes."""
     return _ERROR_STRINGS.get(int(code), f"unknown MPI error code {code}")
+
+
+# MPI-4 naming alias (mpi4py spells it Get_error_string on the module too).
+Get_error_string = Error_string

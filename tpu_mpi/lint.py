@@ -1,0 +1,10 @@
+"""``python -m tpu_mpi.lint file.py dir/ …`` — the static communication
+lint CLI (docs/analysis.md). Thin shim over :mod:`tpu_mpi.analyze.lint`."""
+
+from .analyze.lint import lint_paths, lint_source, main
+
+__all__ = ["lint_paths", "lint_source", "main"]
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main(sys.argv[1:]))

@@ -30,7 +30,8 @@ from typing import Any, Optional
 
 import numpy as np
 
-from ._runtime import require_env, deadlock_timeout, _POLL
+from ._runtime import require_env, deadlock_timeout, raise_deadlock, _POLL
+from .analyze import events as _ev
 from .buffers import (DeviceBuffer, extract_array, element_count,
                       resolve_attached, write_flat, write_range)
 from .comm import Comm
@@ -71,7 +72,7 @@ class _RWLock:
             while self.writer or (exclusive and self.readers > 0):
                 ctx.check_failure()
                 if time.monotonic() > deadline:
-                    raise DeadlockError("deadlock suspected: Win_lock blocked "
+                    raise_deadlock(ctx, "deadlock suspected: Win_lock blocked "
                                         f">{limit}s")
                 self.cond.wait(_POLL)
             if exclusive:
@@ -145,6 +146,8 @@ def _is_proc_mode(comm: Comm) -> bool:
 
 def _collective_state(comm: Comm, contrib, opname: str) -> Any:
     """One rendezvous that makes the last arriver build shared state."""
+    if _ev.enabled():
+        _ev.record_collective(comm, opname)
 
     def combine(cs):
         st = _WinState(len(cs), dynamic=all(c is None for c in cs))
@@ -276,9 +279,26 @@ def Win_fence(assert_: int, win: Win) -> None:
     since Put/Get complete synchronously in shared memory; multi-process
     windows first flush every dirty target over the wire."""
     win._check()
+    traced = _ev.enabled()
+    opname = f"Win_fence@{win.comm.cid}"
+    if traced:
+        _ev.record_collective(win.comm, opname)
+        _ev.fence_begin(win)
     if getattr(win._state, "is_proc", False):
         from ._rma_wire import proc_fence
         proc_fence(win)
+        if traced:
+            _ev.fence_end(win)
+        return
+    if traced:
+        bev = _ev.blocked_event(win.comm, "coll", opname)
+        _ev.set_blocked(win.comm.ctx, bev)
+        try:
+            win.comm.channel().run(win.comm.rank(), None,
+                                   lambda cs: [None] * len(cs), opname)
+        finally:
+            _ev.clear_blocked(win.comm.ctx, bev)
+        _ev.fence_end(win)
         return
     win.comm.channel().run(win.comm.rank(), None, lambda cs: [None] * len(cs),
                            f"Win_fence@{win.comm.cid}")
@@ -289,6 +309,8 @@ def Win_flush(rank: int, win: Win) -> None:
     Synchronous in shared memory; multi-process windows await the owner's
     FIFO ack, which completes every earlier op from this origin."""
     win._check()
+    if _ev.enabled():
+        _ev.record_sync(win, "Win_flush")
     if getattr(win._state, "is_proc", False):
         from ._rma_wire import proc_flush
         proc_flush(win._state, rank)
@@ -306,11 +328,23 @@ def Win_lock(lock_type: LockType, rank: int, assert_: int, win: Win) -> None:
     win._check()
     ctx, _ = require_env()
     excl = lock_type is LOCK_EXCLUSIVE or lock_type.val == LOCK_EXCLUSIVE.val
-    if getattr(win._state, "is_proc", False):
-        from ._rma_wire import proc_lock
-        proc_lock(win._state, int(rank), excl)
-    else:
-        win._state.user_locks[int(rank)].acquire(ctx, excl)
+    target_world = win.comm.world_rank_of(int(rank))
+    traced = _ev.enabled()
+    bev = None
+    if traced:
+        bev = _ev.blocked_event(win.comm, "lock", "Win_lock", peer=target_world)
+        _ev.set_blocked(ctx, bev)
+    try:
+        if getattr(win._state, "is_proc", False):
+            from ._rma_wire import proc_lock
+            proc_lock(win._state, int(rank), excl)
+        else:
+            win._state.user_locks[int(rank)].acquire(ctx, excl)
+    finally:
+        if traced:
+            _ev.clear_blocked(ctx, bev)
+    if traced:
+        _ev.lock_acquired(win, target_world, excl)
     win._held.append((int(rank), excl))
 
 
@@ -321,6 +355,8 @@ def Win_unlock(rank: int, win: Win) -> None:
     for i in range(len(win._held) - 1, -1, -1):
         if win._held[i][0] == rank:
             _, excl = win._held.pop(i)
+            if _ev.enabled():
+                _ev.lock_released(win, win.comm.world_rank_of(rank), excl)
             if getattr(win._state, "is_proc", False):
                 from ._rma_wire import proc_unlock
                 proc_unlock(win._state, rank, excl)
@@ -378,6 +414,9 @@ def Get(origin: Any, *args) -> None:
     else:
         raise TypeError("Get(origin, [count, rank, disp,] win)")
     win._check()
+    if _ev.enabled():
+        _ev.rma_access(win, "Get", win.comm.world_rank_of(int(target_rank)),
+                       int(target_disp), int(target_disp) + int(count))
     if getattr(win._state, "is_proc", False):
         from ._rma_wire import rma_get
         rma_get(win._state, origin, int(count), target_rank, target_disp)
@@ -399,6 +438,9 @@ def Put(origin: Any, *args) -> None:
         raise TypeError("Put(origin, [count, rank, disp,] win)")
     win._check()
     count = int(count)
+    if _ev.enabled():
+        _ev.rma_access(win, "Put", win.comm.world_rank_of(int(target_rank)),
+                       int(target_disp), int(target_disp) + count)
     if getattr(win._state, "is_proc", False):
         from ._rma_wire import rma_put
         rma_put(win._state, origin, count, target_rank, target_disp)
@@ -446,6 +488,10 @@ def Accumulate(origin: Any, count: int, target_rank: int, target_disp: int,
     """Atomically combine origin into the target range with op
     (src/onesided.jl:197-206)."""
     win._check()
+    if _ev.enabled():
+        _ev.rma_access(win, "Accumulate",
+                       win.comm.world_rank_of(int(target_rank)),
+                       int(target_disp), int(target_disp) + int(count))
     src = _origin_array(origin).reshape(-1)[:int(count)]
     _apply_op(win, target_rank, target_disp, src, as_op(op))
 
@@ -455,6 +501,10 @@ def Get_accumulate(origin: Any, result: Any, count: int, target_rank: int,
     """Fetch the old target values into result, then combine origin with op
     (src/onesided.jl:208-219)."""
     win._check()
+    if _ev.enabled():
+        _ev.rma_access(win, "Get_accumulate",
+                       win.comm.world_rank_of(int(target_rank)),
+                       int(target_disp), int(target_disp) + int(count))
     src = _origin_array(origin).reshape(-1)[:int(count)]
     _apply_op(win, target_rank, target_disp, src, as_op(op), fetch_into=result)
 
@@ -468,5 +518,9 @@ def Fetch_and_op(sourceval: Any, returnval: Any, target_rank: int,
     batches into the unlock frame on the multi-process tier. See
     ``docs/performance.md`` ("Batched read epochs")."""
     win._check()
+    if _ev.enabled():
+        _ev.rma_access(win, "Fetch_and_op",
+                       win.comm.world_rank_of(int(target_rank)),
+                       int(target_disp), int(target_disp) + 1)
     src = _origin_array(sourceval).reshape(-1)[:1]
     _apply_op(win, target_rank, target_disp, src, as_op(op), fetch_into=returnval)

@@ -98,7 +98,7 @@ class Request:
     """
 
     __slots__ = ("kind", "buffer", "status", "_pending", "_mailbox", "_count",
-                 "_done", "_inactive")
+                 "_done", "_inactive", "_trace_isend", "_trace_comm")
 
     def __init__(self, kind: str = "null", buffer: Any = None,
                  pending: Optional[PendingRecv] = None, mailbox=None,
@@ -109,6 +109,10 @@ class Request:
         self._pending = pending
         self._mailbox = mailbox
         self._count = count
+        # tpu_mpi.analyze hooks, populated only while tracing: the Isend
+        # buffer checksum (T206) and the comm a traced Irecv records against.
+        self._trace_isend = None
+        self._trace_comm = None
         self._done = kind in ("send", "null")
         # True once the completion has been surfaced to the caller: the
         # request then behaves like MPI_REQUEST_NULL (libmpi writes the null
@@ -129,6 +133,10 @@ class Request:
             write_flat(self.buffer, msg.payload, msg.count)
         self.status = _status_of(msg)
         self._done = True
+        if self._trace_comm is not None:
+            from .analyze import events as _ev
+            if _ev.enabled():
+                _ev.record_recv(self._trace_comm, msg, op="Irecv")
 
     def test(self) -> bool:
         """Nonblocking completion check; delivers on match."""
@@ -153,7 +161,21 @@ class Request:
             return self.status or STATUS_EMPTY
         if not self._done and self.kind == "recv":
             assert self._mailbox is not None and self._pending is not None
-            msg = self._mailbox.wait_recv(self._pending)
+            bev = None
+            if self._trace_comm is not None:
+                from .analyze import events as _ev
+                if _ev.enabled():
+                    pr = self._pending
+                    bev = _ev.blocked_event(
+                        self._trace_comm, "recv", "Wait(Irecv)",
+                        peer=None if pr.src == ANY_SOURCE else pr.src,
+                        tag=pr.tag)
+                    _ev.set_blocked(self._mailbox.ctx, bev)
+            try:
+                msg = self._mailbox.wait_recv(self._pending)
+            finally:
+                if bev is not None:
+                    _ev.clear_blocked(self._mailbox.ctx, bev)
             if msg is None:          # cancelled (src/pointtopoint.jl:677-681)
                 self.buffer = None
                 self.status = STATUS_EMPTY
@@ -166,6 +188,13 @@ class Request:
     def _consume(self) -> Status:
         """Surface the completion: clear the buffer root, go inactive."""
         st = self.status or STATUS_EMPTY
+        if self._trace_isend is not None:
+            # T206: re-checksum the Isend buffer before the root is cleared
+            from .analyze import events as _ev
+            from ._runtime import current_env
+            env = current_env()
+            if env is not None:
+                _ev.check_isend(env[0], self)
         self.buffer = None           # request deallocation clears the root
         self._inactive = True
         return st
@@ -212,12 +241,26 @@ def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
                   comm.cid, payload, count, dtype, kind)
     if mb is None:                       # _send_typed already resolved it
         mb = ctx.mailboxes[_resolve(comm, dest)]
+    from .analyze import events as _ev
+    traced = _ev.enabled()
+    if traced:
+        opname = (("Send" if block else "Isend") if kind == "typed"
+                  else ("send" if block else "isend"))
+        _ev.record_send(comm, dest, tag, count, dtype, op=opname)
     if block and hasattr(mb, "post_blocking"):
         # Flow control for blocking sends. Thread tier: admission-checked
         # against the destination queue under its lock. Multi-process tier:
         # choke/unchoke credit frames from the receiver pause this sender
         # while its unexpected queue is over the high-water mark.
-        mb.post_blocking(msg, "Send")
+        if traced:
+            bev = _ev.blocked_event(comm, "send", opname, peer=dest, tag=tag)
+            _ev.set_blocked(ctx, bev)
+            try:
+                mb.post_blocking(msg, "Send")
+            finally:
+                _ev.clear_blocked(ctx, bev)
+        else:
+            mb.post_blocking(msg, "Send")
     else:
         mb.post(msg)
 
@@ -276,7 +319,11 @@ def Isend(buf: Any, dest: int, tag: int, comm: Comm) -> Request:
     if dest == PROC_NULL:
         return Request("null", status=STATUS_EMPTY)
     _send_typed(buf, dest, tag, comm, block=False)
-    return Request("send", buffer=buf, status=STATUS_EMPTY)
+    req = Request("send", buffer=buf, status=STATUS_EMPTY)
+    from .analyze import events as _ev
+    if _ev.enabled():
+        _ev.note_isend(req, comm, buf)
+    return req
 
 
 def send(obj: Any, dest: int, tag: int, comm: Comm) -> None:
@@ -334,7 +381,20 @@ def Recv(buf_or_type: Any, src: int, tag: int, comm: Comm,
     # mailbox lock entry (direct-drain capable) — the small-message
     # latency lane (VERDICT r3 #4, r4 #5)
     mb = _my_mailbox(comm)
-    msg = mb.recv_blocking(int(src), int(tag), comm.cid)
+    from .analyze import events as _ev
+    if _ev.enabled():
+        ctx, _ = require_env()
+        bev = _ev.blocked_event(comm, "recv", "Recv",
+                                peer=None if src == ANY_SOURCE else src,
+                                tag=tag)
+        _ev.set_blocked(ctx, bev)
+        try:
+            msg = mb.recv_blocking(int(src), int(tag), comm.cid)
+        finally:
+            _ev.clear_blocked(ctx, bev)
+        _ev.record_recv(comm, msg, op="Recv")
+    else:
+        msg = mb.recv_blocking(int(src), int(tag), comm.cid)
     assert msg is not None            # blocking Recv exposes no cancel handle
     n = element_count(buf_or_type)
     if msg.count > n:
@@ -357,8 +417,12 @@ def Irecv(buf: Any, src: int, tag: int, comm: Comm) -> Request:
         return Request("null", status=Status(source=PROC_NULL, tag=ANY_TAG))
     mb = _my_mailbox(comm)
     pr = mb.post_recv(int(src), int(tag), comm.cid)
-    return Request("recv", buffer=buf, pending=pr, mailbox=mb,
-                   count=element_count(buf))
+    req = Request("recv", buffer=buf, pending=pr, mailbox=mb,
+                  count=element_count(buf))
+    from .analyze import events as _ev
+    if _ev.enabled():
+        req._trace_comm = comm
+    return req
 
 
 def recv(src: int, tag: int, comm: Comm):
@@ -367,7 +431,20 @@ def recv(src: int, tag: int, comm: Comm):
     if src == PROC_NULL:
         return None, Status(source=PROC_NULL, tag=ANY_TAG, count=0)
     mb = _my_mailbox(comm)
-    msg = mb.recv_blocking(int(src), int(tag), comm.cid)
+    from .analyze import events as _ev
+    if _ev.enabled():
+        ctx, _ = require_env()
+        bev = _ev.blocked_event(comm, "recv", "recv",
+                                peer=None if src == ANY_SOURCE else src,
+                                tag=tag)
+        _ev.set_blocked(ctx, bev)
+        try:
+            msg = mb.recv_blocking(int(src), int(tag), comm.cid)
+        finally:
+            _ev.clear_blocked(ctx, bev)
+        _ev.record_recv(comm, msg, op="recv")
+    else:
+        msg = mb.recv_blocking(int(src), int(tag), comm.cid)
     assert msg is not None
     return _object_of(msg), _status_of(msg)
 
@@ -384,6 +461,9 @@ def irecv(src: int, tag: int, comm: Comm):
     pr = mb.post_recv(msg.src, msg.tag, comm.cid)
     got = mb.wait_recv(pr)
     assert got is not None
+    from .analyze import events as _ev
+    if _ev.enabled():
+        _ev.record_recv(comm, got, op="irecv")
     return (True, _object_of(got), _status_of(got))
 
 
@@ -416,7 +496,19 @@ def Probe(src: int, tag: int, comm: Comm) -> Status:
     if src == PROC_NULL:
         return Status(source=PROC_NULL, tag=ANY_TAG, count=0)
     mb = _my_mailbox(comm)
-    msg = mb.probe(int(src), int(tag), comm.cid, block=True)
+    from .analyze import events as _ev
+    if _ev.enabled():
+        ctx, _ = require_env()
+        bev = _ev.blocked_event(comm, "recv", "Probe",
+                                peer=None if src == ANY_SOURCE else src,
+                                tag=tag)
+        _ev.set_blocked(ctx, bev)
+        try:
+            msg = mb.probe(int(src), int(tag), comm.cid, block=True)
+        finally:
+            _ev.clear_blocked(ctx, bev)
+    else:
+        msg = mb.probe(int(src), int(tag), comm.cid, block=True)
     assert msg is not None
     return _status_of(msg)
 
@@ -470,8 +562,7 @@ def _poll_ready(reqs: Sequence[Request]) -> list[int]:
     """Spin (with failure checks) until ≥1 *active* request completes.
     Returns [] when no request is active; raises DeadlockError after the
     runtime's deadlock timeout like every other blocking wait."""
-    from ._runtime import deadlock_timeout
-    from .error import DeadlockError
+    from ._runtime import deadlock_timeout, raise_deadlock
     ctx, _ = require_env()
     limit = deadlock_timeout()
     deadline = time.monotonic() + limit
@@ -483,8 +574,8 @@ def _poll_ready(reqs: Sequence[Request]) -> list[int]:
             return ready
         ctx.check_failure()
         if time.monotonic() > deadline:
-            raise DeadlockError(
-                f"deadlock suspected: blocked >{limit}s in Waitany/Waitsome")
+            raise_deadlock(
+                ctx, f"deadlock suspected: blocked >{limit}s in Waitany/Waitsome")
         time.sleep(_POLL)
 
 
@@ -762,9 +853,13 @@ class PartitionedRequest:
 
     def _drain_arrivals(self) -> None:
         mb = _my_mailbox(self.comm)
+        from .analyze import events as _ev
+        traced = _ev.enabled()
         still = []
         for pr in self._pending:
             if mb.test_recv(pr) and pr.msg is not None:
+                if traced:
+                    _ev.record_recv(self.comm, pr.msg, op="Precv")
                 self._accept(pr.msg.payload)
             else:
                 still.append(pr)
@@ -818,12 +913,16 @@ class PartitionedRequest:
             self.status = STATUS_EMPTY
         else:
             mb = _my_mailbox(self.comm)
+            from .analyze import events as _ev
+            traced = _ev.enabled()
             cancelled = False
             for pr in self._pending:
                 msg = mb.wait_recv(pr)
                 if msg is None:               # receive was cancelled
                     cancelled = True
                     continue
+                if traced:
+                    _ev.record_recv(self.comm, msg, op="Precv")
                 self._accept(msg.payload)
             self._pending = []
             if cancelled and len(self._arrived) < self.partitions:
