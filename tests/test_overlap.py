@@ -1,0 +1,348 @@
+"""Host-path overlap engine (ISSUE-3): chunk-pipelined collectives,
+persistent collective plans, persistent handles, and the background
+progress state that Wait/Test join.
+
+The load-bearing property throughout: pipelining is only applied to
+elementwise rank-order folds, where it is chunk-separable — the pipelined
+result must be BITWISE-identical to the monolithic one, across dtypes and
+array types, including payloads that don't divide evenly into chunks.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import tpu_mpi as MPI
+from tpu_mpi import config
+from tpu_mpi.buffers import DeviceBuffer, poison_fill
+from tpu_mpi.overlap import (ChunkSchedule, CollectivePlan, PlanCache,
+                             PersistentCollRequest, plans)
+from tpu_mpi.testing import aeq, run_spmd
+
+
+_PIPE_KNOBS = ("TPU_MPI_PIPELINE_MIN_BYTES", "TPU_MPI_PIPELINE_CHUNKS")
+
+
+class _pipeline:
+    """Context manager: set the pipeline knobs, refresh config, restore."""
+
+    def __init__(self, min_bytes, chunks=4):
+        self.vals = {"TPU_MPI_PIPELINE_MIN_BYTES": str(min_bytes),
+                     "TPU_MPI_PIPELINE_CHUNKS": str(chunks)}
+
+    def __enter__(self):
+        self.saved = {k: os.environ.get(k) for k in _PIPE_KNOBS}
+        os.environ.update(self.vals)
+        config.load(refresh=True)
+
+    def __exit__(self, *exc):
+        for k, v in self.saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        config.load(refresh=True)
+
+
+# ---------------------------------------------------------------------------
+# ChunkSchedule
+
+def test_chunk_schedule_covers_and_absorbs_remainder():
+    s = ChunkSchedule(10, 4)
+    assert s.bounds == [(0, 2), (2, 4), (4, 6), (6, 10)]
+    assert s.bounds[0][0] == 0 and s.bounds[-1][1] == 10
+    # contiguity: every chunk starts where the previous ended
+    for (_, hi), (lo, _) in zip(s.bounds, s.bounds[1:]):
+        assert hi == lo
+    # exact division: all chunks equal
+    assert ChunkSchedule(8, 4).bounds == [(0, 2), (2, 4), (4, 6), (6, 8)]
+    # more chunks than elements: clamps, never an empty chunk
+    s = ChunkSchedule(3, 16)
+    assert s.nchunks == 3 and s.bounds == [(0, 1), (1, 2), (2, 3)]
+    assert len(ChunkSchedule(1, 4)) == 1
+
+
+def test_chunk_schedule_maybe_gates_on_config():
+    with _pipeline(min_bytes=1024, chunks=4):
+        assert ChunkSchedule.maybe(1024, 1).nchunks == 4     # at threshold
+        assert ChunkSchedule.maybe(1023, 1) is None          # below
+        assert ChunkSchedule.maybe(128, 8).nchunks == 4      # itemsize counts
+    with _pipeline(min_bytes=0, chunks=4):                   # pipelining off
+        assert ChunkSchedule.maybe(1 << 30, 8) is None
+    with _pipeline(min_bytes=1024, chunks=1):                # K<2 means off
+        assert ChunkSchedule.maybe(1 << 30, 8) is None
+
+
+# ---------------------------------------------------------------------------
+# Pipelined == monolithic, bitwise
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32,
+                                   np.int64, np.complex64])
+def test_pipelined_allreduce_bitwise_equals_monolithic(nprocs, dtype):
+    n = 4099                                  # prime: 4099 % 4 != 0
+    def run_once():
+        def body():
+            comm = MPI.COMM_WORLD
+            rank = MPI.Comm_rank(comm)
+            rng = np.random.RandomState(17 + rank)
+            if np.issubdtype(dtype, np.complexfloating):
+                x = (rng.rand(n) + 1j * rng.rand(n)).astype(dtype)
+            elif np.issubdtype(dtype, np.floating):
+                x = rng.rand(n).astype(dtype)
+            else:
+                x = rng.randint(-1000, 1000, n).astype(dtype)
+            out = MPI.Allreduce(x, MPI.SUM, comm)
+            return np.asarray(out).copy()
+        return run_spmd(body, nprocs)
+
+    with _pipeline(min_bytes=1 << 60):        # monolithic reference
+        mono = run_once()
+    with _pipeline(min_bytes=256, chunks=4):  # pipelined
+        piped = run_once()
+    for m, p in zip(mono, piped):
+        assert m.dtype == p.dtype
+        assert m.tobytes() == p.tobytes()     # bitwise, not approx
+
+
+def test_pipelined_allreduce_device_buffers(AT, nprocs):
+    n = 5000
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        send = AT.full(n, rank + 1.0)
+        recv = AT.zeros(n)
+        MPI.Allreduce(send, recv, MPI.SUM, comm)
+        assert aeq(recv, np.full(n, float(sum(range(1, size + 1)))))
+        # MIN exercises a different ufunc through the same chunked fold
+        out = MPI.Allreduce(AT.full(n, float(rank)), MPI.MIN, comm)
+        assert aeq(out, np.zeros(n))
+
+    with _pipeline(min_bytes=256, chunks=8):
+        run_spmd(body, nprocs)
+
+
+def test_pipelined_skips_non_elementwise_custom_op(nprocs):
+    # a custom op may couple elements; the chunked fold must refuse it and
+    # the monolithic fold must still produce the right answer
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        last = MPI.Op(lambda a, b: b, commutative=False)
+        out = MPI.Allreduce(np.full(3000, float(rank)), last, comm)
+        assert aeq(out, np.full(3000, float(size - 1)))
+
+    with _pipeline(min_bytes=256, chunks=4):
+        run_spmd(body)
+
+
+def test_pipelined_scan_and_reduce_match_monolithic(nprocs):
+    # the chunked fold also backs Reduce and the scan family's rank-order
+    # folds — same bitwise guarantee
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        x = np.arange(3001, dtype=np.float64) * (rank + 1)
+        r = MPI.Reduce(x, MPI.SUM, 0, comm)
+        s = MPI.Scan(x, MPI.SUM, comm)
+        return (None if r is None else np.asarray(r).copy(),
+                np.asarray(s).copy())
+
+    with _pipeline(min_bytes=1 << 60):
+        mono = run_spmd(body, nprocs)
+    with _pipeline(min_bytes=256, chunks=4):
+        piped = run_spmd(body, nprocs)
+    for (mr, ms), (pr, ps) in zip(mono, piped):
+        assert (mr is None) == (pr is None)
+        if mr is not None:
+            assert mr.tobytes() == pr.tobytes()
+        assert ms.tobytes() == ps.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Plan cache
+
+def _mkplan(gen=None):
+    return CollectivePlan("SUM", MPI.SUM, lambda cs: cs[0], {}, None, None,
+                          config.GENERATION if gen is None else gen)
+
+
+def test_plan_cache_hit_miss_lru_and_invalidate():
+    pc = PlanCache()
+    k1 = (1, "Allreduce", MPI.SUM, 64, "float64", "ndarray")
+    assert pc.get(k1) is None                      # cold
+    p = _mkplan()
+    pc.put(k1, p)
+    assert pc.get(k1) is p                         # hit
+    assert pc.stats()["hits"] == 1
+    pc.invalidate(1)                               # Comm.free(cid=1)
+    assert pc.get(k1) is None
+    # stale generation misses and is evicted
+    pc.put(k1, _mkplan(gen=config.GENERATION - 1))
+    assert pc.get(k1) is None
+    assert pc.stats()["entries"] == 0
+    # unhashable keys never cache, never raise
+    pc.put((1, ["unhashable"]), p)
+    assert pc.get((1, ["unhashable"])) is None
+    # bounded: CAP+1 inserts evict the oldest
+    for i in range(PlanCache.CAP + 1):
+        pc.put((2, i), _mkplan())
+    assert pc.stats()["entries"] == PlanCache.CAP
+    assert pc.get((2, 0)) is None and pc.get((2, 1)) is not None
+
+
+def test_plan_cache_generation_invalidates_on_config_reload():
+    pc = PlanCache()
+    pc.put("k", _mkplan())
+    assert pc.get("k") is not None
+    config.load(refresh=True)                      # bumps GENERATION
+    assert pc.get("k") is None                     # knobs may have changed
+
+
+def test_repeated_allreduce_reuses_plan(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        x = np.full(512, rank + 1.0)
+        before = plans.stats()
+        for _ in range(3):                         # same signature 3x
+            out = MPI.Allreduce(x, MPI.SUM, comm)
+        after = plans.stats()
+        assert aeq(out, np.full(512, float(sum(range(1, size + 1)))))
+        # the training-loop case: repeats hit the cache
+        assert after["hits"] >= before["hits"] + 2
+        # a different shape is a different plan (no false sharing)
+        MPI.Allreduce(np.full(513, rank + 1.0), MPI.SUM, comm)
+
+    run_spmd(body, nprocs)
+
+
+def test_comm_free_invalidates_plans(nprocs):
+    def _cached_cids():
+        with plans._lock:
+            return {k[0] for k in plans._plans}
+
+    def body():
+        comm = MPI.COMM_WORLD
+        dup = MPI.Comm_dup(comm)
+        MPI.Allreduce(np.full(256, 1.0), MPI.SUM, dup)
+        cid = dup.cid
+        assert cid in _cached_cids()
+        MPI.Barrier(comm)          # everyone observed the plan before frees
+        MPI.free(dup)
+        assert cid not in _cached_cids()
+
+    run_spmd(body, nprocs)
+
+
+# ---------------------------------------------------------------------------
+# Background progress: Iallreduce completes while the rank computes
+
+def test_iallreduce_progresses_without_wait(nprocs):
+    import time
+
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        n = 200_000
+        req = MPI.Iallreduce(np.full(n, rank + 1.0), MPI.SUM, comm)
+        # spin on local compute; Test() only OBSERVES — the per-comm worker
+        # and its chunk pipeline must finish the op on their own
+        deadline = time.monotonic() + 60.0
+        acc = 0.0
+        while not req.test():
+            acc += float(np.dot(np.ones(64), np.ones(64)))
+            assert time.monotonic() < deadline, "no background progress"
+        size = MPI.Comm_size(comm)
+        assert aeq(req.result, np.full(n, float(sum(range(1, size + 1)))))
+        prog = req.progress
+        assert prog is not None and prog.stage == "done"
+        if prog.total:
+            assert prog.done == prog.total
+        return (prog.total, prog.done)
+
+    with _pipeline(min_bytes=1024, chunks=4):
+        out = run_spmd(body, nprocs)
+    # the fold runs on exactly one rank's worker (the last arriver); that
+    # rank's progress record must show the full chunk schedule
+    assert any(total >= 2 and done == total for total, done in out), out
+
+
+# ---------------------------------------------------------------------------
+# Persistent collectives (MPI-4 *_init family)
+
+def test_persistent_allreduce_rounds(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        send = np.zeros(8)
+        req = MPI.Allreduce_init(send, MPI.SUM, comm)
+        assert isinstance(req, PersistentCollRequest) and not req.active
+        assert MPI.Wait(req) is not None           # wait-on-inactive: no-op
+        for it in range(3):                        # reusable across rounds
+            send[:] = rank + 1.0 + it
+            MPI.Start(req)
+            MPI.Wait(req)
+            expect = sum(r + 1.0 + it for r in range(size))
+            assert aeq(req.result, np.full(8, expect))
+        with pytest.raises(MPI.MPIError):          # Start while active
+            MPI.Start(req)
+            MPI.Start(req)
+        MPI.Wait(req)
+
+    run_spmd(body, nprocs)
+
+
+def test_persistent_bcast_barrier_and_startall(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        buf = np.full(4, float(rank))
+        rb = MPI.Bcast_init(buf, 0, comm)
+        rr = MPI.Barrier_init(comm)
+        MPI.Startall([rb, rr])                     # same order on all ranks
+        MPI.Waitall([rb, rr])
+        assert aeq(buf, np.zeros(4))
+        assert not rb.active and not rr.active
+        buf[:] = float(rank) + 10.0                # second round, same handle
+        if rank != 0:
+            buf[:] = -1.0
+        MPI.Start(rb)
+        MPI.Wait(rb)
+        assert aeq(buf, np.full(4, 10.0))
+        with pytest.raises(MPI.MPIError):
+            rb.cancel()
+
+    run_spmd(body, nprocs)
+
+
+# ---------------------------------------------------------------------------
+# Strict-mode sentinel poison (satellite: batched-read RMA origins)
+
+def test_poison_fill_per_dtype():
+    f = np.ones(4, np.float64)
+    poison_fill(f)
+    assert np.all(np.isnan(f))
+    c = np.ones(3, np.complex128)
+    poison_fill(c)
+    assert np.all(np.isnan(c.real)) and np.all(np.isnan(c.imag))
+    i = np.zeros(4, np.int64)
+    poison_fill(i)
+    assert np.all(i == np.frombuffer(b"\xa5" * 8, np.int64)[0])
+    u = np.zeros(4, np.uint8)
+    poison_fill(u)
+    assert np.all(u == 0xA5)
+    # count limits the poisoned prefix
+    p = np.zeros(4, np.float32)
+    poison_fill(p, 2)
+    assert np.all(np.isnan(p[:2])) and np.all(p[2:] == 0.0)
+    # object dtype: left alone (no sentinel exists)
+    o = np.array([None, "x"], dtype=object)
+    poison_fill(o)
+    assert o[1] == "x"
+
+
+# The end-to-end strict-poison behavior (a batched Get origin reads as NaN
+# mid-epoch, real value after unlock) lives on the multi-process tier's
+# 1-RTT read epochs — covered in test_procs.py
+# (test_strict_poison_on_batched_get_across_processes).

@@ -1302,7 +1302,10 @@ def test_rma_batched_read_epochs_under_contention():
             MPI.Fetch_and_op(np.array([inc], np.int64), old, 0, 0,
                              MPI.SUM, win)
             MPI.Win_unlock(0, win)
-            assert old[0] >= 0
+            # per-origin monotonicity: the fetched pre-value includes at
+            # least this rank's own prior increments (total - inc); a
+            # lost or reordered fetch-add would fetch an older counter
+            assert old[0] >= total - inc, (old[0], total, inc)
         my_tot = MPI.Allreduce(np.array([total], np.int64), MPI.SUM, comm)
         MPI.Barrier(comm)
         if rank == 0:
@@ -1335,6 +1338,93 @@ def test_rma_batched_read_epochs_under_contention():
     assert res.returncode == 0, (res.stdout, res.stderr)
     for r in range(4):
         assert f"RMA-BATCH-OK-{r}" in res.stdout, (res.stdout, res.stderr)
+
+
+def test_strict_poison_on_batched_get_across_processes():
+    """TPU_MPI_STRICT=1: a batched read-epoch origin (Get / Fetch_and_op
+    fetch buffer) is POISONED with a sentinel until the closing
+    synchronization, so conforming code (read after unlock) sees the real
+    value while a premature mid-epoch read fails loudly as NaN instead of
+    silently returning stale data."""
+    res = _run_procs("""
+        import os
+        os.environ["TPU_MPI_STRICT"] = "1"
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        buf = np.full(4, 7.0) if rank == 0 else np.zeros(4)
+        win = MPI.Win_create(buf, comm)
+        MPI.Barrier(comm)
+        if rank == 1:
+            origin = np.zeros(4)
+            MPI.Win_lock(MPI.LOCK_SHARED, 0, 0, win)
+            MPI.Get(origin, 4, 0, 0, win)
+            assert np.all(np.isnan(origin)), origin   # poisoned mid-epoch
+            MPI.Win_unlock(0, win)
+            assert np.all(origin == 7.0), origin      # completion fills
+
+            # Fetch_and_op's fetch buffer gets the same treatment
+            old = np.zeros(1)
+            MPI.Win_lock(MPI.LOCK_SHARED, 0, 0, win)
+            MPI.Fetch_and_op(np.array([1.0]), old, 0, 0, MPI.SUM, win)
+            assert np.isnan(old[0]), old              # poisoned mid-epoch
+            MPI.Win_unlock(0, win)
+            assert old[0] == 7.0, old                 # pre-value fetched
+        MPI.Barrier(comm)
+        win.free()
+        print(f"STRICT-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """, nprocs=2)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    for r in range(2):
+        assert f"STRICT-OK-{r}" in res.stdout, (res.stdout, res.stderr)
+
+
+def test_chunked_star_allreduce_across_processes():
+    """Chunk-pipelined star collective (overlap engine): with the ring
+    disabled and the pipeline threshold lowered, a large Allreduce takes
+    the chunked-star path ("collc"/"collcres" frames) and must be bitwise
+    identical to the per-rank reference fold; a non-elementwise custom op
+    on the same channel must still go monolithic and agree too."""
+    res = _run_procs("""
+        import os
+        os.environ["TPU_MPI_RING_MIN_BYTES"] = str(1 << 60)   # ring off
+        os.environ["TPU_MPI_PIPELINE_MIN_BYTES"] = "65536"    # starc on
+        os.environ["TPU_MPI_PIPELINE_CHUNKS"] = "4"
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+
+        # 300k floats: not divisible by 4 chunks -> remainder chunk
+        n = 300_001
+        x = np.random.RandomState(7 + rank).rand(n).astype(np.float32)
+        out = MPI.Allreduce(x, MPI.SUM, comm)
+        ref = sum(np.random.RandomState(7 + r).rand(n).astype(np.float32)
+                  for r in range(size))
+        assert np.array_equal(np.asarray(out), ref), "chunked SUM mismatch"
+
+        # custom op (no ufunc): must fall back to the monolithic star
+        last = MPI.Op(lambda a, b: b, commutative=False)
+        y = np.full(n, float(rank), np.float32)
+        out2 = MPI.Allreduce(y, last, comm)
+        assert np.all(np.asarray(out2) == float(size - 1)), "custom op"
+
+        # int dtype through the in-place ufunc fold
+        z = np.arange(n, dtype=np.int64) + rank
+        out3 = MPI.Allreduce(z, MPI.SUM, comm)
+        ref3 = size * np.arange(n, dtype=np.int64) + sum(range(size))
+        assert np.array_equal(np.asarray(out3), ref3), "chunked int SUM"
+
+        print(f"STARC-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """, nprocs=3)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    for r in range(3):
+        assert f"STARC-OK-{r}" in res.stdout, (res.stdout, res.stderr)
 
 
 def test_spawn_closure_worker_across_processes():

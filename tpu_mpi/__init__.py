@@ -55,13 +55,16 @@ from .operators import (BAND, BOR, BXOR, LAND, LOR, LXOR, MAX, MIN, NO_OP, Op,
                         PROD, REPLACE, SUM)
 
 # Collectives (src/collective.jl) + nonblocking variants (MPI-3; absent
-# from the reference — beyond parity)
-from .collective import (Allgather, Allgatherv, Allreduce, Alltoall,
-                         Alltoallv, Barrier, Bcast, CollRequest, Exscan,
-                         Gather, Gatherv, Iallgather, Iallreduce, Ialltoall,
-                         Ibarrier, Ibcast, Iexscan, Igather, Ireduce, Iscan,
-                         Iscatter, Reduce, Reduce_scatter,
-                         Reduce_scatter_block, Scan, Scatter, Scatterv, bcast)
+# from the reference — beyond parity) + persistent collectives (MPI-4)
+from .collective import (Allgather, Allgatherv, Allreduce, Allreduce_init,
+                         Alltoall, Alltoallv, Barrier, Barrier_init, Bcast,
+                         Bcast_init, CollRequest, Exscan, Gather, Gatherv,
+                         Iallgather, Iallreduce, Ialltoall, Ibarrier, Ibcast,
+                         Iexscan, Igather, Ireduce, Iscan, Iscatter, Reduce,
+                         Reduce_scatter, Reduce_scatter_block, Scan, Scatter,
+                         Scatterv, bcast)
+from .overlap import PersistentCollRequest
+from . import overlap
 
 # Point-to-point (src/pointtopoint.jl)
 from .pointtopoint import (Cancel, Get_count, Get_error, Get_source, Get_tag,

@@ -36,8 +36,8 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from ._runtime import _POLL, deadlock_timeout, require_env
-from .buffers import (extract_array, resolve_attached, write_flat,
-                      write_range)
+from .buffers import (extract_array, poison_fill, resolve_attached,
+                      write_flat, write_range)
 from . import error as _ec
 from .error import DeadlockError, MPIError
 from . import operators as _ops
@@ -454,6 +454,18 @@ _EPOCH_MAX_BYTES = 1 << 20
 _PAYLOAD_OPS = frozenset(("put", "acc", "facc"))
 
 
+def _strict_poison(origin: Any, count: int) -> None:
+    """Strict mode (``TPU_MPI_STRICT=1``): a batched read's origin holds no
+    valid data until the closing synchronization (Win_unlock / Win_flush)
+    fills it — MPI says consuming it earlier is erroneous. Poison it with a
+    loud sentinel (NaN / 0xA5-pattern, buffers.poison_fill) so mid-epoch
+    consumption fails visibly instead of reading plausible stale bytes.
+    The completion write_flat overwrites the sentinel."""
+    from . import config
+    if config.load().strict:
+        poison_fill(origin, count)
+
+
 def _materialize_lock(st: ProcWinState, world: int) -> None:
     """Turn a deferred epoch into a live one: take the wire lock for real
     and replay the buffered ops as ordinary frames (FIFO keeps order);
@@ -579,6 +591,7 @@ def rma_get(st: ProcWinState, origin: Any, count: int, target_rank: int,
         # synchronization per MPI — fills ``origin`` at Win_unlock (or at
         # Win_flush / epoch overflow, which materialize and complete it)
         if _epoch_buffer(st, world, ("get", int(disp), int(count), origin)):
+            _strict_poison(origin, int(count))
             return
     eng = _engine(ctx)
     reqid = eng.new_reqid()
@@ -615,6 +628,7 @@ def rma_accumulate(st: ProcWinState, origin_flat: np.ndarray, target_rank: int,
             # at Win_unlock (one frame, one round trip)
             if _epoch_buffer(st, world, ("facc", int(disp), src,
                                          _op_spec(op), fetch_into)):
+                _strict_poison(fetch_into, count)
                 return
         reqid = eng.new_reqid()
         eng.send(world, ("acc", st.win_id, int(disp), src, _op_spec(op),

@@ -481,16 +481,29 @@ _EMPTY = object()   # distinct "no contribution yet" marker (None is a valid pay
 
 
 class CollectiveChannel(_Waitable):
-    """Reusable all-rank rendezvous for one communicator.
+    """Reusable all-rank rendezvous for one communicator, ROUND-KEYED.
 
-    Every collective round: each rank deposits a contribution; the last arriver
-    runs ``combine(contribs) -> per-rank results`` (doing any data placement —
-    all buffers are visible in the shared address space / on device); every rank
-    picks up its slot; the last picker resets the channel for the next round.
+    Every collective round: each rank deposits a contribution; the last
+    arriver runs ``combine(contribs) -> per-rank results`` (doing any data
+    placement — all buffers are visible in the shared address space / on
+    device); every rank picks up its slot.
 
-    The ``opname`` tag is checked across ranks every round — calling mismatched
-    collectives on one communicator raises CollectiveMismatchError in all ranks
-    instead of deadlocking (SURVEY.md §5 "race detection").
+    Rounds are numbered per rank and rendezvous state lives in a per-round
+    slot (the multi-process ``ProcChannel`` round-counter pattern), so a
+    rank that picked its round-k result enters round k+1 IMMEDIATELY —
+    no wait for slow peers to drain round k. The original single-slot
+    design paid two full condition barriers per op (previous-round drain +
+    last-picker reset); head-of-line blocking across back-to-back ops was
+    the largest share of the host-lane dispatch overhead (ISSUE-3,
+    ``BENCH_r05.json`` host_lane.overhead_ms). At most two rounds are ever
+    live: round k+1 cannot complete its rendezvous before every rank
+    arrived in it, which requires every rank to have picked (and thereby
+    freed) round k.
+
+    The ``opname`` tag is checked across ranks every round — calling
+    mismatched collectives on one communicator raises
+    CollectiveMismatchError in all ranks instead of deadlocking (SURVEY.md
+    §5 "race detection").
     """
 
     def __init__(self, ctx: "SpmdContext", size: int):
@@ -498,11 +511,17 @@ class CollectiveChannel(_Waitable):
         self.size = size
         self.lock = threading.RLock()   # see Mailbox.__init__ on reentrancy
         self.cond = threading.Condition(self.lock)
-        self.contribs: list[Any] = [_EMPTY] * size
-        self.results: Optional[Sequence[Any]] = None
-        self.arrived = 0
-        self.picked = 0
-        self.opname: Optional[str] = None
+        # per-rank next-round counters + live per-round rendezvous slots
+        self.rank_round = [0] * size
+        self.rounds: dict[int, dict] = {}
+
+    def _round_state(self, rnd: int) -> dict:
+        st = self.rounds.get(rnd)
+        if st is None:
+            st = self.rounds[rnd] = {
+                "contribs": [_EMPTY] * self.size, "arrived": 0,
+                "results": None, "picked": 0, "opname": None}
+        return st
 
     def run(self, rank: int, contrib: Any, combine: Callable[[list[Any]], Sequence[Any]],
             opname: str, plan=None) -> Any:
@@ -510,46 +529,41 @@ class CollectiveChannel(_Waitable):
         # here: threads share an address space, so the combine-in-place star
         # IS the optimal algorithm — data placement is a pointer exchange.
         with self.cond:
-            # Wait for the previous round to fully drain before joining a new one.
-            self._wait_for(
-                lambda: self.contribs[rank] is _EMPTY and self.results is None,
-                f"collective {opname} (waiting for previous round)")
-            if self.opname is None:
-                self.opname = opname
-            elif self.opname != opname:
+            rnd = self.rank_round[rank]
+            self.rank_round[rank] += 1
+            st = self._round_state(rnd)
+            if st["opname"] is None:
+                st["opname"] = opname
+            elif st["opname"] != opname:
                 err = CollectiveMismatchError(
                     f"rank {rank} called {opname!r} while other ranks are in "
-                    f"{self.opname!r} on the same communicator")
+                    f"{st['opname']!r} on the same communicator")
                 self.ctx.fail(err)
                 raise err
-            self.contribs[rank] = contrib
-            self.arrived += 1
-            if self.arrived == self.size:
+            st["contribs"][rank] = contrib
+            st["arrived"] += 1
+            if st["arrived"] == self.size:
                 try:
-                    self.results = list(combine(list(self.contribs)))
+                    results = list(combine(list(st["contribs"])))
                 except BaseException as e:
                     self.ctx.fail(e)
                     raise
-                if len(self.results) != self.size:
-                    err = MPIError(f"combine for {opname} returned {len(self.results)} "
+                if len(results) != self.size:
+                    err = MPIError(f"combine for {opname} returned {len(results)} "
                                    f"results for {self.size} ranks")
                     self.ctx.fail(err)
                     raise err
-                self.picked = 0
+                st["results"] = results
+                st["contribs"] = []      # contributions are dead: release refs
                 self.cond.notify_all()
             else:
-                self._wait_for(lambda: self.results is not None,
+                self._wait_for(lambda: st["results"] is not None,
                                f"collective {opname}",
                                limit=collective_wait_limit(opname))
-            assert self.results is not None
-            res = self.results[rank]
-            self.picked += 1
-            if self.picked == self.size:
-                self.contribs = [_EMPTY] * self.size
-                self.results = None
-                self.arrived = 0
-                self.opname = None
-                self.cond.notify_all()
+            res = st["results"][rank]
+            st["picked"] += 1
+            if st["picked"] == self.size:
+                self.rounds.pop(rnd, None)   # fully drained; no reset barrier
             return res
 
 

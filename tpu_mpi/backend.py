@@ -445,6 +445,12 @@ class ProcChannel(_Waitable):
       dissemination Barrier (log P rounds). Frames carry the opname and
       (for rooted ops) the claimed root, so mismatched collectives and
       divergent roots still fail loudly on all ranks.
+    - **Chunked star tier** (overlap engine) for bulk elementwise Allreduce
+      the ring declines (non-commutative op, or ring disabled): payloads
+      above ``pipeline_min_bytes`` travel as K chunk frames; the root folds
+      chunk k while its drainer still receives chunks k+1.. and ships each
+      result chunk immediately — transfer overlaps fold, bitwise-equal to
+      the monolithic star.
     - **Star tier** for everything else (arbitrary combine closures): ranks
       send (opname, contrib) to the comm's first process, which verifies,
       combines and scatters per-rank results. Rooted Gather/Scatter stay
@@ -502,11 +508,12 @@ class ProcChannel(_Waitable):
             cur = self.inflight.get(rnd)
             self.inbox[(rnd, src)] = (opname, contrib)
             self.cond.notify_all()
-        if cur is not None and cur[1] == "alg":
-            # a star contribution while this rank runs the algorithm tier:
-            # either a different collective (opname) or — same opname — a
-            # TIER divergence (e.g. non-uniform Allgather counts making the
-            # eligibility gate disagree); both would hang, fail loudly
+        if cur is not None and cur[1] != "star":
+            # a monolithic star contribution while this rank runs another
+            # tier (ring/tree or the chunked star): either a different
+            # collective (opname) or — same opname — a TIER divergence
+            # (e.g. non-uniform counts making the eligibility gate
+            # disagree); both would hang, fail loudly
             if cur[0] != opname:
                 self._mismatch(opname, cur[0])
             else:
@@ -517,6 +524,24 @@ class ProcChannel(_Waitable):
             self.inbox[(rnd,)] = result
             self.cond.notify_all()
 
+    def deliver_chunk(self, rnd: int, src: int, opname: str, idx: int,
+                      nchunks: int, part: Any) -> None:
+        """A pipelined star contribution chunk (frame kind "collc")."""
+        with self.cond:
+            cur = self.inflight.get(rnd)
+            self.inbox[(rnd, src, "c", idx)] = (opname, nchunks, part)
+            self.cond.notify_all()
+        if cur is not None and cur[1] != "starc":
+            if cur[0] != opname:
+                self._mismatch(opname, cur[0])
+            else:
+                self._tier_mismatch(opname, src)
+
+    def deliver_chunk_result(self, rnd: int, idx: int, result: Any) -> None:
+        with self.cond:
+            self.inbox[(rnd, "cres", idx)] = result
+            self.cond.notify_all()
+
     def deliver_alg(self, rnd: int, tag: tuple, src: int, opname: str,
                     payload: Any) -> None:
         with self.cond:
@@ -525,7 +550,7 @@ class ProcChannel(_Waitable):
             self.cond.notify_all()
         if cur is not None and cur[0] != opname:
             self._mismatch(opname, cur[0])
-        elif cur is not None and cur[1] == "star":
+        elif cur is not None and cur[1] != "alg":
             self._tier_mismatch(opname, src)
 
     # -- algorithm tier -------------------------------------------------------
@@ -802,6 +827,33 @@ class ProcChannel(_Waitable):
             return self._run_pairwise_alltoallv
         return None
 
+    def _choose_chunked(self, contrib: Any, plan):
+        """The chunk-pipelined star's eligibility (overlap engine): a bulk
+        Allreduce the ring DECLINED (non-commutative op, or ring disabled)
+        over a known-elementwise op, above ``pipeline_min_bytes``. Returns
+        (op, schedule) or None. Like every tier gate, the decision is a
+        deterministic function of rank-uniform values (plan kind, op,
+        payload size/dtype, config) — and the chunk frames carry the chunk
+        count so a divergent pipeline config still fails loudly instead of
+        hanging."""
+        if not plan or plan[0] != "allreduce":
+            return None
+        from .operators import is_elementwise
+        op = plan[1]
+        if not is_elementwise(op):
+            return None
+        try:
+            arr = np.asarray(contrib)
+        except Exception:
+            return None
+        if arr.dtype == object:
+            return None
+        from .overlap import ChunkSchedule
+        sched = ChunkSchedule.maybe(arr.size, arr.dtype.itemsize)
+        if sched is None:
+            return None
+        return (op, sched)
+
     # -- the collective contract ---------------------------------------------
     def run(self, rank: int, contrib: Any,
             combine: Callable[[list[Any]], Sequence[Any]], opname: str,
@@ -809,7 +861,10 @@ class ProcChannel(_Waitable):
         ctx = self.ctx
         n = len(self.group)
         alg = self._choose_algorithm(contrib, plan) if (plan and n > 1) else None
-        mode = "alg" if alg is not None else "star"
+        chunked = None
+        if alg is None and plan and n > 1:
+            chunked = self._choose_chunked(contrib, plan)
+        mode = "alg" if alg is not None else ("starc" if chunked else "star")
         with self.cond:
             rnd = self.round
             self.round += 1
@@ -818,13 +873,25 @@ class ProcChannel(_Waitable):
             # them for cross-tier mismatches the delivery check couldn't see.
             stale = tier_diverged = None
             for key, val in self.inbox.items():
-                if mode == "star" and key[0] == "alg" and key[1] == rnd:
+                if key[0] == "alg" and key[1] == rnd:
+                    if mode == "alg":
+                        continue
                     if val[1] != opname:
                         stale = val[1]
                     else:
                         tier_diverged = val[0]   # same op, other tier
-                elif (mode == "alg" and isinstance(key[0], int)
-                      and key[0] == rnd and len(key) == 2):
+                elif not (isinstance(key[0], int) and key[0] == rnd):
+                    continue
+                elif len(key) == 2:              # monolithic star contrib
+                    if mode == "star":
+                        continue
+                    if val[0] != opname:
+                        stale = val[0]
+                    else:
+                        tier_diverged = key[1]
+                elif len(key) == 4 and key[2] == "c":   # chunked contrib
+                    if mode == "starc":
+                        continue
                     if val[0] != opname:
                         stale = val[0]
                     else:
@@ -838,6 +905,9 @@ class ProcChannel(_Waitable):
         try:
             if alg is not None:
                 return alg(rank, rnd, contrib, opname)
+            if chunked is not None:
+                return self._run_star_chunked(rank, rnd, contrib,
+                                              chunked[0], chunked[1], opname)
             return self._run_star(rank, rnd, contrib, combine, opname)
         except BaseException as e:
             if ctx.failure is None:
@@ -846,6 +916,133 @@ class ProcChannel(_Waitable):
         finally:
             with self.cond:
                 self.inflight.pop(rnd, None)
+
+    def _result_wait(self, rnd: int, key: Any, opname: str) -> Any:
+        """Wait for ``inbox[key]`` (a star/chunked result from the root) with
+        the busy-probe escape hatch, and pop it. The root may be legitimately
+        slow INSIDE combine (a >60s XLA compile on big shapes — VERDICT r1
+        weak item 6): before declaring deadlock, ask its drainer whether the
+        round is still in flight; a dead root surfaces via abort frames in
+        check_failure instead. The ping ships with the cond RELEASED
+        (ADVICE r2): a blocking transport send under the lock the drainer
+        needs to deliver frames here could wedge both this thread and the
+        drainer on a backed-up socket."""
+        ctx = self.ctx
+        root_world = self.group[0]
+        while True:
+            with self.cond:
+                try:
+                    self._wait_for(lambda: key in self.inbox,
+                                   f"collective {opname}",
+                                   limit=collective_wait_limit(opname))
+                    return self.inbox.pop(key)
+                except DeadlockError as e:
+                    deadlock = e
+                    self.probing.add(rnd)
+            got = busy = False
+            try:
+                self._send(root_world, ("collping", self.cid, rnd,
+                                        ctx.local_rank), opname)
+                with self.cond:
+                    got = self._wait_for(
+                        lambda: (key in self.inbox
+                                 or ("pong", rnd) in self.inbox),
+                        f"collective {opname} (busy probe)",
+                        timeout=15.0)
+                    busy = self.inbox.pop(("pong", rnd), False)
+            finally:
+                # discard AND sweep under one cond hold: a pong landing
+                # between the probe wait's exit and the discard would
+                # otherwise sit in the inbox forever (the collpong
+                # handler gates on probing membership under this cond)
+                with self.cond:
+                    self.probing.discard(rnd)
+                    self.inbox.pop(("pong", rnd), None)
+            with self.cond:
+                if key in self.inbox:
+                    return self.inbox.pop(key)
+            if not (got and busy):
+                raise deadlock
+
+    def _run_star_chunked(self, rank: int, rnd: int, contrib: Any, op,
+                          schedule, opname: str) -> Any:
+        """Chunk-pipelined star Allreduce (overlap engine): contributions
+        travel as K chunk frames; the root folds chunk k in rank order AS
+        SOON as every rank's chunk k has landed — while its drainer keeps
+        receiving chunks k+1..K-1 concurrently (the fold runs with the cond
+        released) — and ships each result chunk immediately. Transfer and
+        fold genuinely overlap, and peers start receiving results before the
+        last contribution chunk was even sent. Bitwise-equal to the
+        monolithic star: same rank-order fold over the same elements, just
+        chunk-separated (the eligibility gate admits elementwise ops only)."""
+        import functools as _ft
+        from .overlap import progress_begin, progress_note
+
+        ctx = self.ctx
+        n = len(self.group)
+        K = schedule.nchunks
+        root_world = self.group[0]
+        arr = np.asarray(contrib).reshape(-1)
+        prog = progress_begin(K, "chunks")
+        if ctx.local_rank != root_world:
+            for idx, (lo, hi) in enumerate(schedule):
+                self._send(root_world,
+                           ("collc", self.cid, rnd, rank, opname, idx, K,
+                            _pack(arr[lo:hi])), opname)
+            parts = []
+            for idx in range(K):
+                parts.append(np.asarray(_unpack(
+                    self._result_wait(rnd, (rnd, "cres", idx), opname)))
+                    .reshape(-1))
+                progress_note(prog)
+            return self._from_host(np.concatenate(parts), contrib)
+
+        # root: per-chunk gather -> rank-order fold -> immediate scatter
+        others = [r for r in range(n) if r != rank]
+        res_parts = []
+        for idx, (lo, hi) in enumerate(schedule):
+            with self.cond:
+                self._wait_for(
+                    lambda: all((rnd, r, "c", idx) in self.inbox
+                                for r in others),
+                    f"collective {opname} (chunk {idx})",
+                    limit=collective_wait_limit(opname))
+                gathered = {r: self.inbox.pop((rnd, r, "c", idx))
+                            for r in others}
+            for r, (got_op, got_k, _) in gathered.items():
+                if got_op != opname:
+                    err = CollectiveMismatchError(
+                        f"rank {r} is in {got_op!r} while this rank is in "
+                        f"{opname!r} on the same communicator")
+                    ctx.fail(err)
+                    raise err
+                if got_k != K:
+                    err = MPIError(
+                        f"ranks disagree on the pipeline chunking of "
+                        f"{opname!r} ({got_k} vs {K} chunks) — "
+                        f"TPU_MPI_PIPELINE_* must be uniform across ranks")
+                    ctx.fail(err)
+                    raise err
+            # fold OUTSIDE the cond hold: the drainer delivers later chunks
+            # while this one reduces — that concurrency IS the overlap
+            pieces = [arr[lo:hi] if r == rank
+                      else np.asarray(_unpack(gathered[r][2])).reshape(-1)
+                      for r in range(n)]
+            if (op.ufunc is not None
+                    and all(p.dtype == arr.dtype for p in pieces)):
+                red = np.empty(hi - lo, dtype=arr.dtype)
+                np.copyto(red, pieces[0])
+                for p in pieces[1:]:
+                    op.ufunc(red, p, out=red)
+            else:
+                red = np.asarray(_ft.reduce(op, pieces))
+            res_parts.append(red)
+            for r in others:
+                self._send(self.group[r],
+                           ("collcres", self.cid, rnd, idx, _pack(red)),
+                           opname)
+            progress_note(prog)
+        return self._from_host(np.concatenate(res_parts), contrib)
 
     def _run_star(self, rank: int, rnd: int, contrib: Any,
                   combine: Callable[[list[Any]], Sequence[Any]],
@@ -856,50 +1053,7 @@ class ProcChannel(_Waitable):
         if ctx.local_rank != root_world:
             self._send(root_world, ("coll", self.cid, rnd, rank, opname,
                                     _pack(contrib)), opname)
-            while True:
-                with self.cond:
-                    try:
-                        self._wait_for(lambda: (rnd,) in self.inbox,
-                                       f"collective {opname}",
-                                       limit=collective_wait_limit(opname))
-                        res = self.inbox.pop((rnd,))
-                        return _unpack(res)
-                    except DeadlockError as e:
-                        deadlock = e
-                        self.probing.add(rnd)
-                # The root may be legitimately slow INSIDE combine (a >60s
-                # XLA compile on big shapes — VERDICT r1 weak item 6). Ask
-                # its drainer whether the round is in flight before
-                # declaring deadlock; a dead root surfaces via abort frames
-                # in check_failure instead. The ping ships with the cond
-                # RELEASED (ADVICE r2): a blocking transport send under the
-                # lock the drainer needs to deliver frames here could wedge
-                # both this thread and the drainer on a backed-up socket.
-                got = busy = False
-                try:
-                    self._send(root_world, ("collping", self.cid, rnd,
-                                            ctx.local_rank), opname)
-                    with self.cond:
-                        got = self._wait_for(
-                            lambda: ((rnd,) in self.inbox
-                                     or ("pong", rnd) in self.inbox),
-                            f"collective {opname} (busy probe)",
-                            timeout=15.0)
-                        busy = self.inbox.pop(("pong", rnd), False)
-                finally:
-                    # discard AND sweep under one cond hold: a pong landing
-                    # between the probe wait's exit and the discard would
-                    # otherwise sit in the inbox forever (the collpong
-                    # handler gates on probing membership under this cond)
-                    with self.cond:
-                        self.probing.discard(rnd)
-                        self.inbox.pop(("pong", rnd), None)
-                with self.cond:
-                    if (rnd,) in self.inbox:
-                        res = self.inbox.pop((rnd,))
-                        return _unpack(res)
-                if not (got and busy):
-                    raise deadlock
+            return _unpack(self._result_wait(rnd, (rnd,), opname))
 
         # root: gather, verify, combine, scatter
         with self.cond:
@@ -1262,6 +1416,13 @@ class ProcContext(SpmdContext):
         elif kind == "collres":
             _, cid, rnd, result = item
             self._proc_channel(cid).deliver_result(rnd, result)
+        elif kind == "collc":
+            _, cid, rnd, src, opname, idx, k, part = item
+            self._proc_channel(cid).deliver_chunk(rnd, src, opname, idx, k,
+                                                  part)
+        elif kind == "collcres":
+            _, cid, rnd, idx, result = item
+            self._proc_channel(cid).deliver_chunk_result(rnd, idx, result)
         elif kind == "collping":
             # busy probe: is this round still in flight here (e.g. the star
             # root mid-combine)? Answered by the drainer so a long combine

@@ -331,6 +331,62 @@ def to_wire(x: Any, count: Optional[int] = None) -> Any:
     return arr
 
 
+def wire_view(x: Any, count: Optional[int] = None) -> Any:
+    """A contiguous flat VIEW of a send operand — the zero-copy sibling of
+    :func:`to_wire` for contributions whose rendezvous output is always a
+    FRESH array (the reduce-family fold): every rank stays blocked in the
+    rendezvous until the fold has run, so the live buffer cannot change
+    under the combiner, and nothing downstream retains the view after the
+    pick. Deliberately NOT marked as a wire snapshot — in-place consumers
+    (the multi-process ring allreduce) must still copy before mutating.
+    Falls back to :func:`to_wire` when a flat view can't be taken without a
+    copy (non-contiguous host views), so callers always get wire shape."""
+    if isinstance(x, DeviceBuffer) or is_jax_array(x):
+        return to_wire(x, count)      # device refs are already zero-copy
+    src = np.asarray(x)
+    if not src.flags.c_contiguous:
+        return to_wire(x, count)
+    flat = src.reshape(-1)
+    if count is not None and flat.size != count:
+        flat = flat[:count]
+    return flat
+
+
+_POISON_BYTE = 0xA5
+
+
+def poison_fill(buf: Any, count: Optional[int] = None) -> None:
+    """Fill the first ``count`` flat elements of an origin buffer with a loud
+    sentinel (strict mode, docs/performance.md "Batched read epochs"): floats
+    and complexes become NaN, ints the repeated-0xA5 bit pattern — so a
+    caller consuming a deferred Get/Fetch_and_op origin before the closing
+    synchronization sees obviously-poisoned values (NaN propagates;
+    0xA5A5… is unmistakable) instead of plausible stale data. Object-dtype
+    and other unpoisonable operands are left untouched."""
+    arr = extract_array(buf)
+    if arr is None:
+        return
+    n = int(arr.size if count is None else min(int(count), arr.size))
+    if n <= 0:
+        return
+    dt = np.dtype(arr.dtype)
+    if dt.kind == "f":
+        val = dt.type(np.nan)
+    elif dt.kind == "c":
+        val = dt.type(complex(np.nan, np.nan))
+    elif dt.kind in "iub":
+        val = np.frombuffer(bytes([_POISON_BYTE]) * dt.itemsize, dtype=dt)[0]
+    else:
+        return
+    if isinstance(buf, DeviceBuffer):
+        write_range(buf, 0, np.full(n, val, dtype=dt))
+    elif isinstance(buf, np.ndarray):
+        if buf.flags.c_contiguous:
+            buf.reshape(-1)[:n] = val
+        else:
+            buf.flat[:n] = val
+
+
 # The reference's dispatch unions (src/buffers.jl:1-11) as isinstance()
 # tuples. Deliberate divergences from the Julia unions: native Python
 # scalars (int/float/complex/bool) are included — the typed send path

@@ -37,12 +37,14 @@ import numpy as np
 
 from .buffers import (IN_PLACE, DeviceBuffer, _InPlace, assert_minlength,
                       clone_like, element_count, extract_array, is_jax_array,
-                      to_wire, write_flat)
+                      to_wire, wire_view, write_flat)
 from .comm import Comm, Intercomm, ROOT
 from ._runtime import PROC_NULL
 from . import error as _ec
 from .error import CollectiveMismatchError, MPIError
 from .operators import Op, as_op
+from .overlap import (ChunkSchedule, CollectivePlan, PersistentCollRequest,
+                      plans as _plans, progress_begin, progress_note)
 
 
 def _run(comm: Comm, contrib: Any, combine, opname: str, plan=None,
@@ -198,12 +200,57 @@ def _fused_reduce_candidate(op: Op, arrs: Sequence[Any]):
     return fused
 
 
-def _reduce_arrays(arrs: Sequence[Any], op: Op) -> Any:
-    """Rank-ordered elementwise reduction (deterministic; MPI rank order)."""
+def _reduce_arrays(arrs: Sequence[Any], op: Op,
+                   schedule: Optional[ChunkSchedule] = None) -> Any:
+    """Rank-ordered elementwise reduction (deterministic; MPI rank order).
+    With a chunk ``schedule`` (overlap engine), host folds run chunk-by-chunk
+    — cache-resident working set, progress notes per chunk, and on the
+    multi-process tier the per-chunk structure is what lets the star root
+    fold chunk k while the drainer still receives chunk k+1."""
     out = _jitted_fold(arrs, op, "reduce")
     if out is not _NOT_JITTABLE:
         return out
+    if schedule is not None and len(arrs) > 1:
+        out = _chunked_fold(arrs, op, schedule)
+        if out is not None:
+            return out
     return functools.reduce(op, arrs)
+
+
+def _chunked_fold(arrs: Sequence[Any], op: Op,
+                  schedule: ChunkSchedule) -> Optional[Any]:
+    """Chunk-pipelined host fold. Elementwise rank-order folds are
+    chunk-separable, so this is BITWISE-IDENTICAL to the monolithic
+    ``functools.reduce``: ufunc-backed ops (SUM/PROD/MIN/MAX/B*) fold each
+    chunk in place into one preallocated output (zero temporaries — the
+    monolithic fold allocates n-1 full-size intermediates); other
+    elementwise ops fold per-chunk and concatenate, preserving the exact
+    dtype-promotion behavior. Returns None when the operands don't fit
+    (non-numpy, object dtype, ragged sizes) and the caller's monolithic
+    fold applies."""
+    from .operators import is_elementwise
+    if not is_elementwise(op):
+        return None     # unknown custom fn might couple elements: monolithic
+    first = arrs[0]
+    if any(not isinstance(a, np.ndarray) or a.dtype == object for a in arrs):
+        return None
+    if any(a.size != schedule.count for a in arrs):
+        return None
+    flats = [a.reshape(-1) for a in arrs]
+    prog = progress_begin(schedule.nchunks, "fold")
+    if op.ufunc is not None and all(a.dtype == first.dtype for a in arrs):
+        out = np.empty(schedule.count, dtype=first.dtype)
+        for lo, hi in schedule:
+            np.copyto(out[lo:hi], flats[0][lo:hi])
+            for a in flats[1:]:
+                op.ufunc(out[lo:hi], a[lo:hi], out=out[lo:hi])
+            progress_note(prog)
+        return out
+    parts = []
+    for lo, hi in schedule:
+        parts.append(functools.reduce(op, [a[lo:hi] for a in flats]))
+        progress_note(prog)
+    return np.concatenate(parts)
 
 
 def _scan_arrays(cs: Sequence[Any], op: Op) -> list:
@@ -774,6 +821,54 @@ def _parse_reduce_args(args, has_root: bool, name: str):
     return sendbuf, recvbuf, count, as_op(op), root, comm, alloc
 
 
+def _reduce_plan(comm: Comm, name: str, mode: str, op: Op, count: int,
+                 payload: Any) -> CollectivePlan:
+    """The pre-resolved plan for one reduce-family signature (the overlap
+    engine's persistent-plan piece): opname tag, combine closure, trace
+    signature, multi-process algorithm hint and chunk schedule are built
+    once per (comm, flavor, op, count, dtype, array kind) and reused by
+    every later same-shape call — the training-loop case pays dict lookups
+    instead of closure/format/config work per collective."""
+    from . import config
+    dtype = getattr(payload, "dtype", None)
+    key = (comm.cid, name, mode, op, int(count), str(dtype),
+           type(payload).__name__)
+    plan = _plans.get(key)
+    if plan is not None:
+        return plan
+    itemsize = getattr(dtype, "itemsize", 0)
+    schedule = (ChunkSchedule.maybe(count, itemsize)
+                if mode == "reduce" else None)
+
+    def combine(cs, rt=None):
+        n = len(cs)
+        if mode == "reduce":
+            total = _reduce_arrays(cs, op, schedule=schedule)
+            if rt is None:              # Allreduce: everyone needs it
+                return [total] * n
+            # rooted Reduce: ship the combined payload to root only — star
+            # egress drops from P×payload to ~zero (VERDICT r2 weak #6;
+            # src/collective.jl:605-666 root-only recvbuf)
+            return [total if r == rt else None for r in range(n)]
+        if mode == "scan":
+            return _scan_arrays(cs, op)
+        if mode == "exscan":
+            # exscan[i] = scan over ranks 0..i-1; rank 0's slot is undefined.
+            return [None, *_scan_arrays(cs[:-1], op)]
+        raise AssertionError(mode)
+
+    sig = {"count": int(count), "dtype": str(dtype)}
+    # The multi-process tier runs large commutative Allreduce as a ring
+    # reduce-scatter + allgather (or the chunked star when the ring
+    # declines); order-sensitive modes stay on the monolithic star.
+    hint = ("allreduce", op) if (mode == "reduce" and not name == "Reduce") \
+        else None
+    plan = CollectivePlan(f"{name}@{comm.cid}", op, combine, sig, hint,
+                          schedule, config.GENERATION)
+    _plans.put(key, plan)
+    return plan
+
+
 def _reduce_family(args, has_root: bool, mode: str, name: str) -> Any:
     sendbuf, recvbuf, count, op, root, comm, alloc = _parse_reduce_args(args, has_root, name)
     rank, size = comm.rank(), comm.size()
@@ -788,36 +883,25 @@ def _reduce_family(args, has_root: bool, mode: str, name: str) -> Any:
     assert_minlength(sendbuf, count)
     if recvbuf is not None and not _is_none(recvbuf) and not inplace:
         assert_minlength(recvbuf, count)
-    payload = to_wire(sendbuf, count)
-
-    def combine(cs, rt=None):
-        n = len(cs)
-        if mode == "reduce":
-            total = _reduce_arrays(cs, op)
-            if rt is None:              # Allreduce: everyone needs it
-                return [total] * n
-            # rooted Reduce: ship the combined payload to root only — star
-            # egress drops from P×payload to ~zero (VERDICT r2 weak #6;
-            # src/collective.jl:605-666 root-only recvbuf)
-            return [total if r == rt else None for r in range(n)]
-        if mode == "scan":
-            return _scan_arrays(cs, op)
-        if mode == "exscan":
-            # exscan[i] = scan over ranks 0..i-1; rank 0's slot is undefined.
-            return [None, *_scan_arrays(cs[:-1], op)]
-        raise AssertionError(mode)
-
-    sig = {"count": int(count),
-           "dtype": str(getattr(payload, "dtype", None))}
-    if has_root:
-        result = _run_rooted(comm, root, payload, combine, f"{name}@{comm.cid}",
-                             _sig=sig)
+    if mode == "reduce":
+        # Zero-copy contribution: the reduce fold's distributed output is
+        # always FRESH data (for n >= 2 the fold allocates; for n == 1 every
+        # consumer below copies or self-assigns), and every rank is blocked
+        # in the rendezvous until the fold has run — so the live buffer is
+        # safe to expose and the to_wire snapshot copy is pure overhead.
+        # Scan/Exscan keep the snapshot: Exscan hands rank 0's contribution
+        # to rank 1 AS-IS, aliasing rank 0's buffer after it returns.
+        payload = wire_view(sendbuf, count)
     else:
-        # The multi-process tier runs large commutative Allreduce as a ring
-        # reduce-scatter + allgather; order-sensitive modes stay on the star.
-        plan = ("allreduce", op) if mode == "reduce" else None
-        result = _run(comm, payload, combine, f"{name}@{comm.cid}", plan=plan,
-                      _sig=sig)
+        payload = to_wire(sendbuf, count)
+
+    cplan = _reduce_plan(comm, name, mode, op, count, payload)
+    if has_root:
+        result = _run_rooted(comm, root, payload, cplan.combine, cplan.opname,
+                             _sig=cplan.sig)
+    else:
+        result = _run(comm, payload, cplan.combine, cplan.opname,
+                      plan=cplan.hint, _sig=cplan.sig)
     i_get_result = (not has_root) or rank == root
     if mode == "exscan" and result is None:
         # rank 0's Exscan output is undefined (src/collective.jl:834-855);
@@ -946,6 +1030,9 @@ class CollRequest:
         self._inactive = False
         self.kind = "coll"
         self.buffer = None
+        # in-flight chunk state (overlap engine) — set by _nb_submit, advanced
+        # by the progress worker, readable any time from the caller's thread
+        self.progress = None
 
     def _complete(self) -> None:
         self.result = self._future.result()   # re-raises collective errors
@@ -1045,24 +1132,35 @@ def nb_shutdown(ctx, cid=None, world_rank=None) -> None:
 
 
 def _nb_submit(comm: Comm, fn) -> CollRequest:
-    """Run ``fn`` on this rank's per-comm collective worker."""
+    """Run ``fn`` on this rank's per-comm collective worker (the host-path
+    progress engine: the worker thread advances the collective — including
+    its pipeline chunks — while the caller is in user code; the request's
+    ``progress`` exposes the in-flight chunk state)."""
     from ._runtime import require_env, set_env
+    from .overlap import ChunkProgress, bind_progress
 
     ctx, world_rank = require_env()
     st = _nb_state(ctx, comm.cid, world_rank, create=True)
+    prog = ChunkProgress()
 
     def run():
         # the worker impersonates the initiating rank (thread-tier ranks
         # are TLS-bound; the proc tier's process-global binding also works)
         set_env((ctx, world_rank))
         _nb_worker_tls.active = True
+        bind_progress(prog)
+        prog.stage = "running"
         try:
             return fn()
         finally:
+            prog.stage = "done"
+            bind_progress(None)
             _nb_worker_tls.active = False
             set_env(None)
 
-    return CollRequest(st.submit(run))
+    req = CollRequest(st.submit(run))
+    req.progress = prog
+    return req
 
 
 def _ordered_run(comm: Comm, call):
@@ -1155,5 +1253,36 @@ def _comm_of(args) -> Comm:
     if not args or not isinstance(args[-1], Comm):
         raise TypeError("the last argument must be the communicator")
     return args[-1]
+
+
+# ---------------------------------------------------------------------------
+# Persistent collectives (MPI-4 MPI_Allreduce_init family), mirroring the
+# persistent P2P machinery (pointtopoint.Send_init/Recv_init + Prequest):
+# the arguments bind once, every Start initiates one round on the progress
+# worker, and the first round populates the plan cache so later rounds skip
+# per-call setup entirely — the training-loop shape.
+# ---------------------------------------------------------------------------
+
+def Allreduce_init(*args) -> PersistentCollRequest:
+    """Persistent Allreduce (same flavors as :func:`Allreduce`). Arm with
+    ``Start``/``Startall``; complete with the Wait/Test family; reuse. The
+    allocating variant's value lands in ``req.result`` each round."""
+    comm = _comm_of(args)
+    return PersistentCollRequest(
+        lambda: _nb_submit(comm, lambda: Allreduce(*args)),
+        "pallreduce", args[0] if args else None)
+
+
+def Bcast_init(buf: Any, root: int, comm: Comm) -> PersistentCollRequest:
+    """Persistent Bcast of ``buf`` from ``root``; mutates buf every round."""
+    return PersistentCollRequest(
+        lambda: _nb_submit(comm, lambda: Bcast(buf, root, comm)),
+        "pbcast", buf)
+
+
+def Barrier_init(comm: Comm) -> PersistentCollRequest:
+    """Persistent barrier."""
+    return PersistentCollRequest(
+        lambda: _nb_submit(comm, lambda: Barrier(comm)), "pbarrier", None)
 
 

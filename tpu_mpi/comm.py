@@ -124,6 +124,8 @@ class Comm:
         (src/comm.jl MPI_Comm_free analog — no C resources, but the
         I-collective executor is a real thread)."""
         self._freed = True
+        from .overlap import plans
+        plans.invalidate(self._cid)   # cached collective plans die with us
         env = current_env()
         if env is not None:
             from .collective import nb_shutdown

@@ -57,6 +57,20 @@ class Config:
     # same-host ranks through one-shot POSIX shm segments instead of the TCP
     # stream (the libmpi shared-memory-BTL analog); 0 disables the shm lane.
     shm_min_bytes: int = 1 << 18
+    # host-path overlap engine (docs/performance.md "Overlap engine"):
+    # payloads at least this large are chunk-pipelined through the
+    # transfer / reduce-combine stages instead of moving monolithically;
+    # 0 disables pipelining entirely.
+    pipeline_min_bytes: int = 1 << 20
+    # number of chunks a pipelined payload splits into (clamped to at
+    # least 2 when pipelining engages; the last chunk absorbs remainders).
+    pipeline_chunks: int = 4
+    # strict mode: poison batched-read RMA origins (Get / Fetch_and_op
+    # results inside a deferred lock epoch) with a sentinel until the
+    # closing synchronization, so a caller consuming them mid-epoch —
+    # undefined behavior per MPI — fails loudly instead of reading stale
+    # bytes (docs/performance.md "Batched read epochs").
+    strict: bool = False
     # blocking-send flow control: a Send/send blocks while the destination's
     # unexpected queue holds more than this many bytes (the rendezvous-
     # protocol analog; Isend keeps buffered semantics). 0 disables.
@@ -96,6 +110,9 @@ _ENV_MAP = {
     "rendezvous_timeout": "TPU_MPI_RENDEZVOUS_TIMEOUT",
     "max_frame_bytes": "TPU_MPI_MAX_FRAME_BYTES",
     "shm_min_bytes": "TPU_MPI_SHM_MIN_BYTES",
+    "pipeline_min_bytes": "TPU_MPI_PIPELINE_MIN_BYTES",
+    "pipeline_chunks": "TPU_MPI_PIPELINE_CHUNKS",
+    "strict": "TPU_MPI_STRICT",
     "send_highwater_bytes": "TPU_MPI_SEND_HIGHWATER_BYTES",
     "debug_sequence_check": "TPU_MPI_DEBUG_SEQUENCE",
     "fused_fold": "TPU_MPI_FUSED_FOLD",

@@ -404,8 +404,11 @@ def Get(origin: Any, *args) -> None:
     ``Win_flush``) — the multi-process tier batches the read into the
     single unlock frame (1 round trip per uncontended epoch), so code that
     consumes the value mid-epoch must flush first, exactly as the standard
-    requires. See ``docs/performance.md`` ("Batched read epochs") for the
-    epoch model and ("The shm bulk lane") for how large payloads travel."""
+    requires. Under ``TPU_MPI_STRICT=1`` a batched origin is POISONED with
+    a sentinel (NaN / 0xA5-pattern) until completion, so such erroneous
+    mid-epoch reads fail loudly instead of returning stale data. See
+    ``docs/performance.md`` ("Batched read epochs") for the epoch model
+    and ("The shm bulk lane") for how large payloads travel."""
     if len(args) == 2:
         target_rank, win = args
         count, target_disp = element_count(origin), 0
@@ -515,7 +518,9 @@ def Fetch_and_op(sourceval: Any, returnval: Any, target_rank: int,
 
     Like :func:`Get`, the fetched value lands at the closing
     synchronization (unlock/flush) in a passive-target epoch — the op
-    batches into the unlock frame on the multi-process tier. See
+    batches into the unlock frame on the multi-process tier, and under
+    ``TPU_MPI_STRICT=1`` the return buffer holds a poison sentinel until
+    then (consuming it mid-epoch is erroneous per MPI). See
     ``docs/performance.md`` ("Batched read epochs")."""
     win._check()
     if _ev.enabled():

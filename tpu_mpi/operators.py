@@ -151,6 +151,15 @@ def _predefined_op(name: str) -> Op:
     return _PREDEFINED[name]
 
 
+def is_elementwise(op: Op) -> bool:
+    """True when ``op`` is KNOWN to act independently per element — every
+    predefined op, plus anything carrying a numpy ufunc. Chunk-separable
+    transforms (the overlap engine's pipelined folds) require this: an
+    arbitrary user callable might couple elements across the array, so it
+    stays on the monolithic fold."""
+    return op.ufunc is not None or _PREDEFINED.get(op.name) is op
+
+
 def acc_combine(old: Any, incoming: Any, op: Op):
     """MPI accumulate semantics for a target range: the new target values,
     or None to leave the target unchanged (NO_OP). The single owner of the

@@ -1,0 +1,282 @@
+"""Host-path overlap engine: chunk schedules, persistent collective plans,
+and in-flight progress state (ISSUE-3 tentpole).
+
+Three coordinated pieces, shared by the thread tier (``_runtime
+.CollectiveChannel``), the multi-process tier (``backend.ProcChannel``'s
+chunked star) and the nonblocking machinery (``collective._nb_submit``):
+
+- :class:`ChunkSchedule` — how a bulk payload splits into K pipeline chunks
+  (``config.pipeline_min_bytes`` / ``config.pipeline_chunks``, the
+  ``shm_min_bytes`` knob pattern). Chunking is only ever applied to
+  elementwise rank-order folds, where it is *chunk-separable*: the pipelined
+  result is bitwise-identical to the monolithic one.
+- :class:`PlanCache` / :class:`CollectivePlan` — repeated same-shape
+  collectives (the training-loop case) resolve their op, combine closure,
+  opname tag, trace signature and chunk schedule ONCE and reuse the plan;
+  keyed on (comm, op, dtype, shape, flavor) and invalidated by
+  ``Comm.free`` and by config reloads (``config.GENERATION``).
+- :class:`ChunkProgress` — per-request in-flight chunk state that the
+  progress threads (the per-comm nonblocking worker; the multi-process
+  drainer feeding it) advance while the rank thread is in user code, and
+  that ``Wait``/``Test`` join instead of executing the whole op.
+
+:class:`PersistentCollRequest` is the persistent-collective handle behind
+``Allreduce_init``-style APIs (MPI-4 persistent collectives), mirroring the
+persistent P2P machinery (:class:`tpu_mpi.pointtopoint.Prequest`).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+from . import error as _ec
+from .error import MPIError
+
+
+class ChunkSchedule:
+    """A bulk payload's split into pipeline chunks.
+
+    ``bounds`` is a list of flat-element ``(lo, hi)`` half-open ranges
+    covering ``[0, count)`` in order. Every chunk has ``base`` elements and
+    the LAST chunk absorbs the remainder (``count % nchunks``), so uneven
+    payloads never produce an empty chunk and never reorder elements —
+    chunked rank-order folds stay bitwise-equal to monolithic ones.
+    """
+
+    __slots__ = ("count", "nchunks", "bounds")
+
+    def __init__(self, count: int, nchunks: int):
+        count, nchunks = int(count), int(nchunks)
+        nchunks = max(1, min(nchunks, count))
+        base = count // nchunks
+        self.count = count
+        self.nchunks = nchunks
+        self.bounds = [(i * base, (i + 1) * base if i < nchunks - 1 else count)
+                       for i in range(nchunks)]
+
+    @classmethod
+    def maybe(cls, count: int, itemsize: int) -> Optional["ChunkSchedule"]:
+        """The schedule for a payload, or None when pipelining is off or
+        the payload is below ``pipeline_min_bytes`` (monolithic path)."""
+        from . import config
+        cfg = config.load()
+        if cfg.pipeline_min_bytes <= 0 or cfg.pipeline_chunks < 2:
+            return None
+        if int(count) * int(itemsize) < cfg.pipeline_min_bytes:
+            return None
+        sched = cls(count, cfg.pipeline_chunks)
+        return sched if sched.nchunks > 1 else None
+
+    def __iter__(self):
+        return iter(self.bounds)
+
+    def __len__(self) -> int:
+        return self.nchunks
+
+    def __repr__(self) -> str:
+        return f"ChunkSchedule({self.count} elems x {self.nchunks} chunks)"
+
+
+class CollectivePlan:
+    """Everything a repeated same-signature collective can pre-resolve:
+    the resolved :class:`~tpu_mpi.operators.Op`, the rendezvous combine
+    closure, the opname tag, the trace-verifier signature, the algorithm
+    hint for the multi-process tier, and the chunk schedule."""
+
+    __slots__ = ("opname", "op", "combine", "sig", "hint", "schedule",
+                 "generation")
+
+    def __init__(self, opname: str, op: Any, combine: Callable, sig: dict,
+                 hint: Any, schedule: Optional[ChunkSchedule],
+                 generation: int):
+        self.opname = opname
+        self.op = op
+        self.combine = combine
+        self.sig = sig
+        self.hint = hint
+        self.schedule = schedule
+        self.generation = generation
+
+
+class PlanCache:
+    """Bounded LRU of :class:`CollectivePlan` keyed on the collective's
+    full call signature: (cid, family, op identity, count, dtype, array
+    kind, flavor). Entries from a stale ``config.GENERATION`` miss (the
+    pipeline knobs feed the schedule), and :meth:`invalidate` drops a
+    freed communicator's plans. Unhashable keys (an unhashable custom op)
+    simply never cache."""
+
+    CAP = 128
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plans: "OrderedDict[Any, CollectivePlan]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: Any) -> Optional[CollectivePlan]:
+        from . import config
+        try:
+            hash(key)
+        except TypeError:
+            return None
+        with self._lock:
+            plan = self._plans.get(key)
+            if plan is not None and plan.generation == config.GENERATION:
+                self._plans.move_to_end(key)
+                self.hits += 1
+                return plan
+            if plan is not None:                 # stale config generation
+                del self._plans[key]
+            self.misses += 1
+            return None
+
+    def put(self, key: Any, plan: CollectivePlan) -> None:
+        try:
+            hash(key)
+        except TypeError:
+            return
+        with self._lock:
+            self._plans[key] = plan
+            self._plans.move_to_end(key)
+            while len(self._plans) > self.CAP:
+                self._plans.popitem(last=False)
+
+    def invalidate(self, cid: Any = None) -> None:
+        """Drop every plan (no args) or one communicator's plans
+        (``Comm.free``)."""
+        with self._lock:
+            if cid is None:
+                self._plans.clear()
+                return
+            for k in [k for k in self._plans if k[0] == cid]:
+                del self._plans[k]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._plans), "hits": self.hits,
+                    "misses": self.misses}
+
+
+#: The process-wide plan cache. ``Comm.free`` invalidates per-cid; config
+#: reloads invalidate by generation.
+plans = PlanCache()
+
+
+class ChunkProgress:
+    """In-flight chunk state for one nonblocking collective, advanced by
+    whichever progress thread moves the op (the per-comm worker; at a
+    multi-process star root, the fold loop fed by the drainer) and read by
+    ``Test``/``Wait`` and by benchmarks. ``total`` is 0 until the op's
+    chunk schedule is known (monolithic ops never set it)."""
+
+    __slots__ = ("done", "total", "stage")
+
+    def __init__(self):
+        self.done = 0
+        self.total = 0
+        self.stage = "pending"
+
+    def begin(self, total: int, stage: str) -> None:
+        self.total = int(total)
+        self.done = 0
+        self.stage = stage
+
+    def note(self, done: Optional[int] = None) -> None:
+        self.done = self.done + 1 if done is None else int(done)
+
+    def __repr__(self) -> str:
+        return f"<ChunkProgress {self.stage} {self.done}/{self.total}>"
+
+
+_progress_tls = threading.local()
+
+
+def bind_progress(prog: Optional[ChunkProgress]) -> None:
+    """Bind the progress record the current thread's collective work should
+    advance (set by the nonblocking worker around each op; None clears)."""
+    _progress_tls.current = prog
+
+
+def current_progress() -> Optional[ChunkProgress]:
+    return getattr(_progress_tls, "current", None)
+
+
+def progress_begin(total: int, stage: str) -> Optional[ChunkProgress]:
+    prog = current_progress()
+    if prog is not None:
+        prog.begin(total, stage)
+    return prog
+
+
+def progress_note(prog: Optional[ChunkProgress]) -> None:
+    if prog is not None:
+        prog.note()
+
+
+class PersistentCollRequest:
+    """Persistent collective request (MPI-4 ``MPI_Allreduce_init`` family),
+    mirroring :class:`tpu_mpi.pointtopoint.Prequest`: created INACTIVE with
+    the operation's arguments bound (and its plan pre-resolved), armed by
+    ``Start``/``Startall``, completed by the whole Wait/Test family, then
+    inactive-but-reusable for the next round. Each Start initiates the
+    collective on this rank's per-comm worker, so rounds progress in the
+    background exactly like the one-shot ``I*`` ops."""
+
+    def __init__(self, make: Callable[[], Any], kind: str, buffer: Any):
+        self._make = make           # () -> a live CollRequest
+        self._inner = None
+        self.kind = kind            # e.g. "pallreduce"
+        self.buffer = buffer
+        self.status = None
+        self.result = None          # allocating flavors: last round's value
+
+    def start(self) -> "PersistentCollRequest":
+        if self._inner is not None and self._inner.active:
+            raise MPIError("Start on an already-active persistent request",
+                           code=_ec.ERR_REQUEST)
+        self._inner = self._make()
+        return self
+
+    @property
+    def active(self) -> bool:
+        return self._inner is not None and self._inner.active
+
+    @property
+    def progress(self) -> Optional[ChunkProgress]:
+        return getattr(self._inner, "progress", None)
+
+    def test(self) -> bool:
+        if self._inner is None:
+            return True
+        done = self._inner.test()
+        if done:
+            self.result = self._inner.result
+        return done
+
+    def wait(self):
+        from .pointtopoint import STATUS_EMPTY
+        if self._inner is None:
+            return self.status or STATUS_EMPTY
+        self.status = self._inner.wait()
+        self.result = self._inner.result
+        self._inner = None          # inactive, ready for the next Start
+        return self.status
+
+    def _consume(self):
+        from .pointtopoint import STATUS_EMPTY
+        if self._inner is None:
+            return self.status or STATUS_EMPTY
+        self.status = self._inner.wait() if self._inner.active \
+            else (self._inner.status or STATUS_EMPTY)
+        self.result = self._inner.result
+        self._inner = None
+        return self.status
+
+    def cancel(self) -> None:
+        raise MPIError("nonblocking collectives cannot be cancelled")
+
+    def __repr__(self) -> str:
+        return f"<PersistentCollRequest {self.kind} active={self.active}>"

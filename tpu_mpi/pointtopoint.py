@@ -703,16 +703,23 @@ def Recv_init(buf: Any, src: int, tag: int, comm: Comm) -> Prequest:
 
 
 def Start(req: Prequest) -> Prequest:
-    """Arm a persistent or partitioned request (MPI_Start)."""
+    """Arm a persistent or partitioned request (MPI_Start) — P2P
+    (Send_init/Recv_init), partitioned (Psend_init/Precv_init), or
+    persistent collective (Allreduce_init/Bcast_init/Barrier_init,
+    tpu_mpi.collective)."""
     if not hasattr(req, "start"):
         raise MPIError(code=_ec.ERR_REQUEST,
                        msg="Start requires a persistent/partitioned request "
-                       "(Send_init/Recv_init/Psend_init/Precv_init)")
+                       "(Send_init/Recv_init/Psend_init/Precv_init/"
+                       "Allreduce_init/Bcast_init/Barrier_init)")
     return req.start()
 
 
 def Startall(reqs: Sequence[Prequest]) -> Sequence[Prequest]:
-    """Arm several persistent requests (MPI_Startall)."""
+    """Arm several persistent requests (MPI_Startall). Persistent
+    collectives must be started in the same order on every rank (the
+    MPI-4 initiation-order rule); a single Startall list in matching
+    order satisfies it."""
     for r in reqs:
         Start(r)
     return reqs
