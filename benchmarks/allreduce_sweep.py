@@ -25,9 +25,12 @@ hardware allows:
   native C++ transport (ring reduce-scatter+allgather above the size
   threshold, star rendezvous below — the tier VERDICT r1 item 4 asked to
   quantify). Runs via ``launch_processes``.
+- ``procs_<algo>`` — one lane per tpu_mpi.tune portfolio algorithm (star,
+  shm, rdouble, rabenseifner, ring), each forced via TPU_MPI_COLL_ALGO in
+  lockstep inside one SPMD launch; selected with ``--lanes procs_algos``.
 
 Usage: python benchmarks/allreduce_sweep.py [--max-bytes N] [--ranks N]
-       [--lanes host,psum,pallas,procs] [-o results/file.json]
+       [--lanes host,psum,pallas,procs,procs_algos] [-o results/file.json]
 """
 
 from __future__ import annotations
@@ -180,55 +183,95 @@ def bench_pallas(sizes: list[int]) -> list[dict]:
                            repeats=1 if interp else REPEATS)
 
 
-def bench_procs(nranks: int, max_bytes: int) -> list[dict]:
+def bench_procs(nranks: int, max_bytes: int,
+                algos: bool = False) -> list[dict] | dict:
     """Cross-process Allreduce sweep: re-enter this script as an SPMD child
-    under launch_processes; rank 0 writes rows to --rows-out."""
+    under launch_processes; rank 0 writes rows to --rows-out.
+
+    With ``algos=True`` the child additionally forces each eligible
+    tpu_mpi.tune portfolio algorithm per size (TPU_MPI_COLL_ALGO + config
+    reload in lockstep) and the return value is a dict of per-algorithm
+    lanes (``procs_star``, ``procs_shm``, ...) instead of one list, so
+    the crossovers the autotuner measures are visible in the artifact."""
     import tempfile
     from tpu_mpi.launcher import launch_processes
 
+    extra = ["--algos"] if algos else []
     with tempfile.NamedTemporaryFile("r", suffix=".jsonl") as rows_f:
         code = launch_processes(
             os.path.abspath(__file__), nranks,
-            ["--max-bytes", str(max_bytes), "--rows-out", rows_f.name],
+            ["--max-bytes", str(max_bytes), "--rows-out", rows_f.name] + extra,
             timeout=3600)
         if code != 0:
             print(f"procs lane failed with exit code {code}", file=sys.stderr)
-            return []
-        return [json.loads(l) for l in rows_f.read().splitlines()]
+            return {} if algos else []
+        rows = [json.loads(l) for l in rows_f.read().splitlines()]
+        if not algos:
+            return rows
+        lanes: dict = {}
+        for row in rows:
+            lanes.setdefault(f"procs_{row.pop('algo')}", []).append(row)
+        return lanes
 
 
-def _procs_child(max_bytes: int, rows_out: str) -> None:
+def _procs_child(max_bytes: int, rows_out: str, algos: bool = False) -> None:
     import time
     import numpy as np
     import tpu_mpi as MPI
+    from tpu_mpi import config as _cfg
+    from tpu_mpi import tune as _tune
 
     MPI.Init()
     comm = MPI.COMM_WORLD
-    rank = comm.rank()
+    rank, size = comm.rank(), comm.size()
+
+    def measure(n, warmup, iters):
+        buf = np.ones(n, np.float32)
+        out = np.zeros(n, np.float32)
+        for _ in range(warmup):
+            MPI.Allreduce(buf, out, MPI.SUM, comm)
+        best = float("inf")
+        for _ in range(REPEATS):
+            MPI.Barrier(comm)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                MPI.Allreduce(buf, out, MPI.SUM, comm)
+            MPI.Barrier(comm)
+            best = min(best, (time.perf_counter() - t0) / iters)
+        return best
+
     with open(rows_out or os.devnull, "a") as f:
         for nbytes in size_sweep(max_bytes):
             n = max(1, nbytes // 4)
-            buf = np.ones(n, np.float32)
-            out = np.zeros(n, np.float32)
             warmup, iters = iters_for(nbytes)
             iters = max(2, iters // 4)       # wire rounds cost more
-            for _ in range(warmup):
-                MPI.Allreduce(buf, out, MPI.SUM, comm)
-            best = float("inf")
-            for _ in range(REPEATS):
-                MPI.Barrier(comm)
-                t0 = time.perf_counter()
-                for _ in range(iters):
-                    MPI.Allreduce(buf, out, MPI.SUM, comm)
-                MPI.Barrier(comm)
-                best = min(best, (time.perf_counter() - t0) / iters)
-            if rank == 0:
-                row = {"bytes": n * 4, "lat_us": round(best * 1e6, 2),
-                       "algbw_gbps": round(n * 4 / best / 1e9, 3)}
-                f.write(json.dumps(row) + "\n")
-                f.flush()
-                print(f"procs {n * 4:>11d} B  {best * 1e6:>10.1f} us  "
-                      f"{row['algbw_gbps']:>8.3f} GB/s", file=sys.stderr)
+            if algos:
+                # identical schedule on every rank: the eligibility inputs
+                # (size, bytes, same-host shm) are rank-uniform
+                lane = _tune.candidates(
+                    "allreduce", size, n * 4, commutative=True,
+                    elementwise=True, numeric=True,
+                    shm=os.path.isdir("/dev/shm"))
+            else:
+                lane = [None]
+            for algo in lane:
+                if algo is not None:
+                    os.environ["TPU_MPI_COLL_ALGO"] = f"allreduce={algo}"
+                    _cfg.load(refresh=True)
+                best = measure(n, warmup, iters)
+                if rank == 0:
+                    row = {"bytes": n * 4, "lat_us": round(best * 1e6, 2),
+                           "algbw_gbps": round(n * 4 / best / 1e9, 3)}
+                    if algo is not None:
+                        row["algo"] = algo
+                    f.write(json.dumps(row) + "\n")
+                    f.flush()
+                    tag = f"procs:{algo}" if algo else "procs"
+                    print(f"{tag:<18} {n * 4:>11d} B  {best * 1e6:>10.1f} us"
+                          f"  {row['algbw_gbps']:>8.3f} GB/s", file=sys.stderr)
+            if algos:
+                os.environ.pop("TPU_MPI_COLL_ALGO", None)
+                _cfg.load(refresh=True)
     MPI.Finalize()
 
 
@@ -242,11 +285,14 @@ def main() -> None:
     ap.add_argument("--ranks", type=int, default=4)
     ap.add_argument("--lanes", default="host,ingraph,psum,pallas")
     ap.add_argument("--rows-out", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--algos", action="store_true",
+                    help="per-algorithm procs lanes (procs_star, procs_shm, "
+                         "...) forced via TPU_MPI_COLL_ALGO")
     ap.add_argument("-o", "--out", default="-")
     args = ap.parse_args()
 
     if os.environ.get("TPU_MPI_PROC_RANK") is not None:
-        _procs_child(args.max_bytes, args.rows_out)
+        _procs_child(args.max_bytes, args.rows_out, args.algos)
         return
 
     plat = detect_platform()
@@ -303,6 +349,9 @@ def main() -> None:
         record["lanes"]["pallas"] = bench_pallas(sub)
     if "procs" in lanes:
         record["lanes"]["procs"] = bench_procs(args.ranks, args.max_bytes)
+    if "procs_algos" in lanes or args.algos:
+        record["lanes"].update(
+            bench_procs(args.ranks, args.max_bytes, algos=True))
     from common import assert_artifact_schema
     assert_artifact_schema(record)        # artifact hygiene: fail, not emit
     emit(args.out, record)

@@ -343,6 +343,29 @@ def test_shared_module_globals_one_dict_per_payload():
     assert peek2() == 0
 
 
+def test_same_module_distinct_namespace_dicts_share_globals():
+    """The shared-globals registry keys on the source MODULE NAME, not the
+    identity of the ``__globals__`` dict: two by-value functions claiming
+    the same module (exec'd in separate namespaces, or pre/post reload)
+    re-knit to ONE namespace on the peer, like functions in a real module."""
+    ns1 = {"__name__": "tpu_mpi_fake_mod"}
+    exec("def put(v):\n    global box\n    box = v\n", ns1)
+    ns2 = {"__name__": "tpu_mpi_fake_mod"}
+    exec("def get():\n    return box\n", ns2)
+    assert ns1["put"].__globals__ is not ns2["get"].__globals__
+    put, get = pickle.loads(S.dumps((ns1["put"], ns2["get"])))
+    assert put.__globals__ is get.__globals__
+    put(7)
+    assert get() == 7
+    # functions WITHOUT a module name stay isolated (identity fallback)
+    anon1 = {"__name__": None}
+    exec("def f():\n    return 1\n", anon1)
+    anon2 = {"__name__": None}
+    exec("def g():\n    return 2\n", anon2)
+    f2, g2 = pickle.loads(S.dumps((anon1["f"], anon2["g"])))
+    assert f2.__globals__ is not g2.__globals__
+
+
 def test_marshal_magic_tag_rejects_foreign_bytecode():
     """Marshalled code carries the interpreter's pyc magic; a blob from a
     different CPython raises a diagnosable MPIError instead of marshal's

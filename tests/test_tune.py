@@ -1,0 +1,210 @@
+"""Collective algorithm selection (tpu_mpi.tune): eligibility clamps,
+heuristic crossovers, the force-override knob, TOML tuning-table
+round-trips, and resolution precedence (override > measured table >
+heuristic). The final test proves a measured table actually CHANGES the
+selected algorithm of a live job — observed structurally through the
+event IR's ``algo`` field (tpu_mpi.analyze), not through timing.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import tpu_mpi as MPI
+from tpu_mpi import config, tune
+from tpu_mpi.analyze import events as ev
+from tpu_mpi.testing import run_spmd
+
+
+@pytest.fixture(autouse=True)
+def clean_config(monkeypatch):
+    for k in ("TPU_MPI_COLL_ALGO", "TPU_MPI_TUNE_TABLE",
+              "TPU_MPI_COLL_SHM_MAX_BYTES", "TPU_MPI_TRACE"):
+        monkeypatch.delenv(k, raising=False)
+    config.load(refresh=True)
+    yield
+    config.load(refresh=True)
+
+
+# -- eligibility -------------------------------------------------------------
+
+def test_star_always_eligible():
+    for coll in tune.PORTFOLIO:
+        assert tune.eligible(coll, "star", 1, None)
+        assert tune.eligible(coll, "star", 64, 0)
+
+
+def test_shm_eligibility_gates():
+    kw = dict(commutative=True, elementwise=True, numeric=True)
+    assert tune.eligible("allreduce", "shm", 4, 64, shm=True, **kw)
+    # off-host, non-elementwise, oversized, and unknown-size payloads
+    assert not tune.eligible("allreduce", "shm", 4, 64, shm=False, **kw)
+    assert not tune.eligible("allreduce", "shm", 4, 64, shm=True,
+                             commutative=True, elementwise=False)
+    cap = config.load().coll_shm_max_bytes
+    assert not tune.eligible("allreduce", "shm", 4, cap, shm=True, **kw)
+    assert not tune.eligible("allreduce", "shm", 4, None, shm=True, **kw)
+    # barrier has no payload: shm flag alone decides
+    assert tune.eligible("barrier", "shm", 4, None, shm=True)
+    assert not tune.eligible("barrier", "shm", 4, None, shm=False)
+
+
+def test_shm_disabled_by_zero_cap(monkeypatch):
+    monkeypatch.setenv("TPU_MPI_COLL_SHM_MAX_BYTES", "0")
+    config.load(refresh=True)
+    assert not tune.eligible("barrier", "shm", 4, None, shm=True)
+    assert tune.select("barrier", 4, None, shm=True) == "dissemination"
+
+
+def test_ring_allreduce_needs_commutativity():
+    kw = dict(elementwise=True, numeric=True)
+    assert tune.eligible("allreduce", "ring", 4, 1 << 20,
+                         commutative=True, **kw)
+    assert not tune.eligible("allreduce", "ring", 4, 1 << 20,
+                             commutative=False, **kw)
+    assert not tune.eligible("allreduce", "ring", 4, None,
+                             commutative=True, **kw)
+
+
+def test_unknown_algo_and_single_rank():
+    assert not tune.eligible("allreduce", "binomial", 4, 64)
+    assert not tune.eligible("allreduce", "rdouble", 1, 64)
+    assert tune.select("allreduce", 1, 64) == "star"
+
+
+# -- heuristic ---------------------------------------------------------------
+
+def test_heuristic_allreduce_crossovers(monkeypatch):
+    from tpu_mpi import backend as B
+    kw = dict(commutative=True, elementwise=True, numeric=True)
+    assert tune.heuristic("allreduce", 8, 64, shm=True, **kw) == "shm"
+    assert tune.heuristic("allreduce", 8, 64, shm=False, **kw) == "star"
+    big = B._RING_MIN_BYTES
+    assert tune.heuristic("allreduce", 8, big, shm=False, **kw) == "ring"
+    # the historical RING knob stays live: a monkeypatched threshold moves
+    # the crossover, and the ring outranks the shm fold (bulk first)
+    monkeypatch.setattr(B, "_RING_MIN_BYTES", 32)
+    assert tune.heuristic("allreduce", 8, 64, shm=True, **kw) == "ring"
+
+
+def test_heuristic_barrier_and_bcast():
+    assert tune.heuristic("barrier", 8, None, shm=True) == "shm"
+    assert tune.heuristic("barrier", 8, None, shm=False) == "dissemination"
+    assert tune.heuristic("bcast", 8, 64) == "binomial"
+    assert tune.heuristic("reduce", 8, 64) == "star"
+    assert tune.heuristic("alltoallv", 8, None, numeric=True) == "pairwise"
+    assert tune.heuristic("alltoallv", 8, None, numeric=False) == "star"
+
+
+# -- override ----------------------------------------------------------------
+
+def test_override_pins_and_clamps(monkeypatch):
+    monkeypatch.setenv("TPU_MPI_COLL_ALGO",
+                       "allreduce=rdouble, barrier=dissemination")
+    config.load(refresh=True)
+    assert tune.select("allreduce", 4, 64, commutative=True,
+                       elementwise=True) == "rdouble"
+    assert tune.select("barrier", 4, None, shm=True) == "dissemination"
+    # an override that is ineligible for THIS signature degrades safely
+    monkeypatch.setenv("TPU_MPI_COLL_ALGO", "allreduce=shm")
+    config.load(refresh=True)
+    assert tune.select("allreduce", 4, 64, commutative=True,
+                       elementwise=True, shm=False) == "star"
+
+
+def test_override_ignores_garbage(capsys):
+    assert tune.parse_override("allreduce=warp9,nonsense,barrier=shm") == \
+        {"barrier": "shm"}
+    # cached: a second parse of the same spec does not re-warn
+    tune.parse_override("allreduce=warp9,nonsense,barrier=shm")
+
+
+# -- tuning table ------------------------------------------------------------
+
+def test_table_roundtrip_and_lookup(tmp_path):
+    path = str(tmp_path / "tune.toml")
+    table = {
+        ("allreduce", 8): [(65536, "ring"), (0, "shm")],
+        ("allreduce", 2): [(0, "star")],
+        ("barrier", 8): [(0, "dissemination")],
+    }
+    tune.write_table(path, table, header="test table")
+    loaded = tune.load_table(path)
+    assert loaded[("allreduce", 8)] == [(65536, "ring"), (0, "shm")]
+    assert loaded[("barrier", 8)] == [(0, "dissemination")]
+    # threshold walk: at/above 64 KiB the ring wins, below it the shm fold
+    assert tune._table_lookup(loaded, "allreduce", 8, 65536) == "ring"
+    assert tune._table_lookup(loaded, "allreduce", 8, 65535) == "shm"
+    # nranks interpolation: nearest measured size below, else smallest
+    assert tune._table_lookup(loaded, "allreduce", 5, 64) == "star"
+    assert tune._table_lookup(loaded, "allreduce", 16, 1 << 20) == "ring"
+    assert tune._table_lookup(loaded, "bcast", 8, 64) is None
+
+
+def test_malformed_table_falls_back(tmp_path, capsys):
+    path = str(tmp_path / "bad.toml")
+    with open(path, "w") as f:
+        f.write("[allreduce.n4\nnot toml at all ===\n")
+    assert tune.load_table(path) == {}
+    assert tune.load_table(str(tmp_path / "missing.toml")) == {}
+
+
+def test_select_precedence(tmp_path, monkeypatch):
+    path = str(tmp_path / "tune.toml")
+    tune.write_table(path, {("allreduce", 4): [(0, "rdouble")]})
+    monkeypatch.setenv("TPU_MPI_TUNE_TABLE", path)
+    config.load(refresh=True)
+    kw = dict(commutative=True, elementwise=True)
+    # the measured table overrides the heuristic...
+    assert tune.select("allreduce", 4, 64, **kw) == "rdouble"
+    # ...a force-pin overrides the table...
+    monkeypatch.setenv("TPU_MPI_COLL_ALGO", "allreduce=star")
+    config.load(refresh=True)
+    assert tune.select("allreduce", 4, 64, **kw) == "star"
+    # ...and an unmeasured collective falls through to the heuristic
+    monkeypatch.delenv("TPU_MPI_COLL_ALGO")
+    config.load(refresh=True)
+    assert tune.select("bcast", 4, 64, **kw) == "binomial"
+
+
+def test_table_ineligible_entry_falls_through(tmp_path, monkeypatch):
+    # a table tuned on a single-host run must not force shm onto a
+    # multi-host communicator: the eligibility clamp drops the entry
+    path = str(tmp_path / "tune.toml")
+    tune.write_table(path, {("allreduce", 4): [(0, "shm")]})
+    monkeypatch.setenv("TPU_MPI_TUNE_TABLE", path)
+    config.load(refresh=True)
+    assert tune.select("allreduce", 4, 64, commutative=True,
+                       elementwise=True, shm=False) == "star"
+
+
+# -- the observable proof: a table changes a live job's selection ------------
+
+def _traced_allreduce_algos(nprocs=2):
+    """Run a tiny SPMD job with tracing on; return the set of algo fields
+    recorded on Allreduce events."""
+    def body():
+        comm = MPI.COMM_WORLD
+        MPI.Allreduce(np.arange(4.0), MPI.SUM, comm)
+
+    run_spmd(body, nprocs)
+    tr = ev.last_trace()
+    assert tr is not None
+    return {e.algo for e in tr.events() if e.kind == "coll"
+            and str(e.op).startswith("Allreduce")}
+
+
+def test_tune_table_changes_selection_in_event_ir(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_MPI_TRACE", "1")
+    config.load(refresh=True)
+    # heuristic: small thread-tier Allreduce (no same-host shm topology on
+    # the thread tier) resolves to the star
+    assert _traced_allreduce_algos() == {"star"}
+    # the measured table moves the same signature to recursive doubling —
+    # a structural, timing-free observation through the event IR
+    path = str(tmp_path / "tune.toml")
+    tune.write_table(path, {("allreduce", 2): [(0, "rdouble")]})
+    monkeypatch.setenv("TPU_MPI_TUNE_TABLE", path)
+    config.load(refresh=True)
+    assert _traced_allreduce_algos() == {"rdouble"}

@@ -89,7 +89,7 @@ class Event:
 
     __slots__ = ("kind", "rank", "op", "cid", "seq", "peer", "root", "tag",
                  "count", "dtype", "win", "lo", "hi", "vc", "origin", "grp",
-                 "file", "line", "t")
+                 "algo", "file", "line", "t")
 
     def __init__(self, kind: str, rank: int, **kw: Any):
         self.kind = kind          # "coll" | "send" | "recv" | "rma" | "sync"
@@ -206,7 +206,7 @@ def record_collective(comm: Any, opname: str,
     ev = Event("coll", wrank, op=str(opname), cid=comm.cid,
                grp=tuple(comm.group), root=sig.get("root"),
                dtype=sig.get("dtype"), count=sig.get("count"),
-               file=f, line=ln)
+               algo=sig.get("algo"), file=f, line=ln)
     return tr.record(ev)
 
 

@@ -92,6 +92,19 @@ class Config:
     trace: bool = False
     # per-rank event ring-buffer capacity while tracing is on.
     trace_buffer: int = 4096
+    # collective algorithm layer (tpu_mpi.tune, docs/performance.md
+    # "Algorithm selection"): path of a measured tuning table written by
+    # ``tpurun --tune``; "" = use the built-in heuristic crossovers.
+    tune_table: str = ""
+    # force-override for debugging/CI: comma list of collective=algorithm
+    # pins (e.g. "allreduce=rdouble,barrier=star"), clamped by per-
+    # algorithm eligibility; "" = no override.
+    coll_algo: str = ""
+    # same-host shared-memory collective fold (the libmpi coll/sm analog):
+    # Allreduce payloads strictly below this many bytes — and Barrier —
+    # use one mmap'd /dev/shm segment per communicator instead of O(P)
+    # transport messages when all ranks share a host; 0 disables the lane.
+    coll_shm_max_bytes: int = 1 << 16
 
     def replace(self, **kw: Any) -> "Config":
         d = {f.name: getattr(self, f.name) for f in fields(self)}
@@ -118,6 +131,9 @@ _ENV_MAP = {
     "fused_fold": "TPU_MPI_FUSED_FOLD",
     "trace": "TPU_MPI_TRACE",
     "trace_buffer": "TPU_MPI_TRACE_BUFFER",
+    "tune_table": "TPU_MPI_TUNE_TABLE",
+    "coll_algo": "TPU_MPI_COLL_ALGO",
+    "coll_shm_max_bytes": "TPU_MPI_COLL_SHM_MAX_BYTES",
 }
 
 _lock = threading.Lock()

@@ -239,9 +239,17 @@ def install_tpurun(command: str = "tpurun",
 
 
 def main(argv: Optional[list[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv[:1] == ["--tune"]:
+        # `tpurun --tune [...]` — the collective-algorithm autotuner
+        # (tpu_mpi.tune): sweep the portfolio on this substrate and write
+        # a tuning table. All following args belong to the tuner.
+        from . import tune
+        return tune.main(argv[1:])
     p = argparse.ArgumentParser(
         prog="tpurun",
-        description="Run an SPMD tpu_mpi program on N ranks (mpiexec analog)")
+        description="Run an SPMD tpu_mpi program on N ranks (mpiexec analog); "
+                    "`tpurun --tune` runs the collective autotuner")
     from . import config
     cfg = config.load()
     p.add_argument("-n", "--np", type=int, default=cfg.nprocs or None,

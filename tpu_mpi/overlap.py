@@ -83,14 +83,16 @@ class CollectivePlan:
     """Everything a repeated same-signature collective can pre-resolve:
     the resolved :class:`~tpu_mpi.operators.Op`, the rendezvous combine
     closure, the opname tag, the trace-verifier signature, the algorithm
-    hint for the multi-process tier, and the chunk schedule."""
+    hint for the multi-process tier (carrying the ``tune.select`` decision,
+    so the algorithm is resolved once per signature and invalidated with
+    the plan), and the chunk schedule."""
 
     __slots__ = ("opname", "op", "combine", "sig", "hint", "schedule",
-                 "generation")
+                 "generation", "algo")
 
     def __init__(self, opname: str, op: Any, combine: Callable, sig: dict,
                  hint: Any, schedule: Optional[ChunkSchedule],
-                 generation: int):
+                 generation: int, algo: str = "star"):
         self.opname = opname
         self.op = op
         self.combine = combine
@@ -98,6 +100,7 @@ class CollectivePlan:
         self.hint = hint
         self.schedule = schedule
         self.generation = generation
+        self.algo = algo
 
 
 class PlanCache:

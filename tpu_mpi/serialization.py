@@ -291,15 +291,24 @@ class Pickler(pickle.Pickler):
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        # id(fn.__globals__) -> the placeholder dict every by-value function
-        # from that namespace reconstructs its __globals__ into. Pickling the
-        # SAME dict object for each of them lets the memo share it, so two
+        # module name -> the placeholder dict every by-value function from
+        # that module reconstructs its __globals__ into. Pickling the SAME
+        # dict object for each of them lets the memo share it, so two
         # siblings from one module see each other's globals on the peer
         # (one dict per source module per payload, fresh per payload).
         self._shared_globals: dict = {}
 
     def _globals_anchor(self, fn: types.FunctionType) -> dict:
-        key = id(fn.__globals__)
+        # Keyed by source MODULE NAME, not globals-dict identity: two
+        # by-value functions from one module re-knit to one shared
+        # namespace on the peer even when their ``__globals__`` dicts
+        # differ by identity (module reload; exec-built namespaces that
+        # set ``__name__``). Functions without a module fall back to
+        # identity keying so unrelated anonymous namespaces stay separate.
+        # The registry lives on the Pickler — one per payload — so
+        # separate payloads still reconstruct disjoint namespaces.
+        key = (getattr(fn, "__module__", None)
+               or f"<anonymous:{id(fn.__globals__)}>")
         anchor = self._shared_globals.get(key)
         if anchor is None:
             anchor = self._shared_globals[key] = {}

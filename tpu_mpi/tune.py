@@ -1,0 +1,661 @@
+"""Collective algorithm portfolio + measurement-driven autotuner.
+
+The reference outsources algorithm choice to libmpi's ``coll_tuned`` module
+(``/root/reference/src/collective.jl:691-738``): MPICH/OpenMPI pick ring vs
+recursive-doubling vs binomial per (collective, communicator size, message
+size) from a *measured* decision table. This module is that layer for the
+multi-process tier:
+
+- :data:`PORTFOLIO` names every algorithm the proc-tier engine
+  (``backend.ProcChannel``) implements per collective, and
+  :func:`eligible` is the rank-uniform eligibility rule for each (the same
+  deterministic-function-of-shared-values contract every tier gate obeys,
+  so ranks can never pick different protocols for one round).
+- :func:`select` is the ONE decision function — it replaces the scattered
+  threshold constants. Resolution order: force-override
+  (``TPU_MPI_COLL_ALGO`` / ``config.coll_algo``, for debugging and CI) →
+  measured tuning table (``TPU_MPI_TUNE_TABLE`` / ``config.tune_table``,
+  written by ``tpurun --tune``) → built-in heuristic. Every layer is
+  clamped by :func:`eligible`, so a stale table or an aggressive override
+  degrades to a correct algorithm instead of a protocol error.
+  ``tpu_mpi.collective`` calls it at plan-build time, so the chosen
+  algorithm is cached inside the :class:`~tpu_mpi.overlap.CollectivePlan`
+  and invalidated with it (``config.GENERATION`` bumps on any reload,
+  including a tuning-table change).
+- :func:`autotune` / ``python -m tpu_mpi.tune`` / ``tpurun --tune`` sweep
+  algorithm × size ladder × nranks *on the actual substrate* (real child
+  processes over the real transport), assert every algorithm's result is
+  bitwise-equal to the star reference, and persist the measured crossovers
+  as a TOML table :func:`select` loads.
+
+The built-in heuristic intentionally reproduces the engine's historical
+behavior (star below ``TPU_MPI_RING_MIN_BYTES``, ring above for commutative
+ops, dissemination Barrier, binomial Bcast) plus the same-host shm fold for
+the small-message band — theory-guided guesses. The measured table exists
+precisely because such guesses are wrong per substrate: on a single-core
+TCP-loopback box, message *count* dominates and log-P algorithms lose to
+the star, while the shm fold (no transport hop at all) wins by an order of
+magnitude; on a real multi-host network the table flips the other way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import config
+
+__all__ = ["PORTFOLIO", "eligible", "candidates", "select", "heuristic",
+           "parse_override", "load_table", "write_table", "autotune", "main"]
+
+
+# Every algorithm the proc-tier engine implements, per collective. "star"
+# is the generic root-serialized rendezvous (always eligible; the chunked
+# "starc" pipeline is a transparent refinement of it, not a separate
+# selection). The rest map to ProcChannel runners in tpu_mpi/backend.py.
+PORTFOLIO: Dict[str, Tuple[str, ...]] = {
+    "allreduce":  ("star", "shm", "rdouble", "rabenseifner", "ring"),
+    "barrier":    ("star", "shm", "dissemination"),
+    "bcast":      ("star", "binomial"),
+    "reduce":     ("star", "binomial"),
+    "gather":     ("star", "binomial"),
+    "scatter":    ("star", "binomial"),
+    "allgather":  ("star", "ring"),
+    "allgatherv": ("star", "ring"),
+    "alltoall":   ("star", "pairwise"),
+    "alltoallv":  ("star", "pairwise"),
+}
+
+
+def eligible(coll: str, algo: str, nranks: int, nbytes: Optional[int], *,
+             commutative: bool = False, elementwise: bool = False,
+             shm: bool = False, numeric: bool = True) -> bool:
+    """Whether ``algo`` may run ``coll`` for this signature.
+
+    Must stay a deterministic function of rank-uniform values: collective
+    name, communicator size, payload bytes (uniform by the MPI count/dtype
+    contract), op properties, config, and same-host topology (every rank of
+    a single-host communicator agrees it is single-host). ``nbytes`` None
+    means "payload size unknown" (object payloads) and disqualifies every
+    size-gated algorithm. ``numeric`` means the payload is a fixed-dtype
+    array (not dtype=object / arbitrary pickled objects).
+    """
+    if algo == "star":
+        return True
+    if nranks < 2 or algo not in PORTFOLIO.get(coll, ()):
+        return False
+    if algo == "shm":
+        if not shm:
+            return False
+        cap = config.load().coll_shm_max_bytes
+        if cap <= 0:
+            return False
+        if coll == "barrier":
+            return True
+        # allreduce through the shm slots: fixed-size raw array payloads
+        # folded flat at the owner — needs an elementwise op (flattening
+        # must not change semantics) and a slot-sized payload.
+        return (numeric and elementwise
+                and nbytes is not None and nbytes < cap)
+    if algo == "rdouble":
+        # concatenation-allgather of raw contributions + the star's own
+        # rank-order fold at every rank: any op, any picklable payload.
+        return True
+    if algo == "rabenseifner":
+        # per-segment rank-order folds: elementwise (segment-separable),
+        # raw array payloads only.
+        return numeric and elementwise and nbytes is not None
+    if algo == "ring":
+        if coll == "allreduce":
+            # ring order != rank order: commutativity required.
+            return commutative and numeric and nbytes is not None
+        return numeric                      # allgather / allgatherv
+    if algo == "pairwise":
+        return numeric                      # alltoall / alltoallv
+    if algo in ("dissemination", "binomial"):
+        return True
+    return False
+
+
+def candidates(coll: str, nranks: int, nbytes: Optional[int], *,
+               commutative: bool = False, elementwise: bool = False,
+               shm: bool = False, numeric: bool = True) -> List[str]:
+    """Eligible algorithms for a signature, portfolio order."""
+    return [a for a in PORTFOLIO.get(coll, ("star",))
+            if eligible(coll, a, nranks, nbytes, commutative=commutative,
+                        elementwise=elementwise, shm=shm, numeric=numeric)]
+
+
+# ---------------------------------------------------------------------------
+# Force-override parsing ("allreduce=rdouble,barrier=star")
+# ---------------------------------------------------------------------------
+
+_override_cache: Tuple[str, Dict[str, str]] = ("", {})
+
+
+def parse_override(spec: str) -> Dict[str, str]:
+    """Parse ``config.coll_algo``: a comma list of ``collective=algorithm``
+    pins. Unknown collectives/algorithms are ignored with a one-time
+    warning rather than erroring — a typo'd debug knob must not take the
+    job down."""
+    global _override_cache
+    if spec == _override_cache[0]:
+        return _override_cache[1]
+    out: Dict[str, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        coll, _, algo = part.partition("=")
+        coll, algo = coll.strip().lower(), algo.strip().lower()
+        if coll in PORTFOLIO and algo in PORTFOLIO[coll]:
+            out[coll] = algo
+        else:
+            print(f"tpu_mpi: ignoring unknown algorithm override "
+                  f"{part!r} (known: "
+                  f"{ {c: list(a) for c, a in PORTFOLIO.items()} })",
+                  file=sys.stderr)
+    _override_cache = (spec, out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Tuning-table persistence (TOML): {(coll, nranks): [(min_bytes, algo)...]}
+# ---------------------------------------------------------------------------
+
+# Table shape on disk:
+#
+#   schema = 1
+#   [allreduce.n8]
+#   "0" = "shm"
+#   "65536" = "ring"
+#
+# [<coll>.n<ranks>] sections map a byte threshold (TOML keys are strings)
+# to the algorithm that wins from that size up. Thresholds are the measured
+# crossover points, so at every measured (size, nranks) the table selects
+# the argmin algorithm exactly.
+
+_table_cache: Tuple[Any, Any, Dict] = (None, None, {})
+_table_warned: set = set()
+
+
+def _parse_table_text(text: str) -> dict:
+    """Tiny TOML-subset parser for the tuning table (sections + quoted
+    string pairs), used when ``tomllib``/``tomli`` is unavailable
+    (Python 3.10 without the vendored fallback's table support)."""
+    root: dict = {}
+    cur = root
+    for ln, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            cur = root
+            for part in line[1:-1].strip().split("."):
+                part = part.strip().strip('"').strip("'")
+                cur = cur.setdefault(part, {})
+            continue
+        key, eq, val = line.partition("=")
+        if not eq:
+            raise ValueError(f"tuning table line {ln}: not key = value")
+        key = key.strip().strip('"').strip("'")
+        val = val.split("#", 1)[0].strip()
+        if val.startswith(("'", '"')):
+            val = val[1:-1]
+        elif val.isdigit():
+            val = int(val)  # type: ignore[assignment]
+        cur[key] = val
+    return root
+
+
+def _read_table_toml(path: str) -> dict:
+    with open(path, "rb") as f:
+        data = f.read()
+    try:
+        import tomllib
+        return tomllib.loads(data.decode())
+    except ImportError:
+        pass
+    try:
+        import tomli  # type: ignore
+        return tomli.loads(data.decode())
+    except ImportError:
+        return _parse_table_text(data.decode())
+
+
+def load_table(path: str) -> Dict[Tuple[str, int], List[Tuple[int, str]]]:
+    """Load (and cache on mtime) a tuning table. A missing or malformed
+    file disables the table layer with a one-time warning — the heuristic
+    still serves, a bad table never takes the job down."""
+    global _table_cache
+    path = os.path.expanduser(path)
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        if path not in _table_warned:
+            _table_warned.add(path)
+            print(f"tpu_mpi: tuning table {path!r} not readable; "
+                  f"using the built-in heuristic", file=sys.stderr)
+        return {}
+    if _table_cache[0] == path and _table_cache[1] == mtime:
+        return _table_cache[2]
+    table: Dict[Tuple[str, int], List[Tuple[int, str]]] = {}
+    try:
+        raw = _read_table_toml(path)
+        for coll, per_n in raw.items():
+            if coll not in PORTFOLIO or not isinstance(per_n, dict):
+                continue
+            for nkey, ladder in per_n.items():
+                if not (isinstance(ladder, dict) and nkey.startswith("n")):
+                    continue
+                n = int(nkey[1:])
+                ent = sorted(((int(th), str(algo))
+                              for th, algo in ladder.items()
+                              if str(algo) in PORTFOLIO[coll]),
+                             reverse=True)
+                if ent:
+                    table[(coll, n)] = ent
+    except Exception as e:
+        if path not in _table_warned:
+            _table_warned.add(path)
+            print(f"tpu_mpi: tuning table {path!r} unusable ({e}); "
+                  f"using the built-in heuristic", file=sys.stderr)
+        table = {}
+    _table_cache = (path, mtime, table)
+    return table
+
+
+def write_table(path: str,
+                table: Dict[Tuple[str, int], List[Tuple[int, str]]],
+                header: str = "") -> None:
+    """Persist a tuning table as TOML (atomic rename)."""
+    path = os.path.expanduser(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    lines = ["# tpu_mpi collective tuning table (tpurun --tune)"]
+    if header:
+        lines += [f"# {h}" for h in header.splitlines()]
+    lines.append("schema = 1")
+    for (coll, n) in sorted(table):
+        lines.append(f"\n[{coll}.n{n}]")
+        for th, algo in sorted(table[(coll, n)]):
+            lines.append(f'"{th}" = "{algo}"')
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.replace(tmp, path)
+
+
+def _table_lookup(table: Dict[Tuple[str, int], List[Tuple[int, str]]],
+                  coll: str, nranks: int,
+                  nbytes: Optional[int]) -> Optional[str]:
+    """The table's pick: exact nranks entry, else the nearest measured
+    communicator size below (libmpi decision tables interpolate the same
+    way), else the smallest above."""
+    ns = sorted(n for (c, n) in table if c == coll)
+    if not ns:
+        return None
+    if nranks in ns:
+        n = nranks
+    else:
+        below = [n for n in ns if n < nranks]
+        n = below[-1] if below else ns[0]
+    size = 0 if nbytes is None else int(nbytes)
+    # order-independent walk: loaded tables arrive descending-sorted, but
+    # the in-memory table from _crossovers is built ascending
+    for th, algo in sorted(table[(coll, n)], reverse=True):
+        if size >= th:
+            return algo
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Heuristic table + the one decision function
+# ---------------------------------------------------------------------------
+
+def heuristic(coll: str, nranks: int, nbytes: Optional[int], *,
+              commutative: bool = False, elementwise: bool = False,
+              shm: bool = False, numeric: bool = True) -> str:
+    """Built-in crossovers (used when no measured table applies). The bulk
+    threshold is ``backend._RING_MIN_BYTES`` — read live, because tests and
+    users monkeypatch it / set ``TPU_MPI_RING_MIN_BYTES`` (the historical
+    knob this table absorbed). Bulk algorithms take precedence over the shm
+    fold so a forced-low ring threshold behaves exactly as it always has."""
+    from . import backend as B
+
+    def ok(algo: str) -> bool:
+        return eligible(coll, algo, nranks, nbytes, commutative=commutative,
+                        elementwise=elementwise, shm=shm, numeric=numeric)
+
+    ring_min = B._RING_MIN_BYTES
+    bulky = numeric and nbytes is not None and nbytes >= ring_min
+    if coll == "allreduce":
+        if bulky and ok("ring"):
+            return "ring"
+        if ok("shm"):
+            return "shm"
+        return "star"
+    if coll == "barrier":
+        return "shm" if ok("shm") else "dissemination"
+    if coll == "bcast":
+        return "binomial"
+    if coll in ("allgather", "allgatherv"):
+        return "ring" if bulky and ok("ring") else "star"
+    if coll == "alltoall":
+        return "pairwise" if bulky and ok("pairwise") else "star"
+    if coll == "alltoallv":
+        # counts differ per rank: dtype-only gate (uniform by contract),
+        # a size gate would let ranks disagree on the tier.
+        return "pairwise" if ok("pairwise") else "star"
+    return "star"           # reduce / gather / scatter default to the star
+
+
+def select(coll: str, nranks: int, nbytes: Optional[int] = None, *,
+           commutative: bool = False, elementwise: bool = False,
+           shm: bool = False, numeric: bool = True) -> str:
+    """THE algorithm decision for one collective signature.
+
+    Resolution: force-override → measured table → heuristic, each clamped
+    by :func:`eligible`. Called once per plan signature (the result is
+    cached inside the CollectivePlan); must stay deterministic across
+    ranks for fixed rank-uniform inputs + uniform config.
+    """
+    if nranks < 2:
+        return "star"
+
+    def ok(algo: str) -> bool:
+        return eligible(coll, algo, nranks, nbytes, commutative=commutative,
+                        elementwise=elementwise, shm=shm, numeric=numeric)
+
+    cfg = config.load()
+    forced = parse_override(cfg.coll_algo).get(coll)
+    if forced is not None and ok(forced):
+        return forced
+    if cfg.tune_table:
+        algo = _table_lookup(load_table(cfg.tune_table), coll, nranks, nbytes)
+        if algo is not None and ok(algo):
+            return algo
+    return heuristic(coll, nranks, nbytes, commutative=commutative,
+                     elementwise=elementwise, shm=shm, numeric=numeric)
+
+
+# ---------------------------------------------------------------------------
+# The autotuner: measure every algorithm on the actual substrate
+# ---------------------------------------------------------------------------
+
+LADDER = (8, 64, 512, 4096, 32768, 262144, 2097152)
+ROOTED_LADDER = (64, 4096, 262144)
+SWEEP_COLLS = ("allreduce", "barrier", "bcast", "reduce", "gather", "scatter")
+
+
+def _iters_for(nbytes: int, scale: float = 1.0) -> Tuple[int, int]:
+    """(warmup, iters) per point; fewer repeats for bulk sizes."""
+    if nbytes >= 1 << 20:
+        w, it = 1, 3
+    elif nbytes >= 1 << 18:
+        w, it = 1, 5
+    elif nbytes >= 1 << 15:
+        w, it = 2, 10
+    else:
+        w, it = 3, 20
+    return w, max(1, int(it * scale))
+
+
+# The in-job bench worker. Runs as an SPMD script under launch_processes:
+# every rank walks the identical (coll, algo, size) schedule in lockstep,
+# flipping the algorithm via the force-override env + config reload (which
+# also exercises the override path end to end), and rank 0 writes the
+# measured rows. Results are asserted bitwise-equal to the star reference
+# per point, on every rank, and AND-reduced.
+_WORKER = r'''
+import json, os, sys, time
+import numpy as np
+import tpu_mpi as MPI
+from tpu_mpi import config as _cfg
+from tpu_mpi import tune as _tune
+
+MPI.Init()
+comm = MPI.COMM_WORLD
+rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+spec = json.load(open(sys.argv[1]))
+scale = spec["scale"]
+
+def set_algo(coll, algo):
+    os.environ["TPU_MPI_COLL_ALGO"] = f"{coll}={algo}"
+    _cfg.load(refresh=True)
+
+def payload(nbytes):
+    n = max(1, nbytes // 8)
+    # integer-valued float64: SUM folds are exact, so bitwise equality is a
+    # meaningful assertion rather than vacuous float luck
+    return (np.arange(n, dtype=np.float64) % 97) + rank + 1.0
+
+def once(coll, nbytes):
+    if coll == "barrier":
+        MPI.Barrier(comm); return None
+    if coll == "allreduce":
+        return np.asarray(MPI.Allreduce(payload(nbytes), MPI.SUM, comm))
+    if coll == "bcast":
+        buf = payload(nbytes) if rank == 0 else np.zeros(max(1, nbytes // 8))
+        return np.asarray(MPI.Bcast(buf, 0, comm))
+    if coll == "reduce":
+        out = MPI.Reduce(payload(nbytes), MPI.SUM, 0, comm)
+        return None if out is None else np.asarray(out)
+    if coll == "gather":
+        out = MPI.Gather(payload(nbytes), 0, comm)
+        return None if out is None else np.asarray(out)
+    if coll == "scatter":
+        send = np.tile(payload(nbytes), size) if rank == 0 else None
+        out = MPI.Scatter(send, max(1, nbytes // 8), 0, comm)
+        return None if out is None else np.asarray(out)
+    raise AssertionError(coll)
+
+rows = []
+for coll, nbytes, algos in spec["points"]:
+    set_algo(coll, "star")
+    ref = once(coll, nbytes)
+    refb = b"" if ref is None else ref.tobytes()
+    for algo in algos:
+        set_algo(coll, algo)
+        out = once(coll, nbytes)                     # correctness probe
+        same = (b"" if out is None else out.tobytes()) == refb
+        warm, iters = _tune._iters_for(nbytes, scale)
+        for _ in range(warm):
+            once(coll, nbytes)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            once(coll, nbytes)
+        dt = (time.perf_counter() - t0) / iters
+        # slowest rank defines the collective's latency; bitwise flag is
+        # the AND over ranks (MIN on {0,1})
+        stats = np.asarray(MPI.Allreduce(
+            np.array([dt, float(same)]), MPI.MAX, comm))
+        ok = np.asarray(MPI.Allreduce(
+            np.array([float(same)]), MPI.MIN, comm))
+        if rank == 0:
+            rows.append({"coll": coll, "nranks": size, "bytes": int(nbytes),
+                         "algo": algo,
+                         "lat_us": round(float(stats[0]) * 1e6, 2),
+                         "bitwise_equal_to_star": bool(ok[0] >= 1.0)})
+            print(f"  {coll:<10} n{size} {nbytes:>9d}B {algo:<13} "
+                  f"{float(stats[0])*1e6:>10.1f} us  "
+                  f"bitwise={bool(ok[0] >= 1.0)}", file=sys.stderr)
+set_algo("allreduce", "star")
+if rank == 0:
+    with open(sys.argv[2], "w") as f:
+        json.dump(rows, f)
+MPI.Finalize()
+'''
+
+
+def _sweep_spec(nranks: int, sizes: Sequence[int],
+                colls: Sequence[str]) -> list:
+    """The lockstep (coll, nbytes, algos) schedule for one world size.
+    Algorithms are the deployment-eligible set per point (shm capped by the
+    configured slot size etc.), so the emitted table never selects
+    something the runtime would clamp away."""
+    points = []
+    shm_ok = os.path.isdir("/dev/shm")   # single-host sweep by construction
+    for coll in colls:
+        ladder: Sequence[int] = ((0,) if coll == "barrier"
+                                 else sizes if coll == "allreduce"
+                                 else [s for s in ROOTED_LADDER
+                                       if s <= max(sizes)])
+        for nbytes in ladder:
+            algos = candidates(coll, nranks, nbytes, commutative=True,
+                               elementwise=True, shm=shm_ok, numeric=True)
+            points.append((coll, int(nbytes), algos))
+    return points
+
+
+def _crossovers(rows: List[dict]) -> Dict[Tuple[str, int],
+                                          List[Tuple[int, str]]]:
+    """Reduce measured rows to threshold->algorithm crossover entries: at
+    each measured size the winner is the argmin latency; thresholds sit at
+    the measured sizes where the winner changes (so the table reproduces
+    the argmin at every measured point exactly)."""
+    best: Dict[Tuple[str, int], List[Tuple[int, str]]] = {}
+    by_point: Dict[Tuple[str, int, int], Tuple[float, str]] = {}
+    for r in rows:
+        key = (r["coll"], r["nranks"], r["bytes"])
+        if key not in by_point or r["lat_us"] < by_point[key][0]:
+            by_point[key] = (r["lat_us"], r["algo"])
+    for (coll, n, nbytes) in sorted(by_point):
+        _, algo = by_point[(coll, n, nbytes)]
+        ent = best.setdefault((coll, n), [])
+        if not ent:
+            ent.append((0, algo))            # below-ladder sizes inherit
+        elif ent[-1][1] != algo:
+            ent.append((nbytes, algo))
+    return best
+
+
+def autotune(nranks_list: Sequence[int] = (2, 4, 8),
+             sizes: Sequence[int] = LADDER,
+             colls: Sequence[str] = SWEEP_COLLS,
+             scale: float = 1.0,
+             out_table: Optional[str] = None,
+             out_json: Optional[str] = None,
+             verbose: bool = True) -> dict:
+    """Run the sweep, write the tuning table, return the full record."""
+    import tempfile
+    from .launcher import launch_processes
+
+    t_start = time.time()
+    rows: List[dict] = []
+    with tempfile.TemporaryDirectory(prefix="tpu_mpi_tune_") as td:
+        worker = os.path.join(td, "tune_worker.py")
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(worker, "w") as f:
+            f.write(f"import sys; sys.path.insert(0, {pkg_parent!r})\n"
+                    + _WORKER)
+        for n in nranks_list:
+            spec = {"scale": scale, "points": _sweep_spec(n, sizes, colls)}
+            spec_path = os.path.join(td, f"spec{n}.json")
+            out_path = os.path.join(td, f"rows{n}.json")
+            with open(spec_path, "w") as f:
+                json.dump(spec, f)
+            if verbose:
+                npts = sum(len(p[2]) for p in spec["points"])
+                print(f"tune: sweeping {npts} (coll, size, algo) points "
+                      f"on {n} ranks ...", file=sys.stderr)
+            rc = launch_processes(worker, n, script_args=[spec_path, out_path],
+                                  sim=1)
+            if rc != 0:
+                raise RuntimeError(f"tune sweep on {n} ranks exited {rc}")
+            with open(out_path) as f:
+                rows.extend(json.load(f))
+
+    table = _crossovers(rows)
+    # selection audit: what the freshly-written table picks at every
+    # measured point, vs the best measured algorithm there
+    by_point: Dict[Tuple[str, int, int], List[dict]] = {}
+    for r in rows:
+        by_point.setdefault((r["coll"], r["nranks"], r["bytes"]), []).append(r)
+    selection = []
+    for (coll, n, nbytes), prs in sorted(by_point.items()):
+        best = min(prs, key=lambda r: r["lat_us"])
+        picked = _table_lookup(table, coll, n, nbytes) or heuristic(
+            coll, n, nbytes, commutative=True, elementwise=True,
+            shm=os.path.isdir("/dev/shm"))
+        sel = next((r for r in prs if r["algo"] == picked), best)
+        selection.append({
+            "coll": coll, "nranks": n, "bytes": nbytes,
+            "tuner_selected": sel["algo"], "selected_lat_us": sel["lat_us"],
+            "best_algo": best["algo"], "best_lat_us": best["lat_us"],
+            "ratio_vs_best": round(sel["lat_us"] / max(best["lat_us"], 1e-9),
+                                   4),
+        })
+
+    record = {
+        "bench": "coll_algos",
+        "rows": rows,
+        "selection": selection,
+        "table": {f"{c}.n{n}": dict(
+            (str(th), algo) for th, algo in ent)
+            for (c, n), ent in table.items()},
+        "elapsed_s": round(time.time() - t_start, 1),
+    }
+    if out_table:
+        write_table(out_table, table,
+                    header=f"measured on {os.uname().nodename} "
+                           f"nranks={list(nranks_list)}")
+        record["table_path"] = os.path.expanduser(out_table)
+    if out_json:
+        with open(os.path.expanduser(out_json), "w") as f:
+            json.dump(record, f, indent=1)
+    return record
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI: ``python -m tpu_mpi.tune`` / ``tpurun --tune``."""
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="tpurun --tune",
+        description="Measure every collective algorithm on this substrate "
+                    "and persist the crossover table select() loads.")
+    p.add_argument("--nranks", default="2,4,8",
+                   help="comma list of world sizes to sweep (default 2,4,8)")
+    p.add_argument("--sizes", default=None,
+                   help="comma list of payload bytes "
+                        f"(default {','.join(map(str, LADDER))})")
+    p.add_argument("--colls", default=",".join(SWEEP_COLLS),
+                   help="comma list of collectives to sweep")
+    p.add_argument("--scale", type=float, default=1.0,
+                   help="iteration-count multiplier (e.g. 0.3 for a quick "
+                        "pass)")
+    p.add_argument("--quick", action="store_true",
+                   help="tiny sweep: 2 ranks, 3 sizes, allreduce+barrier")
+    p.add_argument("-o", "--out", default=None,
+                   help="tuning-table path (default: $TPU_MPI_TUNE_TABLE "
+                        "or ~/.config/tpu_mpi/tune.toml)")
+    p.add_argument("--json", default=None,
+                   help="also write the full sweep record as JSON")
+    args = p.parse_args(argv)
+
+    nranks = [int(x) for x in args.nranks.split(",") if x]
+    sizes = ([int(x) for x in args.sizes.split(",") if x]
+             if args.sizes else list(LADDER))
+    colls = [c.strip() for c in args.colls.split(",") if c.strip()]
+    if args.quick:
+        nranks, sizes = [2], [64, 4096, 65536]
+        colls = ["allreduce", "barrier"]
+    out_table = (args.out or config.load().tune_table
+                 or os.path.join("~", ".config", "tpu_mpi", "tune.toml"))
+    rec = autotune(nranks, sizes, colls, scale=args.scale,
+                   out_table=out_table, out_json=args.json)
+    print(f"tune: wrote {rec['table_path']} "
+          f"({len(rec['rows'])} measured points, {rec['elapsed_s']}s)")
+    for (sect, ladder) in sorted(rec["table"].items()):
+        print(f"  [{sect}] " + "  ".join(
+            f"{th}B->{algo}" for th, algo in sorted(
+                ladder.items(), key=lambda kv: int(kv[0]))))
+    worst = max((s["ratio_vs_best"] for s in rec["selection"]), default=1.0)
+    print(f"tune: selected-vs-best worst ratio {worst:.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
