@@ -50,6 +50,13 @@ Derived breakdown written to the artifact:
 
 Run: ``python benchmarks/overhead_probe.py [out.json]`` (default
 ``benchmarks/results/overhead-probe-tpu.json``).
+
+A separate pvar-overhead lane (``--pvars [out.json]``, default
+``benchmarks/results/overhead-pvars-cpusim.json``) measures the cost of
+the always-on performance-variable counters (docs/observability.md):
+host-path ping-pong and star Allreduce with collection off vs on. The
+off lane must stay within noise of the pre-pvars baseline — its fast
+path is one generation-checked tuple compare per op.
 """
 
 from __future__ import annotations
@@ -142,7 +149,108 @@ def case_floor_vs_size(jax, jnp) -> list[dict]:
     return rows
 
 
+def _pvars_case(pvars_on: bool, pp_iters: int = 2000,
+                ar_iters: int = 300, repeats: int = 5) -> dict:
+    """Per-op host-path latencies (µs) with pvar collection off/on."""
+    import numpy as np
+
+    import tpu_mpi as MPI
+    from tpu_mpi import config, perfvars
+    from tpu_mpi.testing import run_spmd
+
+    os.environ["TPU_MPI_PVARS"] = "1" if pvars_on else "0"
+    os.environ["TPU_MPI_COLL_ALGO"] = "allreduce=star"
+    config.load(refresh=True)
+    perfvars.reset()
+    out = {}
+
+    def pingpong():
+        comm = MPI.COMM_WORLD
+        r = comm.rank()
+        buf = np.ones(64, dtype=np.float64)
+        rbuf = np.empty_like(buf)
+        for _ in range(200):            # warmup
+            if r == 0:
+                MPI.Send(buf, 1, 7, comm)
+                MPI.Recv(rbuf, 1, 7, comm)
+            else:
+                MPI.Recv(rbuf, 0, 7, comm)
+                MPI.Send(buf, 0, 7, comm)
+        best = float("inf")
+        for _ in range(repeats):
+            MPI.Barrier(comm)
+            t0 = time.perf_counter()
+            for _ in range(pp_iters):
+                if r == 0:
+                    MPI.Send(buf, 1, 7, comm)
+                    MPI.Recv(rbuf, 1, 7, comm)
+                else:
+                    MPI.Recv(rbuf, 0, 7, comm)
+                    MPI.Send(buf, 0, 7, comm)
+            best = min(best, (time.perf_counter() - t0) / (2 * pp_iters))
+        if r == 0:
+            out["pingpong_us"] = round(best * 1e6, 3)
+            if pvars_on:
+                assert comm.get_pvars()["sends"] > 0   # collection really on
+
+    run_spmd(pingpong, 2)
+
+    def allreduce():
+        comm = MPI.COMM_WORLD
+        x = np.ones(1024, dtype=np.float64)
+        y = np.empty_like(x)
+        for _ in range(20):
+            MPI.Allreduce(x, y, MPI.SUM, comm)
+        best = float("inf")
+        for _ in range(repeats):
+            MPI.Barrier(comm)
+            t0 = time.perf_counter()
+            for _ in range(ar_iters):
+                MPI.Allreduce(x, y, MPI.SUM, comm)
+            best = min(best, (time.perf_counter() - t0) / ar_iters)
+        if comm.rank() == 0:
+            out["allreduce_star_us"] = round(best * 1e6, 3)
+            if pvars_on:
+                assert comm.get_pvars()["ops"]
+
+    run_spmd(allreduce, 4)
+    perfvars.reset()
+    return out
+
+
+def pvars_lane(out_path: str) -> None:
+    platform = detect_platform()
+    _log(f"platform: {platform}")
+    saved = {k: os.environ.get(k) for k in ("TPU_MPI_PVARS",
+                                            "TPU_MPI_COLL_ALGO")}
+    try:
+        off = _pvars_case(False)
+        _log(f"pvars off: {off}")
+        on = _pvars_case(True)
+        _log(f"pvars on:  {on}")
+    finally:
+        for k, v in saved.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+        from tpu_mpi import config
+        config.load(refresh=True)
+    overhead = {k: round((on[k] - off[k]) / off[k] * 100, 2)
+                for k in off if off[k] > 0}
+    _log(f"overhead %: {overhead}")
+    emit(out_path, {
+        "benchmark": "overhead_pvars",
+        "platform": platform,
+        "pvars_off_us": off,
+        "pvars_on_us": on,
+        "overhead_pct": overhead,
+    })
+
+
 def main() -> None:
+    if sys.argv[1:2] == ["--pvars"]:
+        out = sys.argv[2] if len(sys.argv) > 2 else \
+            os.path.join(_HERE, "results", "overhead-pvars-cpusim.json")
+        pvars_lane(out)
+        return
     out_path = sys.argv[1] if len(sys.argv) > 1 else \
         os.path.join(_HERE, "results", "overhead-probe-tpu.json")
     platform = detect_platform()

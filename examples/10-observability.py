@@ -1,0 +1,52 @@
+"""Observability: pvar counters, Pcontrol, and the merged Perfetto trace.
+
+Run: tpurun --sim 4 examples/10-observability.py
+With tracing:  TPU_MPI_TRACE=1 tpurun --sim 4 examples/10-observability.py
+  (writes the merged trace to $TPU_MPI_EXAMPLE_TRACE or /tmp/tpu_mpi_trace.json
+   — load it at ui.perfetto.dev or chrome://tracing)
+With dumps:    TPU_MPI_PVARS_DUMP=/tmp/pv tpurun --sim 4 examples/10-observability.py
+               tpurun --stats /tmp/pv
+See docs/observability.md.
+"""
+
+import os
+
+import numpy as np
+
+import tpu_mpi as MPI
+
+MPI.Init()
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+size = MPI.Comm_size(comm)
+
+# some traffic worth counting: a few Allreduces and a ring Sendrecv
+x = np.arange(4096, dtype=np.float64) + rank
+y = np.empty_like(x)
+for _ in range(5):
+    MPI.Allreduce(x, y, MPI.SUM, comm)
+
+token = np.array([float(rank)])
+out = np.empty_like(token)
+MPI.Sendrecv(token, (rank + 1) % size, 17, out, (rank - 1) % size, 17, comm)
+
+MPI.Barrier(comm)
+
+# per-comm counters, MPI_T style (always on unless TPU_MPI_PVARS=0)
+s = comm.get_pvars()
+if rank == 0:
+    print(f"ops: {s['ops']}")
+    print(f"p2p: {s['sends']} sends / {s['bytes_sent']} B out, "
+          f"{s['recvs']} recvs / {s['bytes_recv']} B in")
+    print("phase_s:", {k: round(v, 6) for k, v in s["phase_s"].items()})
+
+# with TPU_MPI_TRACE=1 every op above carries wall-clock spans — merge all
+# ranks into one Chrome-trace JSON (rank 0 writes, others pass through)
+if MPI.analyze.last_trace() is not None:
+    path = os.environ.get("TPU_MPI_EXAMPLE_TRACE", "/tmp/tpu_mpi_trace.json")
+    MPI.analyze.timeline.merge_trace(comm, path)
+    if rank == 0:
+        print(f"merged trace -> {path}")
+
+MPI.Finalize()          # flushes pvars-rank<R>.json when TPU_MPI_PVARS_DUMP set

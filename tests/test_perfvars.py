@@ -1,0 +1,419 @@
+"""Performance-variable subsystem tests (PR 5, docs/observability.md):
+counters, Pcontrol, timed spans on the event IR, the merged Chrome-trace
+export, the stats/tune ingestion paths, and the satellite fixes
+(``enabled()`` cold-start, ``Wtick`` fallback, ``profile_trace`` gating).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import tpu_mpi as MPI
+from tpu_mpi import config, perfvars
+from tpu_mpi.testing import run_spmd
+
+
+@pytest.fixture(autouse=True)
+def _pvars_clean(monkeypatch):
+    """Fresh counter store and default-on collection for every test."""
+    monkeypatch.delenv("TPU_MPI_PVARS", raising=False)
+    monkeypatch.delenv("TPU_MPI_PVARS_DUMP", raising=False)
+    # pin the host-path (star) algorithm so op keys and phase spans are
+    # deterministic across payload sizes
+    monkeypatch.setenv("TPU_MPI_COLL_ALGO", "allreduce=star")
+    config.load(refresh=True)
+    perfvars.pcontrol(1)
+    perfvars.reset()
+    yield
+    perfvars.pcontrol(1)
+    perfvars.reset()
+    config.load(refresh=True)
+
+
+def _allreduce_job(nprocs, iters=3, count=2048):
+    snaps = {}
+
+    def body():
+        comm = MPI.COMM_WORLD
+        r = comm.rank()
+        x = np.arange(count, dtype=np.float64) + r
+        out = np.empty_like(x)
+        for _ in range(iters):
+            MPI.Allreduce(x, out, MPI.SUM, comm)
+        MPI.Barrier(comm)
+        snaps[r] = comm.get_pvars()
+
+    run_spmd(body, nprocs)
+    return snaps
+
+
+# ---------------------------------------------------------------------------
+# Counters
+# ---------------------------------------------------------------------------
+
+def test_collective_counters(nprocs):
+    snaps = _allreduce_job(nprocs)
+    assert sorted(snaps) == list(range(nprocs))
+    for r, s in snaps.items():
+        assert s["size"] == nprocs
+        assert s["ops"].get("allreduce|star|float64") == 3, s["ops"]
+        assert any(k.startswith("barrier|") for k in s["ops"])
+        (t,) = [t for t in s["times"] if t["coll"] == "allreduce"]
+        assert t["count"] == 3 and t["nbytes"] == 2048 * 8
+        assert 0 < t["min_s"] <= t["total_s"]
+        assert sum(s["hist"]["allreduce"]) == 3
+        assert len(s["hist"]["allreduce"]) == config.load().pvars_hist_bins
+    # every round has exactly one folder and nprocs-1 rendezvous waiters,
+    # so across ranks both phases must have accumulated time
+    assert sum(s["phase_s"]["fold"] for s in snaps.values()) > 0
+    assert sum(s["phase_s"]["rendezvous"] for s in snaps.values()) > 0
+    assert sum(s["phase_s"]["copy"] for s in snaps.values()) > 0
+
+
+def test_p2p_counters(nprocs):
+    snaps = {}
+
+    def body():
+        comm = MPI.COMM_WORLD
+        r = comm.rank()
+        if r == 0:
+            MPI.Send(np.ones(16, dtype=np.float64), 1, 3, comm)
+        elif r == 1:
+            buf = np.empty(16, dtype=np.float64)
+            MPI.Recv(buf, 0, 3, comm)
+        MPI.Barrier(comm)
+        snaps[r] = comm.get_pvars()
+
+    run_spmd(body, nprocs)
+    assert snaps[0]["sends"] == 1 and snaps[0]["bytes_sent"] == 128
+    assert snaps[1]["recvs"] == 1 and snaps[1]["bytes_recv"] == 128
+    assert snaps[1]["wait_s"] >= 0
+
+
+def test_rma_epoch_counters(nprocs):
+    snaps = {}
+
+    def body():
+        comm = MPI.COMM_WORLD
+        win = MPI.Win_create(np.zeros(4), comm)
+        MPI.Win_fence(0, win)
+        MPI.Win_fence(0, win)
+        snaps[comm.rank()] = comm.get_pvars()
+        MPI.free(win)
+
+    run_spmd(body, nprocs)
+    assert all(s["rma"]["fence"] == 2 for s in snaps.values())
+
+
+def test_disabled_collects_nothing(monkeypatch):
+    monkeypatch.setenv("TPU_MPI_PVARS", "0")
+    config.load(refresh=True)
+    snaps = _allreduce_job(2)
+    assert all(not s["ops"] and s["bytes_sent"] == 0 for s in snaps.values())
+
+
+def test_get_pvars_reset(nprocs):
+    counts = {}
+
+    def body():
+        comm = MPI.COMM_WORLD
+        x = np.ones(8)
+        MPI.Allreduce(x, np.empty_like(x), MPI.SUM, comm)
+        first = comm.get_pvars(reset=True)
+        second = comm.get_pvars()
+        counts[comm.rank()] = (sum(first["ops"].values()),
+                               sum(second["ops"].values()))
+
+    run_spmd(body, nprocs)
+    assert all(a >= 1 and b == 0 for a, b in counts.values())
+
+
+# ---------------------------------------------------------------------------
+# Pcontrol + dump/load
+# ---------------------------------------------------------------------------
+
+def test_pcontrol_toggles_collection():
+    def body():
+        comm = MPI.COMM_WORLD
+        x = np.ones(8)
+        MPI.Pcontrol(0)
+        MPI.Allreduce(x, np.empty_like(x), MPI.SUM, comm)
+        off = comm.get_pvars()
+        assert MPI.Pcontrol(1) == 1
+        MPI.Allreduce(x, np.empty_like(x), MPI.SUM, comm)
+        on = comm.get_pvars()
+        assert not off["ops"]
+        assert sum(on["ops"].values()) == 1
+
+    run_spmd(body, 2)
+
+
+def test_pcontrol_flush_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_MPI_PVARS_DUMP", str(tmp_path))
+    config.load(refresh=True)
+
+    def body():
+        comm = MPI.COMM_WORLD
+        MPI.Allreduce(np.ones(8), np.empty(8), MPI.SUM, comm)
+        MPI.Barrier(comm)
+        MPI.Pcontrol(2)
+
+    run_spmd(body, 2)
+    # thread tier: every rank flushed its own file into the dump dir
+    files = sorted(p.name for p in tmp_path.glob("pvars-rank*.json"))
+    assert files == ["pvars-rank0.json", "pvars-rank1.json"]
+    recs = perfvars.load_dumps([str(tmp_path)])
+    assert all(r["kind"] == "tpu_mpi-pvars" for r in recs)
+    assert any(c["ops"] for r in recs for c in r["comms"])
+
+
+def test_finalize_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_MPI_PVARS_DUMP", str(tmp_path))
+    config.load(refresh=True)
+
+    def body():
+        comm = MPI.COMM_WORLD
+        MPI.Allreduce(np.ones(8), np.empty(8), MPI.SUM, comm)
+        MPI.Barrier(comm)
+        MPI.Finalize()
+
+    run_spmd(body, 2)
+    assert len(list(tmp_path.glob("pvars-rank*.json"))) == 2
+
+
+# ---------------------------------------------------------------------------
+# Timed spans on the event IR + Chrome-trace export
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def traced(monkeypatch):
+    monkeypatch.setenv("TPU_MPI_TRACE", "1")
+    config.load(refresh=True)
+    yield
+    monkeypatch.delenv("TPU_MPI_TRACE", raising=False)
+    config.load(refresh=True)
+
+
+def test_event_spans_and_phase_budget(traced, nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        x = np.arange(2048, dtype=np.float64)
+        MPI.Allreduce(x, np.empty_like(x), MPI.SUM, comm)
+        MPI.Barrier(comm)
+
+    run_spmd(body, nprocs)
+    tr = MPI.analyze.last_trace()
+    spanned = [e for e in tr.events() if e.kind == "coll"
+               and getattr(e, "t_start", None) is not None]
+    assert spanned, "no collective event carried a span"
+    saw_phases = False
+    for ev in spanned:
+        wall = ev.t_end - ev.t_start
+        assert wall >= 0
+        for name, p0, p1 in (ev.phases or ()):
+            assert name in perfvars.PHASES
+            saw_phases = True
+        # phase time can never exceed the op's own wall time
+        total = sum(p1 - p0 for _, p0, p1 in (ev.phases or ()))
+        assert total <= wall + 1e-6, (ev.op, total, wall)
+    assert saw_phases
+
+
+def test_merged_chrome_trace(traced, nprocs, tmp_path):
+    path = str(tmp_path / "trace.json")
+
+    def body():
+        comm = MPI.COMM_WORLD
+        r = comm.rank()
+        x = np.arange(4096, dtype=np.float64) + r
+        MPI.Allreduce(x, np.empty_like(x), MPI.SUM, comm)
+        MPI.Barrier(comm)
+        MPI.analyze.timeline.merge_trace(comm, path)
+
+    run_spmd(body, nprocs)
+    rec = json.load(open(path))          # valid JSON, trace-event shape
+    evs = rec["traceEvents"]
+    assert isinstance(evs, list) and evs
+    assert all({"ph", "pid", "tid"} <= set(e) for e in evs)
+    slices = [e for e in evs if e["ph"] == "X"]
+    assert {e["pid"] for e in slices} == set(range(nprocs))
+    # host-path Allreduce shows its distinct phase spans
+    phases = {e["name"] for e in slices if e.get("cat") == "phase"}
+    assert "rendezvous" in phases and {"fold", "copy"} & phases, phases
+    # per-rank timestamps stay monotone after clock alignment
+    for pid in range(nprocs):
+        ts = [e["ts"] for e in slices if e["pid"] == pid
+              and e.get("cat") == "coll"]
+        assert ts == sorted(ts)
+        assert all(t >= 0 for t in ts)
+    assert all(e["dur"] > 0 for e in slices)
+
+
+# ---------------------------------------------------------------------------
+# Stats CLI + tune ingestion
+# ---------------------------------------------------------------------------
+
+def _dump_job(tmp_path, nprocs=4):
+    def body():
+        comm = MPI.COMM_WORLD
+        r = comm.rank()
+        x = np.arange(2048, dtype=np.float64) + r
+        for _ in range(4):
+            MPI.Allreduce(x, np.empty_like(x), MPI.SUM, comm)
+        MPI.Barrier(comm)
+        perfvars.dump(str(tmp_path / f"pvars-rank{r}.json"), rank=r)
+
+    run_spmd(body, nprocs)
+
+
+def test_stats_cli(tmp_path, capsys):
+    from tpu_mpi import stats
+    _dump_job(tmp_path)
+    out_json = tmp_path / "merged.json"
+    assert stats.main([str(tmp_path), "--json", str(out_json)]) == 0
+    text = capsys.readouterr().out
+    assert "per-collective latency" in text
+    assert "allreduce" in text and "latency histogram" in text
+    rec = json.load(open(out_json))
+    assert rec["kind"] == "tpu_mpi-stats"
+    (row,) = [r for r in rec["colls"] if r["coll"] == "allreduce"]
+    assert row["count"] == 16          # 4 ranks x 4 ops
+    assert rec["phase_s"]["rendezvous"] > 0
+
+
+def test_tune_from_pvars(tmp_path):
+    from tpu_mpi import tune
+    _dump_job(tmp_path)
+    table_path = tmp_path / "tune.toml"
+    rec = tune.table_from_pvars([str(tmp_path)], out_table=str(table_path))
+    rows = {(r["coll"], r["nranks"], r["bytes"]): r for r in rec["rows"]}
+    assert ("allreduce", 4, 16384) in rows
+    assert rows[("allreduce", 4, 16384)]["lat_us"] > 0
+    # the persisted table round-trips through the select() loader
+    table = tune.load_table(str(table_path))
+    assert table[("allreduce", 4)][-1][1] == "star"
+
+
+def test_tune_cli_from_pvars(tmp_path, capsys):
+    from tpu_mpi import tune
+    _dump_job(tmp_path)
+    rc = tune.main(["--from-pvars", str(tmp_path),
+                    "-o", str(tmp_path / "t.toml")])
+    assert rc == 0
+    assert "pvar dumps" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Satellite: enabled() cold-start pays one load, then one tuple compare
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mod", ["events", "perfvars"])
+def test_enabled_cold_start_single_config_load(monkeypatch, mod):
+    """At GENERATION == 0 with a warm config cache (load() early-returns
+    without bumping), enabled() must still cache after ONE config.load —
+    the old `gen != 0` guard re-loaded on every call until the first
+    refresh bump."""
+    if mod == "events":
+        from tpu_mpi.analyze import events as target
+    else:
+        target = perfvars
+    config.load()                          # ensure the config cache is warm
+    monkeypatch.setattr(config, "GENERATION", 0)
+    monkeypatch.setattr(target, "_enabled_cache", (target._UNSET, False))
+    calls = []
+    real_load = config.load
+
+    def counting_load(*a, **k):
+        calls.append(1)
+        return real_load(*a, **k)
+
+    monkeypatch.setattr(config, "load", counting_load)
+    first = target.enabled()
+    for _ in range(5):
+        assert target.enabled() == first
+    assert len(calls) == 1, f"{mod}.enabled() re-read config {len(calls)}x"
+
+
+# ---------------------------------------------------------------------------
+# Satellite: Wtick advertised-vs-measured
+# ---------------------------------------------------------------------------
+
+def test_wtick_advertised():
+    tick = MPI.Wtick()
+    assert 0 < tick < 1.0
+    assert MPI.Wtick() == tick             # stable across calls
+
+
+def test_wtick_measured_fallback(monkeypatch):
+    """A bogus advertised resolution (0 or >= 1s) falls back to the
+    measured minimum observed clock delta."""
+    import time as _time
+
+    from tpu_mpi import environment
+
+    class FakeInfo:
+        resolution = 1.0
+
+    monkeypatch.setattr(environment, "_measured_tick", None)
+    monkeypatch.setattr(_time, "get_clock_info", lambda name: FakeInfo())
+    tick = MPI.Wtick()
+    assert 0 < tick < 1.0
+    assert MPI.Wtick() == tick             # cached measurement
+
+
+# ---------------------------------------------------------------------------
+# Satellite: profile_trace rank gating (4 ranks, thread tier)
+# ---------------------------------------------------------------------------
+
+class _FakeProfiler:
+    def __init__(self):
+        self.starts = []
+        self.stops = 0
+
+    def install(self, monkeypatch):
+        import jax
+        monkeypatch.setattr(jax.profiler, "start_trace",
+                            lambda logdir: self.starts.append(logdir))
+
+        def stop():
+            self.stops += 1
+        monkeypatch.setattr(jax.profiler, "stop_trace", stop)
+
+
+def test_profile_trace_single_starter(monkeypatch, tmp_path):
+    """Thread tier: only the designated rank starts the (process-singleton)
+    JAX profiler; the other ranks' context managers no-op."""
+    prof = _FakeProfiler()
+    prof.install(monkeypatch)
+    actives = {}
+
+    def body():
+        comm = MPI.COMM_WORLD
+        with MPI.profile_trace(str(tmp_path / "t"), rank=2) as cm:
+            MPI.Barrier(comm)
+            actives[comm.rank()] = cm._active
+
+    run_spmd(body, 4)
+    assert len(prof.starts) == 1 and prof.stops == 1
+    assert actives == {0: False, 1: False, 2: True, 3: False}
+
+
+def test_profile_trace_exception_safe(monkeypatch, tmp_path):
+    """An exception inside the block still stops the profiler exactly once
+    and propagates (the context manager must not swallow it)."""
+    prof = _FakeProfiler()
+    prof.install(monkeypatch)
+
+    def standalone():
+        with pytest.raises(RuntimeError, match="boom"):
+            with MPI.profile_trace(str(tmp_path / "t")) as cm:
+                assert cm._active
+                raise RuntimeError("boom")
+        assert not cm._active
+
+    import threading
+    t = threading.Thread(target=standalone)
+    t.start()
+    t.join()
+    assert len(prof.starts) == 1 and prof.stops == 1

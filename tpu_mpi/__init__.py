@@ -30,7 +30,7 @@ from .analyze import Diagnostic
 
 # Environment / lifecycle (src/environment.jl)
 from .environment import (Abort, Finalize, Finalized, Init, Init_thread,
-                          Initialized, Is_thread_main, Query_thread,
+                          Initialized, Is_thread_main, Pcontrol, Query_thread,
                           THREAD_FUNNELED, THREAD_MULTIPLE, THREAD_SERIALIZED,
                           THREAD_SINGLE, ThreadLevel, Wtick, Wtime, has_tpu,
                           profile_trace, universe_size)

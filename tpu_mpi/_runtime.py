@@ -29,6 +29,7 @@ import time
 from typing import Any, Callable, Optional, Sequence
 
 from .error import AbortError, CollectiveMismatchError, DeadlockError, MPIError
+from . import perfvars as _pv
 
 # Wildcards / sentinels (values mirror the MPI spec's spirit; they are our own).
 ANY_SOURCE = -2
@@ -542,12 +543,19 @@ class CollectiveChannel(_Waitable):
                 raise err
             st["contribs"][rank] = contrib
             st["arrived"] += 1
+            # pvar phase spans: last arriver's combine is the fold; every
+            # other rank's block below is the rendezvous. One TLS read when
+            # no scope is open (pvars and tracing both off).
+            sc = _pv.scope()
             if st["arrived"] == self.size:
+                t0 = _pv.monotonic() if sc is not None else 0.0
                 try:
                     results = list(combine(list(st["contribs"])))
                 except BaseException as e:
                     self.ctx.fail(e)
                     raise
+                if sc is not None:
+                    sc.spans.append(("fold", t0, _pv.monotonic()))
                 if len(results) != self.size:
                     err = MPIError(f"combine for {opname} returned {len(results)} "
                                    f"results for {self.size} ranks")
@@ -557,9 +565,12 @@ class CollectiveChannel(_Waitable):
                 st["contribs"] = []      # contributions are dead: release refs
                 self.cond.notify_all()
             else:
+                t0 = _pv.monotonic() if sc is not None else 0.0
                 self._wait_for(lambda: st["results"] is not None,
                                f"collective {opname}",
                                limit=collective_wait_limit(opname))
+                if sc is not None:
+                    sc.spans.append(("rendezvous", t0, _pv.monotonic()))
             res = st["results"][rank]
             st["picked"] += 1
             if st["picked"] == self.size:

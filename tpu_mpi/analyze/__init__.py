@@ -26,7 +26,8 @@ from __future__ import annotations
 from .diagnostics import CODES, Diagnostic
 
 __all__ = ["CODES", "Diagnostic", "lint_paths", "lint_source", "verify_trace",
-           "detect_races", "deadlock_report", "last_trace"]
+           "detect_races", "deadlock_report", "last_trace", "timeline",
+           "merge_trace", "write_chrome", "clock_offsets"]
 
 
 def __getattr__(name):
@@ -44,4 +45,10 @@ def __getattr__(name):
     if name == "last_trace":
         from .events import last_trace
         return last_trace
+    if name in ("timeline", "merge_trace", "write_chrome", "clock_offsets"):
+        # importlib, not `from . import timeline`: the fromlist machinery
+        # resolves missing attributes through THIS __getattr__ and recurses
+        import importlib
+        _timeline = importlib.import_module(".timeline", __name__)
+        return _timeline if name == "timeline" else getattr(_timeline, name)
     raise AttributeError(f"module 'tpu_mpi.analyze' has no attribute {name!r}")

@@ -26,6 +26,8 @@ import sys
 import threading
 import time
 import zlib
+
+from .. import config
 from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
@@ -45,17 +47,20 @@ def last_trace() -> Optional["Tracer"]:
     return last_tracer
 
 
-_enabled_cache: Tuple[Any, bool] = (None, False)
+# Sentinel initial generation: None can never equal config.GENERATION (an
+# int), so the very first call populates the cache and every later call is
+# one tuple compare — including at GENERATION == 0, where the old `gen != 0`
+# guard forced a config.load() per call until the first refresh bump.
+_UNSET = object()
+_enabled_cache: Tuple[Any, bool] = (_UNSET, False)
 
 
 def enabled() -> bool:
     """Whether event tracing is on — cached on ``config.GENERATION`` so the
     per-operation cost of an untraced run is one tuple compare."""
     global _enabled_cache
-    from .. import config
-    gen = config.GENERATION
     cached_gen, val = _enabled_cache
-    if cached_gen == gen and gen != 0:
+    if cached_gen == config.GENERATION:
         return val
     val = bool(config.load().trace)
     _enabled_cache = (config.GENERATION, val)
@@ -89,7 +94,12 @@ class Event:
 
     __slots__ = ("kind", "rank", "op", "cid", "seq", "peer", "root", "tag",
                  "count", "dtype", "win", "lo", "hi", "vc", "origin", "grp",
-                 "algo", "file", "line", "t")
+                 "algo", "file", "line", "t",
+                 # pvar span fields (perfvars.op_end stamps them): wall-clock
+                 # bracket of the whole op plus the phase spans the channels
+                 # observed inside it, as (name, t0, t1) monotonic tuples —
+                 # analyze.timeline renders these as nested Perfetto slices.
+                 "t_start", "t_end", "phases")
 
     def __init__(self, kind: str, rank: int, **kw: Any):
         self.kind = kind          # "coll" | "send" | "recv" | "rma" | "sync"
@@ -174,7 +184,6 @@ def tracer_for(ctx: Any, create: bool = False) -> Optional[Tracer]:
         with _mod_lock:
             tr = getattr(ctx, "_tracer", None)
             if tr is None:
-                from .. import config
                 cfg = config.load()
                 tr = Tracer(getattr(ctx, "size", 0), cfg.trace_buffer)
                 ctx._tracer = tr

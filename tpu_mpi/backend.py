@@ -40,6 +40,7 @@ from typing import Any, Callable, Optional, Sequence
 import numpy as np
 
 from . import config
+from . import perfvars as _pv
 from . import serialization
 from .buffers import is_wire_snapshot
 from ._runtime import (ANY_SOURCE, Mailbox, Message, SpmdContext, _Waitable,
@@ -1448,23 +1449,35 @@ class ProcChannel(_Waitable):
         root_world = self.group[0]
         arr = np.asarray(contrib).reshape(-1)
         prog = progress_begin(K, "chunks")
+        sc = _pv.scope()    # pvar phase spans; None when pvars+tracing off
         if ctx.local_rank != root_world:
+            t0 = _pv.monotonic() if sc is not None else 0.0
             for idx, (lo, hi) in enumerate(schedule):
                 self._send(root_world,
                            ("collc", self.cid, rnd, rank, opname, idx, K,
                             _pack(arr[lo:hi])), opname)
+            if sc is not None:
+                sc.spans.append(("copy", t0, _pv.monotonic()))
+                t0 = _pv.monotonic()
             parts = []
             for idx in range(K):
                 parts.append(np.asarray(_unpack(
                     self._result_wait(rnd, (rnd, "cres", idx), opname)))
                     .reshape(-1))
                 progress_note(prog)
+            if sc is not None:
+                sc.spans.append(("rendezvous", t0, _pv.monotonic()))
             return self._from_host(np.concatenate(parts), contrib)
 
-        # root: per-chunk gather -> rank-order fold -> immediate scatter
+        # root: per-chunk gather -> rank-order fold -> immediate scatter.
+        # The per-phase sums double as the overlap-fraction inputs: chunk-k
+        # rendezvous waits AFTER the first chunk are exactly the transfer
+        # time the pipeline failed to hide behind the chunk-(k-1) fold.
         others = [r for r in range(n) if r != rank]
         res_parts = []
+        fold_ns = wait_after_first_ns = 0
         for idx, (lo, hi) in enumerate(schedule):
+            tw = _pv.monotonic() if sc is not None else 0.0
             with self.cond:
                 self._wait_for(
                     lambda: all((rnd, r, "c", idx) in self.inbox
@@ -1473,6 +1486,11 @@ class ProcChannel(_Waitable):
                     limit=collective_wait_limit(opname))
                 gathered = {r: self.inbox.pop((rnd, r, "c", idx))
                             for r in others}
+            if sc is not None:
+                tw1 = _pv.monotonic()
+                sc.spans.append(("rendezvous", tw, tw1))
+                if idx > 0:
+                    wait_after_first_ns += int((tw1 - tw) * 1e9)
             for r, (got_op, got_k, _) in gathered.items():
                 if got_op != opname:
                     err = CollectiveMismatchError(
@@ -1489,6 +1507,7 @@ class ProcChannel(_Waitable):
                     raise err
             # fold OUTSIDE the cond hold: the drainer delivers later chunks
             # while this one reduces — that concurrency IS the overlap
+            tf = _pv.monotonic() if sc is not None else 0.0
             pieces = [arr[lo:hi] if r == rank
                       else np.asarray(_unpack(gathered[r][2])).reshape(-1)
                       for r in range(n)]
@@ -1500,12 +1519,21 @@ class ProcChannel(_Waitable):
                     op.ufunc(red, p, out=red)
             else:
                 red = np.asarray(_ft.reduce(op, pieces))
+            if sc is not None:
+                tf1 = _pv.monotonic()
+                sc.spans.append(("fold", tf, tf1))
+                fold_ns += int((tf1 - tf) * 1e9)
+                tf = tf1
             res_parts.append(red)
             for r in others:
                 self._send(self.group[r],
                            ("collcres", self.cid, rnd, idx, _pack(red)),
                            opname)
+            if sc is not None:
+                sc.spans.append(("copy", tf, _pv.monotonic()))
             progress_note(prog)
+        if sc is not None and _pv.enabled():
+            _pv.note_pipelined(self.cid, K, fold_ns, wait_after_first_ns)
         return self._from_host(np.concatenate(res_parts), contrib)
 
     def _run_star(self, rank: int, rnd: int, contrib: Any,
@@ -1514,12 +1542,21 @@ class ProcChannel(_Waitable):
         ctx = self.ctx
         n = len(self.group)
         root_world = self.group[0]
+        sc = _pv.scope()    # pvar phase spans; None when pvars+tracing off
         if ctx.local_rank != root_world:
+            t0 = _pv.monotonic() if sc is not None else 0.0
             self._send(root_world, ("coll", self.cid, rnd, rank, opname,
                                     _pack(contrib)), opname)
-            return _unpack(self._result_wait(rnd, (rnd,), opname))
+            if sc is not None:
+                sc.spans.append(("copy", t0, _pv.monotonic()))
+                t0 = _pv.monotonic()
+            res = self._result_wait(rnd, (rnd,), opname)
+            if sc is not None:
+                sc.spans.append(("rendezvous", t0, _pv.monotonic()))
+            return _unpack(res)
 
         # root: gather, verify, combine, scatter
+        t0 = _pv.monotonic() if sc is not None else 0.0
         with self.cond:
             self._wait_for(
                 lambda: all((rnd, r) in self.inbox for r in range(n) if r != rank),
@@ -1530,6 +1567,8 @@ class ProcChannel(_Waitable):
                     gathered[r] = (opname, contrib)
                 else:
                     gathered[r] = self.inbox.pop((rnd, r))
+        if sc is not None:
+            sc.spans.append(("rendezvous", t0, _pv.monotonic()))
         names = {op for op, _ in gathered}
         if len(names) > 1:
             err = CollectiveMismatchError(
@@ -1537,21 +1576,27 @@ class ProcChannel(_Waitable):
                 f"{sorted(names)}")
             self.ctx.fail(err)
             raise err
+        t0 = _pv.monotonic() if sc is not None else 0.0
         try:
             results = list(combine([_unpack(c) for _, c in gathered]))
         except BaseException as e:
             self.ctx.fail(e)
             raise
+        if sc is not None:
+            sc.spans.append(("fold", t0, _pv.monotonic()))
         if len(results) != n:
             err = MPIError(f"combine for {opname} returned {len(results)} "
                            f"results for {n} ranks")
             self.ctx.fail(err)
             raise err
+        t0 = _pv.monotonic() if sc is not None else 0.0
         for r in range(n):
             if r == rank:
                 continue
             self._send(self.group[r],
                        ("collres", self.cid, rnd, _pack(results[r])), opname)
+        if sc is not None:
+            sc.spans.append(("copy", t0, _pv.monotonic()))
         return results[rank]
 
     def _send(self, world_dst: int, item: Any, opname: str) -> None:

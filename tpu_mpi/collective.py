@@ -41,6 +41,8 @@ from .buffers import (IN_PLACE, DeviceBuffer, _InPlace, assert_minlength,
 from .comm import Comm, Intercomm, ROOT
 from ._runtime import PROC_NULL
 from . import error as _ec
+from . import perfvars as _pv
+from .analyze import events as _ev
 from .error import CollectiveMismatchError, MPIError
 from .operators import Op, as_op
 from .overlap import (ChunkSchedule, CollectivePlan, PersistentCollRequest,
@@ -55,20 +57,41 @@ def _run(comm: Comm, contrib: Any, combine, opname: str, plan=None,
     # same single worker, preserving program order.
     # ``_sig`` is the trace verifier's precise cross-rank-checkable
     # signature (root/dtype/count) when the caller knows one.
-    from .analyze import events as _ev
-    if not _ev.enabled():
-        return _ordered_run(comm, lambda: comm.channel().run(
-            comm.rank(), contrib, combine, opname, plan=plan))
-    _ev.record_collective(comm, opname, sig=_sig)
-    from ._runtime import require_env
-    ctx, _ = require_env()
-    bev = _ev.blocked_event(comm, "coll", opname)
-    _ev.set_blocked(ctx, bev)
+    traced = _ev.enabled()
+    # pvar op scope: channels drop phase spans into it; op_end stamps the
+    # trace event and the per-comm counters. op_begin() returns None when an
+    # outer owner (e.g. _reduce_family, capturing the copy-out phase too)
+    # already opened one — then the owner finalizes, not us.
+    sc = _pv.op_begin() if (traced or _pv.enabled()) else None
     try:
-        return _ordered_run(comm, lambda: comm.channel().run(
-            comm.rank(), contrib, combine, opname, plan=plan))
+        if not traced:
+            return _ordered_run(comm, lambda: comm.channel().run(
+                comm.rank(), contrib, combine, opname, plan=plan))
+        ev = _ev.record_collective(comm, opname, sig=_sig)
+        if sc is not None:
+            sc.ev = ev
+        elif traced:
+            outer = _pv.scope()
+            if outer is not None and outer.ev is None:
+                outer.ev = ev
+        from ._runtime import require_env
+        ctx, _ = require_env()
+        bev = _ev.blocked_event(comm, "coll", opname)
+        _ev.set_blocked(ctx, bev)
+        try:
+            return _ordered_run(comm, lambda: comm.channel().run(
+                comm.rank(), contrib, combine, opname, plan=plan))
+        finally:
+            _ev.clear_blocked(ctx, bev)
     finally:
-        _ev.clear_blocked(ctx, bev)
+        if sc is not None:
+            sig = _sig or {}
+            # plan opnames carry the cid ("Allreduce@0") — strip for the key
+            _pv.op_end(sc, comm, coll=opname.split("@", 1)[0].lower(),
+                       algo=sig.get("algo"),
+                       dtype=(str(sig["dtype"]) if sig.get("dtype") is not None
+                              else None),
+                       nbytes=_pv.payload_nbytes(contrib))
 
 
 def _run_rooted(comm: Comm, root: int, contrib: Any, combine, opname: str,
@@ -994,30 +1017,51 @@ def _reduce_family(args, has_root: bool, mode: str, name: str) -> Any:
         payload = to_wire(sendbuf, count)
 
     cplan = _reduce_plan(comm, name, mode, op, count, payload)
-    if has_root:
-        result = _run_rooted(comm, root, payload, cplan.combine, cplan.opname,
-                             plan=cplan.hint, _sig=cplan.sig)
-    else:
-        result = _run(comm, payload, cplan.combine, cplan.opname,
-                      plan=cplan.hint, _sig=cplan.sig)
-    i_get_result = (not has_root) or rank == root
-    if mode == "exscan" and result is None:
-        # rank 0's Exscan output is undefined (src/collective.jl:834-855);
-        # leave buffers untouched, return the input unchanged.
+    # Own the pvar op scope across BOTH the rendezvous (_run) and the
+    # result consumption below, so the copy-out into the user's recvbuf
+    # lands in the same phase breakdown as the channel's rendezvous/fold
+    # spans (the inner _run sees the open scope and defers finalization).
+    sc = _pv.op_begin() if (_pv.enabled() or _ev.enabled()) else None
+    try:
+        if has_root:
+            result = _run_rooted(comm, root, payload, cplan.combine,
+                                 cplan.opname, plan=cplan.hint, _sig=cplan.sig)
+        else:
+            result = _run(comm, payload, cplan.combine, cplan.opname,
+                          plan=cplan.hint, _sig=cplan.sig)
+        i_get_result = (not has_root) or rank == root
+        if mode == "exscan" and result is None:
+            # rank 0's Exscan output is undefined (src/collective.jl:834-855);
+            # leave buffers untouched, return the input unchanged.
+            if alloc:
+                return sendbuf if scalar_in else clone_like(sendbuf, np.asarray(sendbuf))
+            return recvbuf if not inplace else sendbuf
+        if not i_get_result:
+            return None if alloc else recvbuf
         if alloc:
-            return sendbuf if scalar_in else clone_like(sendbuf, np.asarray(sendbuf))
-        return recvbuf if not inplace else sendbuf
-    if not i_get_result:
-        return None if alloc else recvbuf
-    if alloc:
-        if scalar_in:
-            out = np.asarray(result)
-            return out.item() if out.ndim == 0 or out.size == 1 else out
-        shaped = _shape_result(result, sendbuf, count)
-        return clone_like(sendbuf, shaped)
-    target = sendbuf if inplace else recvbuf
-    write_flat(target, result, count)
-    return target
+            if scalar_in:
+                out = np.asarray(result)
+                return out.item() if out.ndim == 0 or out.size == 1 else out
+            shaped = _shape_result(result, sendbuf, count)
+            if sc is None:
+                return clone_like(sendbuf, shaped)
+            t0 = _pv.monotonic()
+            out = clone_like(sendbuf, shaped)
+            sc.spans.append(("copy", t0, _pv.monotonic()))
+            return out
+        target = sendbuf if inplace else recvbuf
+        if sc is None:
+            write_flat(target, result, count)
+        else:
+            t0 = _pv.monotonic()
+            write_flat(target, result, count)
+            sc.spans.append(("copy", t0, _pv.monotonic()))
+        return target
+    finally:
+        if sc is not None:
+            _pv.op_end(sc, comm, coll=name.lower(), algo=cplan.sig.get("algo"),
+                       dtype=cplan.sig.get("dtype"),
+                       nbytes=_pv.payload_nbytes(payload))
 
 
 def _shape_result(result: Any, like: Any, count: int) -> Any:
@@ -1151,7 +1195,14 @@ class CollRequest:
         if self._inactive:
             return self.status or STATUS_EMPTY
         if not self._done:
-            self._complete()
+            if _pv.enabled():
+                t0 = _pv.monotonic()
+                try:
+                    self._complete()
+                finally:
+                    _pv.add_wait(_pv.monotonic() - t0)
+            else:
+                self._complete()
         return self._consume()
 
     def _consume(self):

@@ -111,6 +111,16 @@ class Comm:
         self._check()
         return self.ctx.channel(self._cid, len(self._group), group=self._group)
 
+    def get_pvars(self, reset: bool = False) -> dict:
+        """This rank's performance-variable snapshot on this communicator
+        (docs/observability.md): byte/op counters, per-collective latency
+        stats and histograms, host-path phase times, RMA epoch counts.
+        ``reset=True`` additionally zeroes the counters (MPI_T pvar
+        read-and-reset semantics)."""
+        self._check()
+        from . import perfvars
+        return perfvars.comm_snapshot(self, reset=reset)
+
     @property
     def device(self):
         """The JAX device owned by the calling rank (SURVEY.md §2.3: buffers

@@ -105,6 +105,16 @@ class Config:
     # use one mmap'd /dev/shm segment per communicator instead of O(P)
     # transport messages when all ranks share a host; 0 disables the lane.
     coll_shm_max_bytes: int = 1 << 16
+    # performance-variable (pvar) collection level (docs/observability.md):
+    # 0 disables every counter (one branch per op remains), 1 collects.
+    # Pcontrol(level) overrides this at runtime without a config reload.
+    pvars: int = 1
+    # directory for per-rank pvar dumps at Finalize / Pcontrol(>=2):
+    # each rank writes pvars-rank<R>.json there; "" = no dump.
+    pvars_dump: str = ""
+    # per-collective latency histogram width (log2-microsecond buckets):
+    # bucket i counts ops with latency in [2^(i-1), 2^i) us.
+    pvars_hist_bins: int = 24
 
     def replace(self, **kw: Any) -> "Config":
         d = {f.name: getattr(self, f.name) for f in fields(self)}
@@ -134,6 +144,9 @@ _ENV_MAP = {
     "tune_table": "TPU_MPI_TUNE_TABLE",
     "coll_algo": "TPU_MPI_COLL_ALGO",
     "coll_shm_max_bytes": "TPU_MPI_COLL_SHM_MAX_BYTES",
+    "pvars": "TPU_MPI_PVARS",
+    "pvars_dump": "TPU_MPI_PVARS_DUMP",
+    "pvars_hist_bins": "TPU_MPI_PVARS_HIST_BINS",
 }
 
 _lock = threading.Lock()

@@ -124,6 +124,10 @@ def Finalize() -> None:
     # communicator that saw a nonblocking collective)
     from .collective import nb_shutdown
     nb_shutdown(ctx, world_rank=rank)
+    # flush this rank's perf counters when a dump dir is configured
+    # (TPU_MPI_PVARS_DUMP) — one branch when pvars are off
+    from . import perfvars
+    perfvars.finalize_dump()
     ctx.finalized[rank] = True
 
 
@@ -152,10 +156,45 @@ def Wtime() -> float:
     return time.perf_counter()
 
 
+_measured_tick: Optional[float] = None
+
+
 def Wtick() -> float:
-    """Resolution of Wtime (src/environment.jl:289)."""
-    info = time.get_clock_info("perf_counter")
-    return info.resolution
+    """Resolution of Wtime (src/environment.jl:289).
+
+    Returns the platform's ADVERTISED ``perf_counter`` resolution when it is
+    plausible (strictly between 0 and 1 second — the MPI contract: Wtick is
+    the seconds between ticks, and e.g. Windows advertises a bogus 1e-7 /
+    some platforms report whole seconds). Otherwise falls back to a MEASURED
+    tick — the minimum nonzero delta observed over a short spin — cached for
+    the life of the process.
+    """
+    res = time.get_clock_info("perf_counter").resolution
+    if 0.0 < res < 1.0:
+        return res
+    global _measured_tick
+    if _measured_tick is None:
+        best = 1.0
+        for _ in range(1000):
+            a = time.perf_counter()
+            b = time.perf_counter()
+            while b == a:           # spin until the clock visibly advances
+                b = time.perf_counter()
+            if b - a < best:
+                best = b - a
+        _measured_tick = best
+    return _measured_tick
+
+
+def Pcontrol(level: int) -> int:
+    """MPI-standard profiling-level control, wired to the pvar subsystem
+    (docs/observability.md): ``Pcontrol(0)`` disables counter collection,
+    ``Pcontrol(1)`` restores the configured default (the ``pvars`` knob),
+    and ``Pcontrol(level >= 2)`` enables collection AND immediately flushes
+    a per-rank dump to ``pvars_dump`` (when set). Returns the effective
+    collection level."""
+    from . import perfvars
+    return perfvars.pcontrol(level)
 
 
 class profile_trace:

@@ -246,10 +246,18 @@ def main(argv: Optional[list[str]] = None) -> int:
         # a tuning table. All following args belong to the tuner.
         from . import tune
         return tune.main(argv[1:])
+    if argv[:1] == ["--stats"]:
+        # `tpurun --stats <dumps...>` / `tpurun --stats -- <launch args>` —
+        # the pvar report CLI (tpu_mpi.stats): aggregate per-rank counter
+        # dumps into latency/bandwidth tables, or wrap a whole launch with
+        # dumping enabled. All following args belong to the reporter.
+        from . import stats
+        return stats.main(argv[1:])
     p = argparse.ArgumentParser(
         prog="tpurun",
         description="Run an SPMD tpu_mpi program on N ranks (mpiexec analog); "
-                    "`tpurun --tune` runs the collective autotuner")
+                    "`tpurun --tune` runs the collective autotuner and "
+                    "`tpurun --stats` the pvar performance reporter")
     from . import config
     cfg = config.load()
     p.add_argument("-n", "--np", type=int, default=cfg.nprocs or None,

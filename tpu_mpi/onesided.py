@@ -32,6 +32,7 @@ import numpy as np
 
 from ._runtime import require_env, deadlock_timeout, raise_deadlock, _POLL
 from .analyze import events as _ev
+from . import perfvars as _pv
 from .buffers import (DeviceBuffer, extract_array, element_count,
                       resolve_attached, write_flat, write_range)
 from .comm import Comm
@@ -279,6 +280,8 @@ def Win_fence(assert_: int, win: Win) -> None:
     since Put/Get complete synchronously in shared memory; multi-process
     windows first flush every dirty target over the wire."""
     win._check()
+    if _pv.enabled():
+        _pv.note_rma(win.comm, "fence")
     traced = _ev.enabled()
     opname = f"Win_fence@{win.comm.cid}"
     if traced:
@@ -309,6 +312,8 @@ def Win_flush(rank: int, win: Win) -> None:
     Synchronous in shared memory; multi-process windows await the owner's
     FIFO ack, which completes every earlier op from this origin."""
     win._check()
+    if _pv.enabled():
+        _pv.note_rma(win.comm, "flush")
     if _ev.enabled():
         _ev.record_sync(win, "Win_flush")
     if getattr(win._state, "is_proc", False):
@@ -326,6 +331,8 @@ def Win_lock(lock_type: LockType, rank: int, assert_: int, win: Win) -> None:
     (src/onesided.jl:138-143): EXCLUSIVE excludes all, SHARED excludes
     writers — a real reader/writer lock (SURVEY.md §2.3 lock emulation)."""
     win._check()
+    if _pv.enabled():
+        _pv.note_rma(win.comm, "lock")
     ctx, _ = require_env()
     excl = lock_type is LOCK_EXCLUSIVE or lock_type.val == LOCK_EXCLUSIVE.val
     target_world = win.comm.world_rank_of(int(rank))

@@ -1,0 +1,451 @@
+"""MPI_T-inspired performance variables (pvars): always-on counters + spans.
+
+The reference ships no tracing at all (SURVEY.md: only ``Wtime``/``Wtick``;
+external PMPI/MPI_T tools are assumed) — this module is the layer those
+tools would have provided, owned by the runtime itself. Three cooperating
+pieces:
+
+- **Per-comm counters** keyed ``(world rank, cid)``: bytes sent/received,
+  op counts per ``(collective, algorithm, dtype)``, time blocked in the
+  Wait family, host-path phase time split rendezvous / fold / copy,
+  chunk-pipeline overlap inputs, RMA epoch counts, and per-collective
+  latency histograms (log2-µs buckets, ``config.pvars_hist_bins`` wide).
+  Plan-cache hits/misses ride along at snapshot time from
+  ``overlap.plans.stats()``.
+- **Timed spans** on the event IR: when tracing is on, the op scope opened
+  here stamps the recorded :class:`~tpu_mpi.analyze.events.Event` with
+  ``t_start``/``t_end`` and the phase spans the channels observed, which
+  :mod:`tpu_mpi.analyze.timeline` renders as a Chrome-trace / Perfetto
+  timeline.
+- **Runtime control**: the MPI-standard ``Pcontrol(level)``
+  (:func:`tpu_mpi.environment.Pcontrol` delegates here) — 0 disables, 1
+  enables (the default), >= 2 enables AND flushes a dump immediately.
+
+Overhead discipline (the ``analyze.events.enabled()`` contract): every hot
+hook front-loads :func:`enabled` — one tuple compare against
+``config.GENERATION`` — so a ``TPU_MPI_PVARS=0`` run pays a single
+predictable branch per operation; the committed
+``benchmarks/results/overhead-pvars-cpusim.json`` artifact pins that.
+
+Span-attribution caveat: phase spans collect into a thread-local op scope,
+so a BLOCKING collective that routes through the nonblocking worker (only
+when that comm has outstanding ``I*`` ops) keeps its counters but loses its
+per-phase spans — the worker thread owns no scope for it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from . import config
+from typing import Any, Dict, List, Optional, Tuple
+
+monotonic = time.monotonic
+
+PHASES = ("rendezvous", "fold", "copy")
+
+_UNSET = object()
+_enabled_cache: Tuple[Any, bool] = (_UNSET, False)
+# Pcontrol's runtime override: None = follow config.pvars.
+_level_override: Optional[int] = None
+_store_lock = threading.Lock()
+_store: Dict[Tuple[int, int], "CommPvars"] = {}
+# bumped whenever accumulators are dropped from _store, so the per-thread
+# _acct caches never keep writing into an orphaned accumulator
+_store_gen = 0
+
+
+class _TLS(threading.local):
+    # class-attribute defaults: fresh threads read these without the
+    # AttributeError/getattr-default dance on the hot path
+    scope = None                      # the open _OpScope of this thread
+    acct = None                       # (store_gen, {key: CommPvars}) cache
+
+
+_tls = _TLS()
+
+
+def _config_level() -> int:
+    if _level_override is not None:
+        config.load()               # keep GENERATION meaningful for the gate
+        return _level_override
+    return int(config.load().pvars)
+
+
+def enabled() -> bool:
+    """Whether pvar collection is on — cached on ``config.GENERATION`` so
+    the per-operation cost of a disabled run is one tuple compare."""
+    global _enabled_cache
+    cached_gen, val = _enabled_cache
+    if cached_gen == config.GENERATION:
+        return val
+    val = _config_level() >= 1
+    _enabled_cache = (config.GENERATION, val)
+    return val
+
+
+def level() -> int:
+    """The effective collection level (0 off, 1 on; >= 2 behaves as 1 —
+    the flush side effect belongs to :func:`pcontrol` itself)."""
+    return _config_level()
+
+
+def pcontrol(lvl: int) -> int:
+    """Runtime toggle (the ``MPI_Pcontrol`` contract): 0 disables
+    collection, 1 restores the default (the ``pvars`` config knob), and
+    any level >= 2 enables collection and immediately flushes a dump to
+    ``config.pvars_dump`` (when set). Returns the effective level."""
+    global _level_override, _enabled_cache
+    lvl = int(lvl)
+    if lvl < 0:
+        lvl = 0
+    _level_override = None if lvl == 1 else lvl
+    _enabled_cache = (config.GENERATION, _config_level() >= 1)
+    if lvl >= 2:
+        finalize_dump(force=True)
+    return _config_level()
+
+
+class CommPvars:
+    """The counter set of one ``(world rank, cid)`` pair."""
+
+    __slots__ = ("rank", "cid", "size", "bytes_sent", "bytes_recv", "sends",
+                 "recvs", "wait_ns", "ops", "times", "phase_ns", "rma",
+                 "hist", "pipe_ops", "pipe_chunks", "pipe_fold_ns",
+                 "pipe_wait_ns")
+
+    def __init__(self, rank: int, cid: int):
+        self.rank = rank
+        self.cid = cid
+        self.size = 0
+        self.bytes_sent = 0
+        self.bytes_recv = 0
+        self.sends = 0
+        self.recvs = 0
+        self.wait_ns = 0
+        # (coll, algo, dtype) -> op count
+        self.ops: Dict[Tuple[str, str, str], int] = {}
+        # (coll, algo, nbytes) -> [count, total_ns, min_ns, max_ns]
+        self.times: Dict[Tuple[str, str, int], List[int]] = {}
+        self.phase_ns = {p: 0 for p in PHASES}
+        self.rma = {"fence": 0, "lock": 0, "flush": 0}
+        self.hist: Dict[str, List[int]] = {}      # coll -> log2-µs buckets
+        # chunk-pipeline overlap inputs (see snapshot() for the derived
+        # fraction): fold time + post-first-chunk rendezvous waits of
+        # pipelined star roots
+        self.pipe_ops = 0
+        self.pipe_chunks = 0
+        self.pipe_fold_ns = 0
+        self.pipe_wait_ns = 0
+
+    def snapshot(self) -> dict:
+        bins = max(4, int(config.load().pvars_hist_bins))
+        pipe_busy = self.pipe_fold_ns + self.pipe_wait_ns
+        return {
+            "rank": self.rank, "cid": self.cid, "size": self.size,
+            "bytes_sent": self.bytes_sent, "bytes_recv": self.bytes_recv,
+            "sends": self.sends, "recvs": self.recvs,
+            "wait_s": self.wait_ns / 1e9,
+            "ops": {"|".join(k): v for k, v in sorted(self.ops.items())},
+            "times": [{"coll": c, "algo": a, "nbytes": b, "count": t[0],
+                       "total_s": t[1] / 1e9, "min_s": t[2] / 1e9,
+                       "max_s": t[3] / 1e9}
+                      for (c, a, b), t in sorted(self.times.items())],
+            "phase_s": {p: ns / 1e9 for p, ns in self.phase_ns.items()},
+            "rma": dict(self.rma),
+            "hist_bins": bins,
+            "hist": {c: list(h) for c, h in sorted(self.hist.items())},
+            "pipeline": {
+                "ops": self.pipe_ops, "chunks": self.pipe_chunks,
+                "fold_s": self.pipe_fold_ns / 1e9,
+                "wait_after_first_s": self.pipe_wait_ns / 1e9,
+                # 1.0 = every post-first-chunk contribution had already
+                # landed when the root finished the previous fold (transfer
+                # fully hidden behind compute); 0.0 = fully serial
+                "overlap_fraction": (round(self.pipe_fold_ns / pipe_busy, 4)
+                                     if pipe_busy else None),
+            },
+        }
+
+
+def _acct(comm: Any = None, cid: Optional[int] = None,
+          size: int = 0) -> Optional[CommPvars]:
+    """The accumulator of (current world rank, comm's cid), creating it on
+    first touch; None outside an SPMD environment."""
+    from ._runtime import current_env
+    env = current_env()
+    if env is None:
+        return None
+    rank = env[1]
+    if comm is not None:
+        cid = comm.cid
+    elif cid is None:
+        cid = -1                      # unattributed (no comm at the hook)
+    key = (rank, cid)
+    cached = _tls.acct
+    if cached is not None and cached[0] == _store_gen:
+        acct = cached[1].get(key)
+        if acct is not None:
+            if comm is not None and not acct.size:
+                acct.size = size or len(comm.group)
+            return acct
+    with _store_lock:
+        acct = _store.get(key)
+        if acct is None:
+            acct = _store[key] = CommPvars(rank, cid)
+        if comm is not None and not acct.size:
+            acct.size = size or len(comm.group)
+    if cached is None or cached[0] != _store_gen:
+        cached = _tls.acct = (_store_gen, {})
+    cached[1][key] = acct
+    return acct
+
+
+# ---------------------------------------------------------------------------
+# Op scope: per-op span collection shared with the event IR
+# ---------------------------------------------------------------------------
+
+class _OpScope:
+    __slots__ = ("t0", "spans", "ev")
+
+    def __init__(self):
+        self.t0 = monotonic()
+        self.spans: List[Tuple[str, float, float]] = []
+        self.ev: Any = None           # the trace Event of this op, if any
+
+
+def scope() -> Optional[_OpScope]:
+    """The open op scope of this thread (channels append phase spans to
+    ``scope().spans``), or None."""
+    return _tls.scope
+
+
+def op_begin() -> Optional[_OpScope]:
+    """Open an op scope on this thread. Returns None when one is already
+    open — the outermost owner finalizes (``_reduce_family`` wraps ``_run``
+    so the copy-out phase lands inside the same scope)."""
+    if _tls.scope is not None:
+        return None
+    sc = _OpScope()
+    _tls.scope = sc
+    return sc
+
+
+def op_end(sc: _OpScope, comm: Any = None, coll: Optional[str] = None,
+           algo: Optional[str] = None, dtype: Optional[str] = None,
+           nbytes: Optional[int] = None) -> None:
+    """Close the scope: stamp the op's trace event (t_start/t_end/phases)
+    and fold duration + spans into the per-comm counters."""
+    _tls.scope = None
+    t1 = monotonic()
+    ev = sc.ev
+    if ev is not None:
+        ev.t_start = sc.t0
+        ev.t_end = t1
+        if sc.spans:
+            ev.phases = list(sc.spans)
+    if not enabled() or coll is None:
+        return
+    acct = _acct(comm)
+    if acct is None:
+        return
+    bins = max(4, int(config.load().pvars_hist_bins))
+    dur_ns = int((t1 - sc.t0) * 1e9)
+    key = (coll, algo or "star", -1 if nbytes is None else int(nbytes))
+    with _store_lock:
+        okey = (coll, algo or "star", dtype or "?")
+        acct.ops[okey] = acct.ops.get(okey, 0) + 1
+        t = acct.times.get(key)
+        if t is None:
+            acct.times[key] = [1, dur_ns, dur_ns, dur_ns]
+        else:
+            t[0] += 1
+            t[1] += dur_ns
+            if dur_ns < t[2]:
+                t[2] = dur_ns
+            if dur_ns > t[3]:
+                t[3] = dur_ns
+        for name, s0, s1 in sc.spans:
+            if name in acct.phase_ns:
+                acct.phase_ns[name] += int((s1 - s0) * 1e9)
+        hist = acct.hist.get(coll)
+        if hist is None:
+            hist = acct.hist[coll] = [0] * bins
+        idx = (dur_ns // 1000).bit_length()   # log2 bucket of the µs latency
+        hist[min(idx, len(hist) - 1)] += 1
+
+
+def payload_nbytes(contrib: Any) -> Optional[int]:
+    """Wire size of a collective contribution for the bandwidth counters
+    (rooted contributions arrive as ``(root, payload)`` tuples)."""
+    if isinstance(contrib, tuple) and len(contrib) == 2:
+        contrib = contrib[1]
+    nb = getattr(contrib, "nbytes", None)
+    if nb is None:
+        return None
+    dt = getattr(contrib, "dtype", None)
+    if dt is None or dt == object:
+        return None
+    return int(nb)
+
+
+# ---------------------------------------------------------------------------
+# Hot-path counter hooks (call sites gate on enabled())
+# ---------------------------------------------------------------------------
+
+def add_send(comm: Any, nbytes: int, wait_ns: int = 0) -> None:
+    acct = _acct(comm)
+    if acct is None:
+        return
+    with _store_lock:
+        acct.sends += 1
+        acct.bytes_sent += int(nbytes or 0)
+        acct.wait_ns += int(wait_ns)
+
+
+def add_recv(comm: Any, nbytes: int, wait_ns: int = 0) -> None:
+    acct = _acct(comm)
+    if acct is None:
+        return
+    with _store_lock:
+        acct.recvs += 1
+        acct.bytes_recv += int(nbytes or 0)
+        acct.wait_ns += int(wait_ns)
+
+
+def add_wait(wait_s: float, comm: Any = None, cid: Optional[int] = None) -> None:
+    """Time blocked in the Wait/Test family (unattributed waits land on the
+    pseudo-cid -1)."""
+    acct = _acct(comm, cid=cid)
+    if acct is None:
+        return
+    with _store_lock:
+        acct.wait_ns += int(wait_s * 1e9)
+
+
+def note_rma(comm: Any, kind: str) -> None:
+    """One RMA epoch event: kind in {fence, lock, flush}."""
+    acct = _acct(comm)
+    if acct is None:
+        return
+    with _store_lock:
+        if kind in acct.rma:
+            acct.rma[kind] += 1
+
+
+def note_pipelined(cid: int, nchunks: int, fold_ns: int,
+                   wait_after_first_ns: int) -> None:
+    """One chunk-pipelined star fold at the root: the overlap-fraction
+    inputs (fold time vs rendezvous waits AFTER the first chunk — waits
+    that a perfectly overlapped pipeline hides behind the fold)."""
+    acct = _acct(cid=cid)
+    if acct is None:
+        return
+    with _store_lock:
+        acct.pipe_ops += 1
+        acct.pipe_chunks += int(nchunks)
+        acct.pipe_fold_ns += int(fold_ns)
+        acct.pipe_wait_ns += int(wait_after_first_ns)
+
+
+# ---------------------------------------------------------------------------
+# Snapshot / reset / dump
+# ---------------------------------------------------------------------------
+
+def snapshot(rank: Optional[int] = None, reset: bool = False) -> dict:
+    """Machine-readable dump of every counter (one rank, or all ranks this
+    process has accumulated). Stable schema — ``tpu_mpi.stats`` and
+    ``tune.table_from_pvars`` consume exactly this."""
+    global _store_gen
+    from .overlap import plans
+    with _store_lock:
+        keys = [k for k in sorted(_store) if rank is None or k[0] == rank]
+        comms = [_store[k].snapshot() for k in keys]
+        if reset:
+            for k in keys:
+                del _store[k]
+            _store_gen += 1
+    return {"schema": 1, "kind": "tpu_mpi-pvars", "level": level(),
+            "comms": comms, "plan_cache": plans.stats()}
+
+
+def comm_snapshot(comm: Any, reset: bool = False) -> dict:
+    """``Comm.get_pvars`` backend: this rank's counters on one comm."""
+    global _store_gen
+    from ._runtime import require_env
+    _, rank = require_env()
+    key = (rank, comm.cid)
+    with _store_lock:
+        acct = _store.get(key)
+        snap = acct.snapshot() if acct is not None \
+            else CommPvars(rank, comm.cid).snapshot()
+        if reset and acct is not None:
+            del _store[key]
+            _store_gen += 1
+    return snap
+
+
+def reset() -> None:
+    """Drop every accumulated counter (all ranks of this process)."""
+    global _store_gen
+    with _store_lock:
+        _store.clear()
+        _store_gen += 1
+
+
+def dump(path: str, rank: Optional[int] = None, reset: bool = False) -> str:
+    """Write :func:`snapshot` as JSON; returns the path."""
+    rec = snapshot(rank=rank, reset=reset)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def load_dumps(paths) -> List[dict]:
+    """Read pvar dump records from files and/or directories (a directory
+    contributes every ``pvars-rank*.json`` / ``*.json`` file in it).
+    Consumers: ``tpu_mpi.stats`` and ``tune.table_from_pvars``."""
+    files: List[str] = []
+    for p in paths:
+        p = os.path.expanduser(p)
+        if os.path.isdir(p):
+            names = sorted(os.listdir(p))
+            picked = [n for n in names if n.startswith("pvars-rank")
+                      and n.endswith(".json")]
+            files.extend(os.path.join(p, n) for n in
+                         (picked or [n for n in names if n.endswith(".json")]))
+        else:
+            files.append(p)
+    recs = []
+    for f in files:
+        with open(f) as fh:
+            rec = json.load(fh)
+        if rec.get("kind") != "tpu_mpi-pvars":
+            raise ValueError(f"{f}: not a tpu_mpi pvar dump")
+        rec["_path"] = f
+        recs.append(rec)
+    return recs
+
+
+def finalize_dump(force: bool = False) -> Optional[str]:
+    """Per-rank dump at Finalize (and at ``Pcontrol(level >= 2)``): when
+    ``config.pvars_dump`` names a directory, this rank writes
+    ``pvars-rank<R>.json`` there. Costs one branch when pvars are off."""
+    if not (enabled() or force):
+        return None
+    from ._runtime import current_env
+    d = config.load().pvars_dump
+    if not d:
+        return None
+    env = current_env()
+    rank = env[1] if env is not None else 0
+    return dump(os.path.join(os.path.expanduser(d), f"pvars-rank{rank}.json"),
+                rank=rank)

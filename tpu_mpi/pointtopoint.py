@@ -37,6 +37,8 @@ from .buffers import (element_count, extract_array, is_wire_snapshot,
 from .comm import Comm
 from .datatypes import Datatype, to_datatype
 from . import error as _ec
+from . import perfvars as _pv
+from .analyze import events as _ev
 from .error import MPIError, TruncationError
 
 _POLL = 0.001
@@ -134,9 +136,11 @@ class Request:
         self.status = _status_of(msg)
         self._done = True
         if self._trace_comm is not None:
-            from .analyze import events as _ev
             if _ev.enabled():
                 _ev.record_recv(self._trace_comm, msg, op="Irecv")
+            if _pv.enabled():
+                _pv.add_recv(self._trace_comm,
+                             getattr(msg.payload, "nbytes", 0) or 0)
 
     def test(self) -> bool:
         """Nonblocking completion check; delivers on match."""
@@ -162,8 +166,9 @@ class Request:
         if not self._done and self.kind == "recv":
             assert self._mailbox is not None and self._pending is not None
             bev = None
+            pv_on = _pv.enabled()
+            t0 = _pv.monotonic() if pv_on else 0.0
             if self._trace_comm is not None:
-                from .analyze import events as _ev
                 if _ev.enabled():
                     pr = self._pending
                     bev = _ev.blocked_event(
@@ -176,6 +181,8 @@ class Request:
             finally:
                 if bev is not None:
                     _ev.clear_blocked(self._mailbox.ctx, bev)
+                if pv_on:
+                    _pv.add_wait(_pv.monotonic() - t0, comm=self._trace_comm)
             if msg is None:          # cancelled (src/pointtopoint.jl:677-681)
                 self.buffer = None
                 self.status = STATUS_EMPTY
@@ -190,7 +197,6 @@ class Request:
         st = self.status or STATUS_EMPTY
         if self._trace_isend is not None:
             # T206: re-checksum the Isend buffer before the root is cleared
-            from .analyze import events as _ev
             from ._runtime import current_env
             env = current_env()
             if env is not None:
@@ -241,8 +247,9 @@ def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
                   comm.cid, payload, count, dtype, kind)
     if mb is None:                       # _send_typed already resolved it
         mb = ctx.mailboxes[_resolve(comm, dest)]
-    from .analyze import events as _ev
     traced = _ev.enabled()
+    pv_on = _pv.enabled()
+    t0 = _pv.monotonic() if pv_on else 0.0
     if traced:
         opname = (("Send" if block else "Isend") if kind == "typed"
                   else ("send" if block else "isend"))
@@ -263,6 +270,12 @@ def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
             mb.post_blocking(msg, "Send")
     else:
         mb.post(msg)
+    if pv_on:
+        nb = getattr(payload, "nbytes", None)
+        if nb is None:
+            nb = len(payload) if isinstance(payload, (bytes, bytearray)) else 0
+        _pv.add_send(comm, int(nb),
+                     wait_ns=int((_pv.monotonic() - t0) * 1e9) if block else 0)
 
 
 # ---------------------------------------------------------------------------
@@ -320,7 +333,6 @@ def Isend(buf: Any, dest: int, tag: int, comm: Comm) -> Request:
         return Request("null", status=STATUS_EMPTY)
     _send_typed(buf, dest, tag, comm, block=False)
     req = Request("send", buffer=buf, status=STATUS_EMPTY)
-    from .analyze import events as _ev
     if _ev.enabled():
         _ev.note_isend(req, comm, buf)
     return req
@@ -381,7 +393,8 @@ def Recv(buf_or_type: Any, src: int, tag: int, comm: Comm,
     # mailbox lock entry (direct-drain capable) — the small-message
     # latency lane (VERDICT r3 #4, r4 #5)
     mb = _my_mailbox(comm)
-    from .analyze import events as _ev
+    pv_on = _pv.enabled()
+    t0 = _pv.monotonic() if pv_on else 0.0
     if _ev.enabled():
         ctx, _ = require_env()
         bev = _ev.blocked_event(comm, "recv", "Recv",
@@ -396,6 +409,9 @@ def Recv(buf_or_type: Any, src: int, tag: int, comm: Comm,
     else:
         msg = mb.recv_blocking(int(src), int(tag), comm.cid)
     assert msg is not None            # blocking Recv exposes no cancel handle
+    if pv_on and msg is not None:
+        _pv.add_recv(comm, getattr(msg.payload, "nbytes", 0) or 0,
+                     wait_ns=int((_pv.monotonic() - t0) * 1e9))
     n = element_count(buf_or_type)
     if msg.count > n:
         raise TruncationError(
@@ -419,8 +435,9 @@ def Irecv(buf: Any, src: int, tag: int, comm: Comm) -> Request:
     pr = mb.post_recv(int(src), int(tag), comm.cid)
     req = Request("recv", buffer=buf, pending=pr, mailbox=mb,
                   count=element_count(buf))
-    from .analyze import events as _ev
-    if _ev.enabled():
+    # pvars ride the same comm backref tracing uses (every consumer of
+    # _trace_comm re-gates on its own enabled() before acting on it)
+    if _ev.enabled() or _pv.enabled():
         req._trace_comm = comm
     return req
 
@@ -431,7 +448,6 @@ def recv(src: int, tag: int, comm: Comm):
     if src == PROC_NULL:
         return None, Status(source=PROC_NULL, tag=ANY_TAG, count=0)
     mb = _my_mailbox(comm)
-    from .analyze import events as _ev
     if _ev.enabled():
         ctx, _ = require_env()
         bev = _ev.blocked_event(comm, "recv", "recv",
@@ -461,7 +477,6 @@ def irecv(src: int, tag: int, comm: Comm):
     pr = mb.post_recv(msg.src, msg.tag, comm.cid)
     got = mb.wait_recv(pr)
     assert got is not None
-    from .analyze import events as _ev
     if _ev.enabled():
         _ev.record_recv(comm, got, op="irecv")
     return (True, _object_of(got), _status_of(got))
@@ -496,7 +511,6 @@ def Probe(src: int, tag: int, comm: Comm) -> Status:
     if src == PROC_NULL:
         return Status(source=PROC_NULL, tag=ANY_TAG, count=0)
     mb = _my_mailbox(comm)
-    from .analyze import events as _ev
     if _ev.enabled():
         ctx, _ = require_env()
         bev = _ev.blocked_event(comm, "recv", "Probe",
@@ -860,7 +874,6 @@ class PartitionedRequest:
 
     def _drain_arrivals(self) -> None:
         mb = _my_mailbox(self.comm)
-        from .analyze import events as _ev
         traced = _ev.enabled()
         still = []
         for pr in self._pending:
@@ -920,7 +933,6 @@ class PartitionedRequest:
             self.status = STATUS_EMPTY
         else:
             mb = _my_mailbox(self.comm)
-            from .analyze import events as _ev
             traced = _ev.enabled()
             cancelled = False
             for pr in self._pending:

@@ -1,0 +1,215 @@
+"""Pvar report CLI: ``python -m tpu_mpi.stats`` / ``tpurun --stats``.
+
+Reads per-rank pvar dumps (``TPU_MPI_PVARS_DUMP`` output, one
+``pvars-rank<R>.json`` per rank — see docs/observability.md) and prints
+the cross-rank report: per-collective latency tables and log2-µs
+histograms, bandwidth, host-path phase breakdown, P2P byte counters,
+plan-cache hit rate, and the chunk-pipeline overlap fraction.
+
+``tpurun --stats <dir-or-files>`` reports existing dumps;
+``tpurun --stats -- <launch args...>`` runs a launch with dumping enabled
+into a temp dir and reports it when the job exits (zero-setup profiling).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import perfvars
+
+_BAR = 30    # histogram bar width (characters at the largest bucket)
+
+
+def aggregate(records: Sequence[dict]) -> dict:
+    """Cross-rank/comm merge of pvar dump records into one report object."""
+    colls: Dict[Tuple[str, str, int], List[float]] = {}
+    hist: Dict[str, List[int]] = {}
+    phase = {p: 0.0 for p in perfvars.PHASES}
+    rma = {"fence": 0, "lock": 0, "flush": 0}
+    tot = {"bytes_sent": 0, "bytes_recv": 0, "sends": 0, "recvs": 0,
+           "wait_s": 0.0}
+    pipe = {"ops": 0, "chunks": 0, "fold_s": 0.0, "wait_after_first_s": 0.0}
+    plan = {"hits": 0, "misses": 0}
+    nranks = set()
+    for rec in records:
+        pc = rec.get("plan_cache") or {}
+        plan["hits"] += int(pc.get("hits", 0))
+        plan["misses"] += int(pc.get("misses", 0))
+        for comm in rec.get("comms", ()):
+            nranks.add(int(comm.get("size") or 0))
+            for k in ("bytes_sent", "bytes_recv", "sends", "recvs", "wait_s"):
+                tot[k] += comm.get(k, 0)
+            for p, s in (comm.get("phase_s") or {}).items():
+                phase[p] = phase.get(p, 0.0) + s
+            for k, v in (comm.get("rma") or {}).items():
+                rma[k] = rma.get(k, 0) + v
+            pl = comm.get("pipeline") or {}
+            for k in pipe:
+                pipe[k] += pl.get(k, 0)
+            for t in comm.get("times", ()):
+                key = (t["coll"], t["algo"], int(t["nbytes"]))
+                ent = colls.setdefault(key, [0.0, 0.0, float("inf"), 0.0])
+                ent[0] += t["count"]
+                ent[1] += t["total_s"]
+                ent[2] = min(ent[2], t["min_s"])
+                ent[3] = max(ent[3], t["max_s"])
+            for coll, buckets in (comm.get("hist") or {}).items():
+                h = hist.setdefault(coll, [0] * len(buckets))
+                if len(h) < len(buckets):
+                    h.extend([0] * (len(buckets) - len(h)))
+                for i, c in enumerate(buckets):
+                    h[i] += c
+    busy = pipe["fold_s"] + pipe["wait_after_first_s"]
+    return {
+        "nranks": sorted(n for n in nranks if n),
+        "colls": colls, "hist": hist, "phase_s": phase, "rma": rma,
+        "totals": tot, "plan_cache": plan, "pipeline": pipe,
+        "overlap_fraction": (round(pipe["fold_s"] / busy, 4) if busy
+                             else None),
+    }
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def render(agg: dict, out=None) -> None:
+    """Print the human-readable report."""
+    out = out or sys.stdout
+    w = out.write
+    w("== tpu_mpi pvar report ==\n")
+    if agg["nranks"]:
+        w(f"world sizes seen: {agg['nranks']}\n")
+
+    colls = agg["colls"]
+    if colls:
+        w("\nper-collective latency (aggregated over ranks):\n")
+        w(f"  {'collective':<12} {'algo':<10} {'payload':>9} {'count':>7} "
+          f"{'mean':>10} {'min':>10} {'max':>10} {'algbw':>10}\n")
+        for (coll, algo, nbytes), (cnt, tot_s, mn, mx) in sorted(colls.items()):
+            mean = tot_s / cnt if cnt else 0.0
+            bw = (f"{nbytes / mean / 1e9:.2f}GB/s"
+                  if nbytes > 0 and mean > 0 else "-")
+            w(f"  {coll:<12} {algo:<10} "
+              f"{_fmt_bytes(nbytes) if nbytes >= 0 else '-':>9} "
+              f"{int(cnt):>7} {mean * 1e6:>8.1f}us {mn * 1e6:>8.1f}us "
+              f"{mx * 1e6:>8.1f}us {bw:>10}\n")
+
+    for coll, buckets in sorted(agg["hist"].items()):
+        total = sum(buckets)
+        if not total:
+            continue
+        w(f"\nlatency histogram: {coll} ({total} ops, log2-us buckets)\n")
+        peak = max(buckets)
+        for i, c in enumerate(buckets):
+            if not c:
+                continue
+            lo = 0 if i == 0 else 1 << (i - 1)
+            hi = 1 << i
+            bar = "#" * max(1, round(c / peak * _BAR))
+            w(f"  [{lo:>8}, {hi:>8})us {c:>7} {bar}\n")
+
+    phase = agg["phase_s"]
+    if any(phase.values()):
+        tot_p = sum(phase.values())
+        w("\nhost-path phase breakdown (summed over ranks):\n")
+        for p in perfvars.PHASES:
+            s = phase.get(p, 0.0)
+            w(f"  {p:<12} {s * 1e3:>9.2f}ms  {s / tot_p * 100 if tot_p else 0:>5.1f}%\n")
+
+    t = agg["totals"]
+    w(f"\np2p: {t['sends']} sends / {_fmt_bytes(t['bytes_sent'])} out, "
+      f"{t['recvs']} recvs / {_fmt_bytes(t['bytes_recv'])} in, "
+      f"{t['wait_s'] * 1e3:.2f}ms blocked in Wait\n")
+    pc = agg["plan_cache"]
+    lk = pc["hits"] + pc["misses"]
+    if lk:
+        w(f"plan cache: {pc['hits']}/{lk} hits "
+          f"({pc['hits'] / lk * 100:.0f}%)\n")
+    rma = agg["rma"]
+    if any(rma.values()):
+        w(f"rma epochs: {rma['fence']} fences, {rma['lock']} locks, "
+          f"{rma['flush']} flushes\n")
+    if agg["overlap_fraction"] is not None:
+        p = agg["pipeline"]
+        w(f"chunk pipeline: {int(p['ops'])} ops / {int(p['chunks'])} chunks, "
+          f"overlap fraction {agg['overlap_fraction']:.3f} "
+          f"(1.0 = transfers fully hidden behind folds)\n")
+
+
+def _launch_and_collect(launch_args: List[str]) -> List[dict]:
+    """Run a ``tpurun`` launch with pvar dumping into a temp dir and load
+    the per-rank dumps it leaves behind."""
+    import os
+    import subprocess
+    import tempfile
+    with tempfile.TemporaryDirectory(prefix="tpu_mpi_stats_") as td:
+        env = dict(os.environ)
+        env["TPU_MPI_PVARS"] = "1"
+        env["TPU_MPI_PVARS_DUMP"] = td
+        rc = subprocess.call([sys.executable, "-m", "tpu_mpi.launcher",
+                              *launch_args], env=env)
+        if rc != 0:
+            print(f"stats: launch exited {rc}", file=sys.stderr)
+        recs = perfvars.load_dumps([td])
+        if not recs:
+            raise SystemExit(f"stats: the launch left no pvar dumps in {td}")
+        return recs
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpurun --stats",
+        description="Aggregate per-rank pvar dumps into latency/bandwidth "
+                    "tables (docs/observability.md).")
+    p.add_argument("paths", nargs="*",
+                   help="pvar dump files or directories (TPU_MPI_PVARS_DUMP "
+                        "output); pass '-- <launch args>' to run a launch "
+                        "with dumping enabled and report it")
+    p.add_argument("--json", default=None,
+                   help="also write the merged machine-readable record "
+                        "('-' for stdout)")
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" in argv:
+        cut = argv.index("--")
+        argv, launch = argv[:cut], argv[cut + 1:]
+    else:
+        launch = None
+    args = p.parse_args(argv)
+    if launch:
+        records = _launch_and_collect(launch)
+    elif args.paths:
+        records = perfvars.load_dumps(args.paths)
+    else:
+        p.error("give pvar dump paths, or '-- <launch args>' to run one")
+    agg = aggregate(records)
+    render(agg)
+    if args.json:
+        rec = {"schema": 1, "kind": "tpu_mpi-stats",
+               "sources": [r.get("_path", "?") for r in records],
+               "colls": [{"coll": c, "algo": a, "nbytes": b, "count": v[0],
+                          "total_s": v[1], "min_s": v[2], "max_s": v[3]}
+                         for (c, a, b), v in sorted(agg["colls"].items())],
+               "hist": agg["hist"], "phase_s": agg["phase_s"],
+               "totals": agg["totals"], "rma": agg["rma"],
+               "plan_cache": agg["plan_cache"], "pipeline": agg["pipeline"],
+               "overlap_fraction": agg["overlap_fraction"],
+               "nranks": agg["nranks"]}
+        if args.json == "-":
+            json.dump(rec, sys.stdout, indent=1)
+            sys.stdout.write("\n")
+        else:
+            with open(args.json, "w") as f:
+                json.dump(rec, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

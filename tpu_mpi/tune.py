@@ -532,6 +532,51 @@ def _crossovers(rows: List[dict]) -> Dict[Tuple[str, int],
     return best
 
 
+def rows_from_pvars(records: Sequence[dict]) -> List[dict]:
+    """Measured rows (the autotune sweep's row schema) from pvar dump
+    records (``perfvars.snapshot``): mean latency per (collective, world
+    size, payload bytes, algorithm) aggregated across ranks and comms. The
+    production workload's own counters become tuning input — the table is
+    fed by the same measurements it will later be judged against."""
+    acc: Dict[Tuple[str, int, int, str], List[float]] = {}
+    for rec in records:
+        for comm in rec.get("comms", ()):
+            n = int(comm.get("size") or 0)
+            if n < 2:
+                continue
+            for t in comm.get("times", ()):
+                nbytes = int(t["nbytes"])
+                key = (t["coll"], n, max(0, nbytes), t["algo"])
+                ent = acc.setdefault(key, [0.0, 0.0])
+                ent[0] += t["count"]
+                ent[1] += t["total_s"]
+    return [{"coll": c, "nranks": n, "bytes": b, "algo": a,
+             "lat_us": round(tot / cnt * 1e6, 3)}
+            for (c, n, b, a), (cnt, tot) in sorted(acc.items()) if cnt]
+
+
+def table_from_pvars(paths: Sequence[str],
+                     out_table: Optional[str] = None) -> dict:
+    """Crossover table from pvar dumps: load, reduce to rows, argmin per
+    measured point (``_crossovers``), optionally persist. A point measured
+    under only ONE algorithm still pins that algorithm as its threshold
+    entry — production counters rarely cover the full portfolio, so this
+    table refines, not replaces, a sweep-built one."""
+    from . import perfvars
+    records = perfvars.load_dumps(paths)
+    rows = rows_from_pvars(records)
+    table = _crossovers(rows)
+    rec = {"bench": "coll_algos_from_pvars", "rows": rows,
+           "table": {f"{c}.n{n}": {str(th): algo for th, algo in ent}
+                     for (c, n), ent in table.items()},
+           "sources": [r["_path"] for r in records]}
+    if out_table:
+        write_table(out_table, table,
+                    header=f"from pvar dumps: {len(records)} ranks")
+        rec["table_path"] = os.path.expanduser(out_table)
+    return rec
+
+
 def autotune(nranks_list: Sequence[int] = (2, 4, 8),
              sizes: Sequence[int] = LADDER,
              colls: Sequence[str] = SWEEP_COLLS,
@@ -633,7 +678,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "or ~/.config/tpu_mpi/tune.toml)")
     p.add_argument("--json", default=None,
                    help="also write the full sweep record as JSON")
+    p.add_argument("--from-pvars", nargs="+", default=None, metavar="PATH",
+                   help="build the table from pvar dump files/dirs "
+                        "(TPU_MPI_PVARS_DUMP output) instead of sweeping")
     args = p.parse_args(argv)
+
+    if args.from_pvars:
+        out_table = (args.out or config.load().tune_table
+                     or os.path.join("~", ".config", "tpu_mpi", "tune.toml"))
+        rec = table_from_pvars(args.from_pvars, out_table=out_table)
+        if args.json:
+            with open(os.path.expanduser(args.json), "w") as f:
+                json.dump(rec, f, indent=1)
+        print(f"tune: wrote {rec['table_path']} from {len(rec['sources'])} "
+              f"pvar dumps ({len(rec['rows'])} measured points)")
+        for (sect, ladder) in sorted(rec["table"].items()):
+            print(f"  [{sect}] " + "  ".join(
+                f"{th}B->{algo}" for th, algo in sorted(
+                    ladder.items(), key=lambda kv: int(kv[0]))))
+        return 0
 
     nranks = [int(x) for x in args.nranks.split(",") if x]
     sizes = ([int(x) for x in args.sizes.split(",") if x]
