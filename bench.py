@@ -149,12 +149,18 @@ def _fold_ceiling_fields(n_elems: int, nranks: int = 4,
     ig = ingraph_collective_slope("allreduce", n_elems, nranks, rtt=rtt)
     igf = ingraph_collective_slope("allreduce_fused", n_elems, nranks,
                                    rtt=rtt)
+    igd = ingraph_collective_slope("allreduce_donated", n_elems, nranks,
+                                   rtt=rtt)
     cc = ceiling_control_slope(n_elems, nranks, rtt=rtt)
-    head = igf if (igf.get("fused")
-                   and igf["algbw_gbps"] >= ig["algbw_gbps"]) else ig
+    # every candidate keeps MPI fold semantics (rank-ordered left fold):
+    # the fused Pallas kernel where it actually ran, and the donated AOT
+    # executable the registered host lane shares (ISSUE-6)
+    cands = [ig, igd] + ([igf] if igf.get("fused") else [])
+    head = max(cands, key=lambda r: r["algbw_gbps"])
     return {
         "ingraph": ig,
         "ingraph_fused": igf,
+        "ingraph_donated": igd,
         "ceiling_control": cc,
         "headline_fold": head["variant"],
         "fold_algbw_gbps": head["algbw_gbps"],

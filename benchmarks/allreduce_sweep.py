@@ -6,6 +6,11 @@ hardware allows:
 - ``host``   — the framework's host-path ``MPI.Allreduce`` over rank threads
   (jitted fold + zero-copy DeviceBuffer rebind); runs everywhere, measures
   the deployment path a single-host user hits.
+- ``host_persistent`` — the registered-buffer fast path (ISSUE-6): one
+  ``Allreduce_init`` per size outside the timed loop, then ``Start``/``Wait``
+  rounds against the plan-pinned wire buffers. Both host lanes also emit a
+  ``pvars_phase`` block (rendezvous/fold/copy seconds + rendezvous share)
+  at the largest swept size.
 - ``ingraph`` — the weather-immune lane (VERDICT r4 next #1): K-chained
   in-jit Allreduce folds (+ the fused-kernel ``allreduce_fused`` variant
   and reducescatter/allgather, all on the same size ladder), adaptive-slope
@@ -45,22 +50,88 @@ from common import best_block, detect_platform, emit, iters_for, size_sweep
 REPEATS = 3
 
 
-def bench_host(nranks: int, sizes: list[int], use_device: bool) -> list[dict]:
+def bench_host(nranks: int, sizes: list[int], use_device: bool,
+               persistent: bool = False) -> list[dict]:
     # chained honest-execution protocol shared with bench.py — see
-    # common.host_allreduce_times (VERDICT r2 weak #1)
+    # common.host_allreduce_times (VERDICT r2 weak #1). persistent=True is
+    # the registered-buffer lane (ISSUE-6): one Allreduce_init outside the
+    # timed loop, Start/Wait per op against the plan-pinned buffers.
     from common import host_allreduce_times
 
+    tag = "hostP" if persistent else "host"
     rows = []
     for nbytes in sizes:
         n = max(1, nbytes // 4)
         warmup, iters = iters_for(nbytes)
         dt = best_block(host_allreduce_times(n, nranks, use_device,
-                                             warmup, iters, REPEATS))
+                                             warmup, iters, REPEATS,
+                                             persistent=persistent))
         rows.append({"bytes": n * 4, "lat_us": round(dt * 1e6, 2),
                      "algbw_gbps": round(n * 4 / dt / 1e9, 3)})
-        print(f"host  {n * 4:>11d} B  {dt * 1e6:>10.1f} us  "
+        print(f"{tag:<5} {n * 4:>11d} B  {dt * 1e6:>10.1f} us  "
               f"{rows[-1]['algbw_gbps']:>8.3f} GB/s", file=sys.stderr)
     return rows
+
+
+def host_phase_breakdown(nranks: int, n_elems: int,
+                         rounds: int = 50) -> dict:
+    """Per-phase pvar evidence for the host lanes (ISSUE-6 satellite 1):
+    run ``rounds`` generic Allreduce calls and ``rounds`` persistent
+    Start/Wait rounds back-to-back under one SPMD session with pvars on,
+    snapshotting rank 0's rendezvous/fold/copy phase seconds after each.
+    The persistent lane's rendezvous share collapsing toward zero is the
+    registered-buffer fast path's direct signature."""
+    import numpy as np
+    import tpu_mpi as MPI
+    from tpu_mpi import spmd_run
+
+    os.environ["TPU_MPI_PVARS"] = "1"
+    from tpu_mpi import config as _cfg
+    _cfg.load(refresh=True)
+
+    def body():
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        buf = np.ones(n_elems, np.float32)
+        out = np.zeros(n_elems, np.float32)
+        MPI.Allreduce(buf, out, MPI.SUM, comm)      # warm plan caches
+        # barriers fence each measured window so one rank's section change
+        # cannot bleed into a sibling's still-open spans (GIL time-sharing)
+        MPI.Barrier(comm)
+        comm.get_pvars(reset=True)
+        for _ in range(rounds):
+            MPI.Allreduce(buf, out, MPI.SUM, comm)
+        generic = comm.get_pvars(reset=True)
+        req = MPI.Allreduce_init(buf, out, MPI.SUM, comm)
+        MPI.Start(req)
+        MPI.Wait(req)                               # warm registered round
+        MPI.Barrier(comm)
+        comm.get_pvars(reset=True)
+        for _ in range(rounds):
+            MPI.Start(req)
+            MPI.Wait(req)
+        pers = comm.get_pvars(reset=True)
+        MPI.Finalize()
+
+        def lane(s):
+            ph = {k: round(v, 6) for k, v in s["phase_s"].items()}
+            tot = sum(ph.values())
+            return {"rounds": rounds, "phase_s": ph,
+                    "wait_s": round(s["wait_s"], 6),
+                    "rendezvous_share": round(
+                        ph.get("rendezvous", 0.0) / tot, 4) if tot else None}
+        return {"host": lane(generic), "host_persistent": lane(pers)}
+
+    res = spmd_run(body, nranks)
+    out = res[0]
+    out["bytes"] = n_elems * 4
+    print(f"pvars host        rendezvous_share="
+          f"{out['host']['rendezvous_share']} "
+          f"phase_s={out['host']['phase_s']}", file=sys.stderr)
+    print(f"pvars host_persistent rendezvous_share="
+          f"{out['host_persistent']['rendezvous_share']} "
+          f"phase_s={out['host_persistent']['phase_s']}", file=sys.stderr)
+    return out
 
 
 def _bench_in_graph(sizes: list[int], fn_of_mesh, max_iters: int = 10 ** 9,
@@ -283,7 +354,8 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-bytes", type=int, default=1 << 30)
     ap.add_argument("--ranks", type=int, default=4)
-    ap.add_argument("--lanes", default="host,ingraph,psum,pallas")
+    ap.add_argument("--lanes",
+                    default="host,host_persistent,ingraph,psum,pallas")
     ap.add_argument("--rows-out", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--algos", action="store_true",
                     help="per-algorithm procs lanes (procs_star, procs_shm, "
@@ -301,9 +373,22 @@ def main() -> None:
     record: dict = {"benchmark": "allreduce_sweep", "platform": plat,
                     "ranks": args.ranks, "lanes": {}}
     multi = plat["devices"] >= 2
-    if "host" in lanes:
+    if "host" in lanes or "host_persistent" in lanes:
         use_device = plat["platform"] != "cpu"
-        record["lanes"]["host"] = bench_host(args.ranks, sizes, use_device)
+        if "host" in lanes:
+            record["lanes"]["host"] = bench_host(args.ranks, sizes,
+                                                 use_device)
+        if "host_persistent" in lanes:
+            record["lanes"]["host_persistent"] = bench_host(
+                args.ranks, sizes, use_device, persistent=True)
+        # per-phase pvar evidence at the largest swept size: the persistent
+        # lane's rendezvous share collapsing is the fast path's signature
+        try:
+            record["pvars_phase"] = host_phase_breakdown(
+                args.ranks, max(1, sizes[-1] // 4))
+        except Exception as e:
+            print(f"pvar phase breakdown skipped: {type(e).__name__}: {e}",
+                  file=sys.stderr)
     if "ingraph" in lanes:
         # sampled sizes: the adaptive slope spends ~0.5-2 s per (size,
         # variant); every 2nd size + the endpoints covers the curve. All
@@ -312,6 +397,7 @@ def main() -> None:
         sub = sizes[::2] + ([sizes[-1]] if (len(sizes) - 1) % 2 else [])
         ig = bench_ingraph(args.ranks, sub,
                            variants=("allreduce", "allreduce_fused",
+                                     "allreduce_donated",
                                      "reducescatter", "allgather"))
         record["lanes"]["ingraph"] = ig.pop("allreduce", [])
         for variant, rows in ig.items():

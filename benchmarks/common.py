@@ -33,8 +33,8 @@ def iters_for(nbytes: int) -> tuple[int, int]:
 
 
 def host_allreduce_times(n_elems: int, nranks: int, use_device: bool,
-                         warmup: int, iters: int,
-                         repeats: int) -> list[list[float]]:
+                         warmup: int, iters: int, repeats: int,
+                         persistent: bool = False) -> list[list[float]]:
     """Honest-execution host-path Allreduce timing, shared by ``bench.py``
     and ``allreduce_sweep.py`` (VERDICT r2 weak #1: the round-2 protocol
     measured async dispatch and reported >HBM-peak bandwidth).
@@ -56,6 +56,12 @@ def host_allreduce_times(n_elems: int, nranks: int, use_device: bool,
     Returns times[rank][repeat]; only rank 0's blocks include the forcing
     readback, so aggregate with :func:`best_block` (max-per-repeat keys on
     rank 0).
+
+    ``persistent=True`` is the registered-buffer lane (ISSUE-6,
+    docs/performance.md "Registered buffers"): the plan is created ONCE via
+    ``Allreduce_init`` outside the timed loop, and each timed op is one
+    Start/Wait round against the plan-pinned buffers — the lane that kills
+    the per-call parse/plan/worker dispatch overhead.
     """
     import numpy as np
     import tpu_mpi as MPI
@@ -72,10 +78,8 @@ def host_allreduce_times(n_elems: int, nranks: int, use_device: bool,
             buf = DeviceBuffer(jnp.ones(n_elems, jnp.float32))
             out = DeviceBuffer(jnp.zeros(n_elems, jnp.float32))
 
-            def step():
-                MPI.Allreduce(buf, out, MPI.SUM, comm)
-                if rank == 0:
-                    buf.value = out.value    # host-side rebind: the chain
+            def rebind():
+                buf.value = out.value        # host-side rebind: the chain
 
             def readback():
                 return float(out.value[0])
@@ -83,13 +87,26 @@ def host_allreduce_times(n_elems: int, nranks: int, use_device: bool,
             buf = np.ones(n_elems, np.float32)
             out = np.zeros(n_elems, np.float32)
 
-            def step():
-                MPI.Allreduce(buf, out, MPI.SUM, comm)
-                if rank == 0:
-                    np.copyto(buf, out)      # same chain, host arrays
+            def rebind():
+                np.copyto(buf, out)          # same chain, host arrays
 
             def readback():
                 return float(out[0])
+
+        if persistent:
+            req = MPI.Allreduce_init(buf, out, MPI.SUM, comm)
+
+            def coll():
+                MPI.Start(req)
+                MPI.Wait(req)
+        else:
+            def coll():
+                MPI.Allreduce(buf, out, MPI.SUM, comm)
+
+        def step():
+            coll()
+            if rank == 0:
+                rebind()
 
         def force():
             got, want = readback(), float(1 + ops * (nranks - 1))
@@ -257,6 +274,8 @@ _TRAFFIC_MODELS = {
     "allreduce": "(n+1)*bytes: n operand-stream reads + 1 result write",
     "allreduce_fused": "(n+1)*bytes: n streams read once in a single fused "
                        "pass + 1 result write",
+    "allreduce_donated": "(n+1)*bytes: n operand-stream reads + 1 result "
+                         "write aliased into the donated accumulator",
     "reducescatter": "(n+1)/n*bytes: n shard-slice reads + 1 shard write",
     "allgather": "2*shard*n bytes: shard read + full concat write",
     "ceiling_control": "(n+1)*bytes: same streams, best schedule, no MPI "
@@ -282,6 +301,15 @@ def ingraph_collective_slope(variant: str, n_elems: int, nranks: int,
       single-pass Pallas ``fused_multi_reduce`` kernel on TPU (the ISSUE-1
       tentpole); off-TPU it runs the chained fallback and the row records
       ``fused: false`` (the path the CPU-sim CI smoke checks);
+    - ``allreduce_donated`` — the registered host lane's fold compilation
+      (ISSUE-6): ONE AOT executable with ``donate_argnums`` on the
+      accumulator, called K times from the host with each result chained
+      back in as the next donated acc — the in-graph twin of the
+      ``PlanRegistration`` per-round fold. Donation lets XLA alias the
+      result into the consumed acc buffer (honored on TPU; the CPU backend
+      treats donation as advisory). Unlike the fori_loop variants, per-fold
+      executable dispatch is PART of this measurement — that is the cost
+      the registered lane actually pays per persistent round;
     - ``reducescatter``   — this chip computes rank 0's shard: nranks
       shard-slice reads + one shard write ((nranks+1)/nranks * payload);
     - ``allgather``       — shard in, full concat out (~2x payload).
@@ -304,7 +332,7 @@ def ingraph_collective_slope(variant: str, n_elems: int, nranks: int,
     nbytes = n_elems * 4
     fallback_fold = None                  # set for variants with two impls
     fused_used = False
-    if variant in ("allreduce", "allreduce_fused"):
+    if variant in ("allreduce", "allreduce_fused", "allreduce_donated"):
         peer_elems, acc_elems = n_elems, n_elems
         traffic = (nranks + 1) * nbytes
 
@@ -368,13 +396,45 @@ def ingraph_collective_slope(variant: str, n_elems: int, nranks: int,
     f = _make(one_fold)
     x0 = jnp.ones(acc_elems, jnp.float32)
 
-    def call(k):
-        y = f(x0, k, *peers)
-        got = float(y[0])                 # forces completion thru the tunnel
-        want = expect_of(k)
-        assert got == want, (
-            f"in-graph {variant} chain readback {got} != {want} "
-            f"— the timed folds did not execute correctly")
+    if variant == "allreduce_donated":
+        # One AOT executable per fold, accumulator donated — the exact
+        # compilation collective._registered_device_fold runs per
+        # persistent round. The k folds chain through the donated buffer
+        # at the Python level; per-call(k) constants (operand alloc,
+        # readback) still cancel in the slope, per-FOLD dispatch does not
+        # — by design, it is the registered lane's real per-round cost.
+        import warnings
+
+        def dfold(acc, jf, *ps):
+            a = acc
+            for o in ps:
+                a = opfn(a, o + jf)
+            return a
+
+        jfs = (jnp.asarray(0.0, jnp.float32), jnp.asarray(1.0, jnp.float32))
+        with warnings.catch_warnings():
+            # CPU backend: "some donated buffers were not usable" — there
+            # donation is advisory and the row measures dispatch alone
+            warnings.simplefilter("ignore")
+            fc = (jax.jit(dfold, donate_argnums=(0,))
+                  .lower(x0, jfs[0], *peers).compile())
+
+        def call(k):
+            acc = jnp.ones(acc_elems, jnp.float32)   # donated away per fold
+            for j in range(k):
+                acc = fc(acc, jfs[j % 2], *peers)
+            got, want = float(acc[0]), expect_of(k)
+            assert got == want, (
+                f"in-graph {variant} chain readback {got} != {want} "
+                f"— the timed folds did not execute correctly")
+    else:
+        def call(k):
+            y = f(x0, k, *peers)
+            got = float(y[0])             # forces completion thru the tunnel
+            want = expect_of(k)
+            assert got == want, (
+                f"in-graph {variant} chain readback {got} != {want} "
+                f"— the timed folds did not execute correctly")
 
     def time_of(k):
         return best_of_calls(call, k, repeats)
@@ -392,7 +452,8 @@ def ingraph_collective_slope(variant: str, n_elems: int, nranks: int,
     # keep the closed-form chain value float32-EXACT at the largest k the
     # slope can evaluate (2*k_cap): 1 + (nranks-1)*(2k + k) must stay under
     # 2^24, or the readback assert fires spuriously at high rank counts
-    if variant in ("allreduce", "allreduce_fused", "reducescatter"):
+    if variant in ("allreduce", "allreduce_fused", "allreduce_donated",
+                   "reducescatter"):
         k_cap = min(k_cap, ((1 << 24) - 2) // (3 * max(1, nranks - 1)))
     sl = adaptive_slope(time_of, rtt, k_cap=k_cap)
     per_fold = sl["per_step_s"]
@@ -422,6 +483,8 @@ def ingraph_collective_slope(variant: str, n_elems: int, nranks: int,
     }
     if variant == "allreduce_fused":
         out["fused"] = fused_used
+    if variant == "allreduce_donated":
+        out["donated"] = True
     return out
 
 

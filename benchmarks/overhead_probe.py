@@ -214,6 +214,43 @@ def _pvars_case(pvars_on: bool, pp_iters: int = 2000,
                 assert comm.get_pvars()["ops"]
 
     run_spmd(allreduce, 4)
+    perfvars.reset()     # isolate the persistent lane's wait_s evidence
+
+    def persistent():
+        # registered fast path (ISSUE-6): plan bound once, Start/Wait per
+        # round. The snapshot must show wait_s == 0 — the round's wall
+        # clock is owned by its op scope, and the outermost-owner rule
+        # keeps the inner Wait from double-counting it (the bug this
+        # probe's earlier revision had).
+        comm = MPI.COMM_WORLD
+        x = np.ones(1024, dtype=np.float64)
+        y = np.empty_like(x)
+        req = MPI.Allreduce_init(x, y, MPI.SUM, comm)
+        for _ in range(20):
+            MPI.Start(req)
+            MPI.Wait(req)
+        best = float("inf")
+        for _ in range(repeats):
+            MPI.Barrier(comm)
+            t0 = time.perf_counter()
+            for _ in range(ar_iters):
+                MPI.Start(req)
+                MPI.Wait(req)
+            best = min(best, (time.perf_counter() - t0) / ar_iters)
+        if comm.rank() == 0:
+            out["allreduce_persistent_us"] = round(best * 1e6, 3)
+            if pvars_on:
+                s = comm.get_pvars()
+                rounds = sum(v for k, v in s["ops"].items()
+                             if k.startswith("allreduce"))
+                assert rounds > 0, s["ops"]
+                assert s["wait_s"] == 0.0, s["wait_s"]   # no double count
+                out["persistent_rounds"] = rounds
+                out["persistent_wait_s"] = s["wait_s"]
+                out["persistent_phase_s"] = {
+                    k: round(v, 6) for k, v in s["phase_s"].items()}
+
+    run_spmd(persistent, 4)
     perfvars.reset()
     return out
 

@@ -346,3 +346,175 @@ def test_poison_fill_per_dtype():
 # mid-epoch, real value after unlock) lives on the multi-process tier's
 # 1-RTT read epochs — covered in test_procs.py
 # (test_strict_poison_on_batched_get_across_processes).
+
+
+# ---------------------------------------------------------------------------
+# Registered-buffer fast path (ISSUE-6 tentpole): persistent Allreduce rounds
+# run inline against plan-pinned wire views and fold scratch — bitwise equal
+# to the generic star, donation-safe, id-stable, generation-aware.
+
+_REG_DTYPES = (np.float32, np.float64, np.int32, np.int64, np.complex128)
+_REG_COUNTS = (1, 7, 1000, 4097)   # incl. odd / non-chunk-dividing counts
+
+
+def test_registered_allreduce_bitwise_equals_generic_star(nprocs):
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        rng = np.random.default_rng(7 + rank)
+        for dt in _REG_DTYPES:
+            for count in _REG_COUNTS:
+                if np.issubdtype(dt, np.complexfloating):
+                    send = (rng.random(count)
+                            + 1j * rng.random(count)).astype(dt)
+                elif np.issubdtype(dt, np.floating):
+                    send = rng.random(count).astype(dt)
+                else:
+                    send = rng.integers(-999, 999, count).astype(dt)
+                recv = np.zeros(count, dt)
+                req = MPI.Allreduce_init(send, recv, MPI.SUM, comm)
+                assert req.registration is not None, (dt, count)
+                MPI.Start(req)
+                assert req._fast_armed, (dt, count)
+                MPI.Wait(req)
+                ref = MPI.Allreduce(send, MPI.SUM, comm)
+                assert recv.tobytes() == np.asarray(ref).tobytes(), (dt, count)
+
+    run_spmd(body, nprocs)
+
+
+def test_registered_rounds_leave_user_send_buffer_alone(nprocs):
+    """Donation safety: without the IN_PLACE opt-in, persistent rounds must
+    never mutate (host lane) or donate away (device lane) the user's send
+    buffer."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        orig = np.arange(64, dtype=np.float64) + rank
+        send = orig.copy()
+        recv = np.zeros(64)
+        req = MPI.Allreduce_init(send, recv, MPI.SUM, comm)
+        for _ in range(3):
+            MPI.Start(req)
+            MPI.Wait(req)
+            assert np.array_equal(send, orig)
+        # device lane: the donated fold consumes only plan-private ring
+        # slots — the user's array must stay readable (a donated jax array
+        # would raise on access)
+        import jax.numpy as jnp
+        dsend = jnp.asarray(orig)
+        dreq = MPI.Allreduce_init(dsend, MPI.SUM, comm)
+        assert dreq.registration is not None
+        for _ in range(3):
+            MPI.Start(dreq)
+            MPI.Wait(dreq)
+            assert np.array_equal(np.asarray(dsend), orig)
+
+    run_spmd(body, nprocs)
+
+
+def test_registered_buffers_id_stable_across_rounds(nprocs):
+    def body():
+        from tpu_mpi.buffers import is_registered
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        send = np.full(256, float(rank + 1))
+        recv = np.zeros(256)
+        req = MPI.Allreduce_init(send, recv, MPI.SUM, comm)
+        reg = req.registration
+        assert reg is not None and reg.scratch
+        assert all(is_registered(s) for s in reg.scratch)
+        # the wire view is pinned straight over the user's send buffer
+        assert reg.wire is send or reg.wire.base is send
+        ids = tuple(id(s) for s in reg.scratch)
+        for _ in range(4):
+            MPI.Start(req)
+            MPI.Wait(req)
+            assert req.registration is reg               # no rebuild
+            assert tuple(id(s) for s in reg.scratch) == ids
+            assert aeq(recv, np.full(256, sum(range(1, size + 1))))
+        # the allocating flavor returns the SAME pinned result array every
+        # round (persistent in-place result semantics)
+        areq = MPI.Allreduce_init(send, MPI.SUM, comm)
+        MPI.Start(areq)
+        MPI.Wait(areq)
+        first = areq.result
+        MPI.Start(areq)
+        MPI.Wait(areq)
+        assert areq.result is first
+
+    run_spmd(body, nprocs)
+
+
+def test_registered_rebind_on_config_generation(nprocs):
+    """A config reload (generation bump) must rebuild the registration; the
+    TPU_MPI_REGISTERED_BUFFERS=0 knob must drop rounds to the legacy worker
+    lane — correct either way."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        send = np.full(512, float(rank + 1))
+        recv = np.zeros(512)
+        expect = np.full(512, sum(range(1, size + 1)))
+        req = MPI.Allreduce_init(send, recv, MPI.SUM, comm)
+        reg0 = req.registration
+        assert reg0 is not None
+        MPI.Start(req)
+        MPI.Wait(req)
+        assert aeq(recv, expect)
+        MPI.Barrier(comm)
+        if rank == 0:
+            os.environ["TPU_MPI_REGISTERED_BUFFERS"] = "0"
+        MPI.Barrier(comm)
+        config.load(refresh=True)
+        recv[:] = 0.0
+        MPI.Start(req)
+        assert not req._fast_armed       # knob off: legacy worker lane
+        MPI.Wait(req)
+        assert aeq(recv, expect)
+        assert req.registration is not reg0          # factory re-ran
+        MPI.Barrier(comm)
+        if rank == 0:
+            os.environ.pop("TPU_MPI_REGISTERED_BUFFERS", None)
+        MPI.Barrier(comm)
+        config.load(refresh=True)
+        recv[:] = 0.0
+        MPI.Start(req)
+        assert req._fast_armed           # re-armed with fresh pinned buffers
+        MPI.Wait(req)
+        assert aeq(recv, expect)
+        assert req.registration.scratch and not req.registration.released
+
+    try:
+        run_spmd(body, nprocs)
+    finally:
+        os.environ.pop("TPU_MPI_REGISTERED_BUFFERS", None)
+        config.load(refresh=True)
+
+
+def test_comm_free_releases_registered_buffers(nprocs):
+    """ISSUE-6 satellite: Comm.free drops plan-registered wire buffers (and
+    any shm slot lease); the strict-mode refcount assert sees zero."""
+    def body():
+        from tpu_mpi.overlap import registry
+        comm = MPI.COMM_WORLD
+        sub = MPI.Comm_dup(comm)
+        cid = sub.cid
+        send = np.ones(64)
+        recv = np.zeros(64)
+        req = MPI.Allreduce_init(send, recv, MPI.SUM, sub)
+        reg = req.registration
+        assert reg is not None and not reg.released
+        MPI.Start(req)
+        MPI.Wait(req)
+        sub.free()                       # strict mode: asserts leased == 0
+        assert reg.released and not reg.scratch and reg.wire is None
+        assert registry.leased(cid) == 0
+
+    os.environ["TPU_MPI_STRICT"] = "1"
+    config.load(refresh=True)
+    try:
+        run_spmd(body, nprocs)
+    finally:
+        os.environ.pop("TPU_MPI_STRICT", None)
+        config.load(refresh=True)

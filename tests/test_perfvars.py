@@ -417,3 +417,43 @@ def test_profile_trace_exception_safe(monkeypatch, tmp_path):
     t.start()
     t.join()
     assert len(prof.starts) == 1 and prof.stops == 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-6 satellite: persistent-round Wait must not double-count. The round's
+# wall clock is already fully accounted by the op scope its executor owns
+# (phase_s + times), so PersistentCollRequest claims wait ownership and adds
+# NO wait_ns — on the registered fast path AND the legacy worker lane. The
+# one-shot Iallreduce+Wait is unowned and keeps its wait_ns.
+
+@pytest.mark.parametrize("registered", ["1", "0"])
+def test_persistent_wait_not_double_counted(nprocs, monkeypatch, registered):
+    monkeypatch.setenv("TPU_MPI_REGISTERED_BUFFERS", registered)
+    config.load(refresh=True)
+    snaps = {}
+
+    def body():
+        comm = MPI.COMM_WORLD
+        r = comm.rank()
+        x = np.arange(4096, dtype=np.float64) + r
+        out = np.empty_like(x)
+        req = MPI.Allreduce_init(x, out, MPI.SUM, comm)
+        for _ in range(5):
+            MPI.Start(req)
+            MPI.Wait(req)
+        pers = comm.get_pvars(reset=True)
+        ireq = MPI.Iallreduce(x, MPI.SUM, comm)
+        MPI.Wait(ireq)
+        snaps[r] = (pers, comm.get_pvars())
+
+    run_spmd(body, nprocs)
+    config.load(refresh=True)
+    assert sorted(snaps) == list(range(nprocs))
+    for r, (pers, oneshot) in snaps.items():
+        # all five rounds counted, with their phases, but zero wait_s
+        assert pers["ops"].get("allreduce|star|float64") == 5, (r, pers["ops"])
+        (t,) = [t for t in pers["times"] if t["coll"] == "allreduce"]
+        assert t["count"] == 5
+        assert pers["wait_s"] == 0.0, (r, registered, pers["wait_s"])
+        assert oneshot["wait_s"] > 0.0, (r, registered, oneshot["wait_s"])
+    assert sum(s["phase_s"]["rendezvous"] for s, _ in snaps.values()) > 0

@@ -525,11 +525,21 @@ class CollectiveChannel(_Waitable):
         return st
 
     def run(self, rank: int, contrib: Any, combine: Callable[[list[Any]], Sequence[Any]],
-            opname: str, plan=None) -> Any:
+            opname: str, plan=None, unlocked_fold: bool = False) -> Any:
         # ``plan`` (an algorithm hint for the multi-process tier) is ignored
         # here: threads share an address space, so the combine-in-place star
         # IS the optimal algorithm — data placement is a pointer exchange.
-        with self.cond:
+        #
+        # ``unlocked_fold`` (registered fast path): the last arriver runs the
+        # combine with the channel lock RELEASED. Safe exactly then: the
+        # combine folds into a plan-private registered scratch (no shared
+        # rendezvous state touched), all peer ranks of THIS round are parked
+        # in cond.wait, and no rank can arrive in round k+1 before picking
+        # round k — so nothing else can mutate the round slot while the lock
+        # is down, and waiters, P2P progress and other communicators never
+        # contend with a long fold for the condvar.
+        self.cond.acquire()
+        try:
             rnd = self.rank_round[rank]
             self.rank_round[rank] += 1
             st = self._round_state(rnd)
@@ -548,9 +558,17 @@ class CollectiveChannel(_Waitable):
             # no scope is open (pvars and tracing both off).
             sc = _pv.scope()
             if st["arrived"] == self.size:
+                contribs = list(st["contribs"])
                 t0 = _pv.monotonic() if sc is not None else 0.0
                 try:
-                    results = list(combine(list(st["contribs"])))
+                    if unlocked_fold:
+                        self.cond.release()
+                        try:
+                            results = list(combine(contribs))
+                        finally:
+                            self.cond.acquire()
+                    else:
+                        results = list(combine(contribs))
                 except BaseException as e:
                     self.ctx.fail(e)
                     raise
@@ -576,6 +594,8 @@ class CollectiveChannel(_Waitable):
             if st["picked"] == self.size:
                 self.rounds.pop(rnd, None)   # fully drained; no reset barrier
             return res
+        finally:
+            self.cond.release()
 
 
 class SpmdContext:

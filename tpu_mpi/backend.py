@@ -490,6 +490,11 @@ class _ShmColl:
         finally:
             os.close(fd)
         self.unlinked = False
+        # registered-plan slot leases (overlap.PlanRegistration.shm_release):
+        # a persistent Allreduce pre-maps the segment at plan creation and
+        # holds a lease until released; Comm.free asserts (strict mode) that
+        # every lease was dropped before the mapping may be torn down
+        self.leases = 0
 
     def _hdr(self, slot: int) -> int:
         return slot * self.SLOT
@@ -1067,6 +1072,44 @@ class ProcChannel(_Waitable):
                            (claimed_root,
                             {u: blocks[u] for u in range(c, end)}))
         return blocks[v]
+
+    def shm_bind(self, nbytes: int) -> Optional[Callable[[], None]]:
+        """Pre-map the same-host shm collective segment for a registered
+        plan (tpu_mpi.collective._register_allreduce) and take a slot
+        lease, so the first Start pays neither the eligibility walk nor
+        the lazy mmap. Returns the release callback the registration hands
+        to ``Comm.free``, or None when the tier is not eligible (not
+        same-host, payload exceeds the mapped slot size) — the plan then
+        simply runs without a segment lease."""
+        ok = getattr(self.ctx, "coll_shm_ok", None)
+        if ok is None or not self.group or not ok(self.group):
+            return None
+        try:
+            sc = self._shm_coll()
+        except MPIError:
+            return None             # plan creation must not fate-share
+        if nbytes > sc.cap:
+            return None
+        sc.leases += 1
+
+        def release() -> None:
+            sc.leases = max(0, sc.leases - 1)
+        return release
+
+    def drop_shm(self) -> None:
+        """Tear down the mapped segment once every registered-plan lease is
+        gone (``Comm.free``): unlink the name and close the mapping. A
+        BufferError (a live numpy view still pins the map) keeps the
+        mapping — the view owner drops it with the comm object."""
+        sc = self._shm
+        if sc is None or sc.leases > 0:
+            return
+        self._shm = None
+        sc.maybe_unlink()
+        try:
+            sc.mm.close()
+        except BufferError:
+            self._shm = sc          # a slot view is still alive; keep it
 
     def _shm_coll(self) -> _ShmColl:
         if self._shm is None:

@@ -352,6 +352,46 @@ def wire_view(x: Any, count: Optional[int] = None) -> Any:
     return flat
 
 
+# Registered (pinned) host scratch arrays, minted by register_scratch() for
+# the persistent-collective fast path (docs/performance.md "Registered
+# buffers"): private to the runtime, never aliased by user data, so folds
+# may mutate them in place round after round with zero steady-state
+# allocation. Same id-keyed weak marking scheme as _wire_snapshots.
+_registered: "weakref.WeakValueDictionary[int, np.ndarray]" = \
+    weakref.WeakValueDictionary()
+
+
+def register_scratch(count: int, dtype: Any) -> np.ndarray:
+    """A pinned, runtime-private flat host array for a plan-bound fold
+    accumulator. Registered buffers are allocated once at plan creation
+    (``Allreduce_init``) and reused by every round — the zero-alloc
+    contract the registered fast path is built on."""
+    arr = np.empty(int(count), dtype=np.dtype(dtype))
+    _registered[id(arr)] = arr
+    return arr
+
+
+def is_registered(arr: Any) -> bool:
+    """True iff ``arr`` is a runtime-private registered scratch buffer
+    (safe to fold into in place; no user alias can exist)."""
+    return _registered.get(id(arr)) is arr
+
+
+def pinned_wire_view(x: Any, count: int) -> Optional[np.ndarray]:
+    """A STABLE flat view of a host send operand, bindable once at plan
+    creation: later rounds reuse the view with no per-call normalization.
+    Returns None when the operand cannot be pre-bound — non-ndarray kinds
+    (DeviceBuffer rebinds its array every round; jax arrays are replaced,
+    not mutated), non-contiguous views (wire_view would copy), or object
+    dtype. The caller falls back to per-call :func:`wire_view`."""
+    if not isinstance(x, np.ndarray) or x.dtype == object:
+        return None
+    if not x.flags.c_contiguous:
+        return None
+    flat = x.reshape(-1)
+    return flat if flat.size == count else flat[:count]
+
+
 _POISON_BYTE = 0xA5
 
 

@@ -46,7 +46,8 @@ from .analyze import events as _ev
 from .error import CollectiveMismatchError, MPIError
 from .operators import Op, as_op
 from .overlap import (ChunkSchedule, CollectivePlan, PersistentCollRequest,
-                      plans as _plans, progress_begin, progress_note)
+                      PlanRegistration, plans as _plans, progress_begin,
+                      progress_note, registry as _registry)
 
 
 def _run(comm: Comm, contrib: Any, combine, opname: str, plan=None,
@@ -1172,6 +1173,7 @@ class CollRequest:
         self._inactive = False
         self.kind = "coll"
         self.buffer = None
+        self.comm_cid = None     # pvar wait attribution (set by _nb_submit)
         # in-flight chunk state (overlap engine) — set by _nb_submit, advanced
         # by the progress worker, readable any time from the caller's thread
         self.progress = None
@@ -1195,12 +1197,15 @@ class CollRequest:
         if self._inactive:
             return self.status or STATUS_EMPTY
         if not self._done:
-            if _pv.enabled():
+            # wait_owned(): an outer owner (PersistentCollRequest) already
+            # accounts this round's wall clock — adding wait_ns here too
+            # would double-count it (the outermost-owner rule, ISSUE-6).
+            if _pv.enabled() and not _pv.wait_owned():
                 t0 = _pv.monotonic()
                 try:
                     self._complete()
                 finally:
-                    _pv.add_wait(_pv.monotonic() - t0)
+                    _pv.add_wait(_pv.monotonic() - t0, cid=self.comm_cid)
             else:
                 self._complete()
         return self._consume()
@@ -1286,8 +1291,12 @@ def _nb_submit(comm: Comm, fn) -> CollRequest:
     its pipeline chunks — while the caller is in user code; the request's
     ``progress`` exposes the in-flight chunk state)."""
     from ._runtime import require_env, set_env
-    from .overlap import ChunkProgress, bind_progress
+    from .overlap import ChunkProgress, bind_progress, demote_fast_armed
 
+    # a fast-armed persistent round on this comm has not rendezvoused yet:
+    # it must initiate (on the worker) BEFORE this submission to keep the
+    # per-comm initiation order equal to program order
+    demote_fast_armed(comm.cid)
     ctx, world_rank = require_env()
     st = _nb_state(ctx, comm.cid, world_rank, create=True)
     prog = ChunkProgress()
@@ -1309,6 +1318,7 @@ def _nb_submit(comm: Comm, fn) -> CollRequest:
 
     req = CollRequest(st.submit(run))
     req.progress = prog
+    req.comm_cid = comm.cid       # attributes the caller's Wait time (pvars)
     return req
 
 
@@ -1321,6 +1331,10 @@ def _ordered_run(comm: Comm, call):
     different orders on different ranks and mispair rendezvous rounds."""
     if getattr(_nb_worker_tls, "active", False):
         return call()                      # already ON the worker
+    # fast-armed persistent rounds initiate before this blocking collective
+    # (same program-order rule as the worker submissions)
+    from .overlap import demote_fast_armed
+    demote_fast_armed(comm.cid)
     from ._runtime import current_env
     env = current_env()
     if env is None:
@@ -1412,6 +1426,275 @@ def _comm_of(args) -> Comm:
 # per-call setup entirely — the training-loop shape.
 # ---------------------------------------------------------------------------
 
+def _registered_device_fold(op: Op, count: int, dtype: Any, size: int):
+    """The donated-accumulator fold executable for the registered device
+    lane: ONE XLA computation compiled AOT at plan creation with
+    ``donate_argnums`` on the accumulator, so every round's rank-ordered
+    chain reuses the accumulator's device buffer in place instead of
+    allocating a fresh output (the per-round HBM alloc + copy the generic
+    ``_jitted_fold`` pays). Two pre-pinned accumulator slots alternate
+    (``ring``): donation consumes a slot, so round k's result stays valid
+    until round k+2's fold re-donates that slot — the persistent in-place
+    contract documented in docs/performance.md. Returns the combine
+    closure, or None when the op can't trace (the caller then declines the
+    device registration and the generic path applies)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+    except Exception:                               # pragma: no cover
+        return None
+    count = int(count)
+    dt = np.dtype(dtype)
+    sds = jax.ShapeDtypeStruct((count,), dt)
+
+    def chain(acc, *xs):
+        # the .set() seeds the donated buffer; the fold is then the same
+        # rank-ordered left chain as _jitted_fold — bitwise-identical
+        acc = acc.at[:].set(xs[0])
+        for x in xs[1:]:
+            acc = op.fn(acc, x)
+        return acc
+
+    def plain_fold(*xs):
+        acc = xs[0]
+        for x in xs[1:]:
+            acc = op.fn(acc, x)
+        return acc
+
+    try:
+        donated = jax.jit(chain, donate_argnums=(0,)) \
+            .lower(sds, *([sds] * size)).compile()
+        plain = jax.jit(plain_fold).lower(*([sds] * size)).compile()
+        ring = [jnp.zeros((count,), dt), jnp.zeros((count,), dt)]
+    except Exception:
+        return None                 # host-only / untraceable op: no lane
+    from .buffers import is_jax_array as _isjax
+    state = {"k": 0}
+
+    def combine(cs, rt=None):
+        k = state["k"]
+        state["k"] = k + 1
+        n = len(cs)
+        good = n == size and all(
+            _isjax(c) and tuple(c.shape) == (count,) and c.dtype == dt
+            for c in cs)
+        if good:
+            slot = ring[k & 1]
+            # an operand aliasing the accumulator (a rank fed a previous
+            # result straight back) can't be donated over — fold fresh
+            if slot is not None and not any(c is slot for c in cs):
+                out = donated(slot, *cs)
+                ring[k & 1] = out
+                return [out] * n
+            return [plain(*cs)] * n
+        # a peer contributed a host / reshaped payload this round: generic
+        total = _reduce_arrays(list(cs), op)
+        return [total] * n
+
+    return combine
+
+
+def _register_allreduce(comm: Comm, args) -> Optional[PlanRegistration]:
+    """Build the registered-buffer fast path of one ``Allreduce_init``
+    signature (the ISSUE-6 tentpole), or None when the operands are not
+    eligible (every round then takes the generic worker path).
+
+    Everything a round needs is resolved and PINNED here, at plan-creation
+    time:
+
+    - the send operand's flat wire view (``buffers.pinned_wire_view``) —
+      rendezvous ships the pre-bound view, no per-call normalization;
+    - the fold accumulator (``buffers.register_scratch``) — the chunked
+      in-place ufunc fold lands in plan-private pinned memory (the generic
+      ``_chunked_fold`` allocates its output every call);
+    - the copy-out target — the user's recv buffer's pinned view, or a
+      per-rank registered result array for the allocating flavor
+      (returned in place round after round: ``Allreduce_init`` callers opt
+      into persistent in-place result semantics, see docs/performance.md);
+    - on the device lane (thread tier), the donated fold executable
+      (:func:`_registered_device_fold`) compiled once per plan;
+    - on the multi-process tier, the same-host shm segment lease
+      (``ProcChannel.shm_bind``) so no round pays the lazy mmap.
+
+    The round closure then does ONE rendezvous round trip inline on the
+    calling thread — no arg parse, no plan lookup, no worker hop, zero
+    steady-state allocation — with the thread tier's channel lock released
+    during the fold (``unlocked_fold``: the combine only touches the
+    plan-private scratch)."""
+    from . import config
+    from ._runtime import CollectiveChannel as _ThreadChannel, current_env
+    from .buffers import pinned_wire_view, register_scratch
+
+    if not isinstance(comm, Comm) or isinstance(comm, Intercomm):
+        return None
+    env = current_env()
+    if env is None:
+        return None                 # outside an SPMD env: legacy path raises
+    ctx, world_rank = env
+    cfg = config.load()
+    if not cfg.registered_buffers:
+        # knob off: keep a disabled stub so a later config reload (which
+        # bumps GENERATION) re-runs this factory and can bind for real
+        def _off():
+            raise MPIError("registered fast path is disabled")
+        return _registry.add(PlanRegistration(
+            comm.cid, config.GENERATION, _off, knob_on=False))
+    try:
+        sendbuf, recvbuf, count, op, _root, _c, alloc = \
+            _parse_reduce_args(args, False, "Allreduce")
+    except Exception:
+        return None                 # malformed args: legacy path raises
+    inplace = isinstance(sendbuf, _InPlace)
+    if inplace:
+        if _is_none(recvbuf):
+            return None
+        sendbuf = recvbuf
+    try:
+        if count is None:
+            count = element_count(sendbuf)
+        assert_minlength(sendbuf, count)
+    except Exception:
+        return None
+    count = int(count)
+    size, rank = comm.size(), comm.rank()
+    channel = comm.channel()
+    thread_tier = isinstance(channel, _ThreadChannel)
+
+    from .operators import is_elementwise
+    sendview = pinned_wire_view(sendbuf, count)
+    scratch: tuple
+    if sendview is not None:
+        # ---- host lane: pinned views + registered in-place chunk fold ----
+        if op.ufunc is None or not is_elementwise(op):
+            return None
+        payload = sendview
+        acc = register_scratch(count, sendview.dtype)
+        contrib = lambda: sendview
+        cplan = _reduce_plan(comm, "Allreduce", "reduce", op, count, payload)
+        bounds = (tuple(cplan.schedule) if cplan.schedule is not None
+                  else ((0, count),))
+        shared = [acc] * size
+
+        def combine(cs, rt=None):
+            flats = []
+            for c in cs:
+                if isinstance(c, np.ndarray) and c.dtype == acc.dtype \
+                        and c.size == count:
+                    flats.append(c.reshape(-1))
+                else:
+                    # a peer contributed a device / promoted payload this
+                    # round: fold generically, land it in the pinned scratch
+                    total = _reduce_arrays(list(cs), op,
+                                           schedule=cplan.schedule)
+                    np.copyto(acc, np.asarray(total).reshape(-1),
+                              casting="unsafe")
+                    return shared
+            for lo, hi in bounds:
+                np.copyto(acc[lo:hi], flats[0][lo:hi])
+                for f in flats[1:]:
+                    op.ufunc(acc[lo:hi], f[lo:hi], out=acc[lo:hi])
+            return shared
+
+        if alloc:
+            out = register_scratch(count, sendview.dtype)
+            shape = np.shape(sendbuf)
+            ret = out.reshape(shape) \
+                if int(np.prod(shape, dtype=np.int64)) == count else out
+            scratch = (acc, out)
+
+            def copyout(res):
+                if res is not out:
+                    np.copyto(out, np.asarray(res).reshape(-1),
+                              casting="unsafe")
+                return ret
+        else:
+            tgt = sendbuf if inplace else recvbuf
+            tgtview = sendview if inplace else pinned_wire_view(tgt, count)
+            if tgtview is None:
+                return None         # unbindable recv operand: legacy path
+            scratch = (acc,)
+
+            def copyout(res):
+                resarr = np.asarray(res).reshape(-1)
+                if resarr is not tgtview and resarr.base is not tgtview:
+                    np.copyto(tgtview, resarr, casting="unsafe")
+                return tgt
+    elif (isinstance(sendbuf, DeviceBuffer) or is_jax_array(sendbuf)) \
+            and thread_tier:
+        # ---- device lane: donated-accumulator fold, thread tier only ----
+        payload = to_wire(sendbuf, count)
+        cplan = _reduce_plan(comm, "Allreduce", "reduce", op, count, payload)
+        combine = _registered_device_fold(op, count, payload.dtype, size)
+        if combine is None:
+            return None
+        contrib = lambda: to_wire(sendbuf, count)   # rebind-aware snapshot
+        scratch = ()
+        if alloc:
+            shape = tuple(getattr(sendbuf, "shape", ()))
+            reshape = int(np.prod(shape, dtype=np.int64)) == count
+            wrap = isinstance(sendbuf, DeviceBuffer)
+
+            def copyout(res):
+                val = res if (not reshape or res.shape == shape) \
+                    else res.reshape(shape)
+                return DeviceBuffer(val) if wrap else val
+        else:
+            tgt = sendbuf if inplace else recvbuf
+            if not isinstance(tgt, DeviceBuffer):
+                return None         # jax.Array recv is immutable: legacy
+            def copyout(res):
+                v = tgt.value
+                if is_jax_array(res) and res.size == v.size \
+                        and res.dtype == v.dtype:
+                    tgt.setflat(res if res.shape == v.shape
+                                else res.reshape(v.shape))
+                else:
+                    tgt.setflat(res, count)
+                return tgt
+    else:
+        return None
+
+    shm_release = None
+    shm_bind = getattr(channel, "shm_bind", None)
+    if shm_bind is not None:
+        nbytes = int(count) * int(getattr(payload.dtype, "itemsize", 0) or 0)
+        shm_release = shm_bind(nbytes)
+
+    cid = comm.cid
+
+    def nb_probe() -> int:
+        st = _nb_state(ctx, cid, world_rank, create=False)
+        return 0 if st is None else st.outstanding
+
+    opname, hint, sig = cplan.opname, cplan.hint, cplan.sig
+    runkw = {"unlocked_fold": True} if thread_tier else {}
+    pv_nbytes = _pv.payload_nbytes(payload)
+
+    def run_round():
+        # the fast-armed Wait: one rendezvous round trip on THIS thread.
+        # _ordered_run is unnecessary by construction — arming required an
+        # idle nonblocking worker, and any later submission on this comm
+        # demotes the armed round before it gets here.
+        sc = _pv.op_begin() if _pv.enabled() else None
+        try:
+            res = channel.run(rank, contrib(), combine, opname,
+                              plan=hint, **runkw)
+            if sc is None:
+                return copyout(res)
+            t0 = _pv.monotonic()
+            val = copyout(res)
+            sc.spans.append(("copy", t0, _pv.monotonic()))
+            return val
+        finally:
+            if sc is not None:
+                _pv.op_end(sc, comm, coll="allreduce", algo=sig.get("algo"),
+                           dtype=sig.get("dtype"), nbytes=pv_nbytes)
+
+    return _registry.add(PlanRegistration(
+        cid, config.GENERATION, run_round, scratch=scratch, wire=sendview,
+        shm_release=shm_release, knob_on=True, nb_probe=nb_probe,
+        inplace_optin=bool(inplace or alloc)))
+
 def Allreduce_init(*args) -> PersistentCollRequest:
     """Persistent Allreduce (same flavors as :func:`Allreduce`). Arm with
     ``Start``/``Startall``; complete with the Wait/Test family; reuse. The
@@ -1419,7 +1702,8 @@ def Allreduce_init(*args) -> PersistentCollRequest:
     comm = _comm_of(args)
     return PersistentCollRequest(
         lambda: _nb_submit(comm, lambda: Allreduce(*args)),
-        "pallreduce", args[0] if args else None)
+        "pallreduce", args[0] if args else None).bind_registration(
+            lambda: _register_allreduce(comm, args))
 
 
 def Bcast_init(buf: Any, root: int, comm: Comm) -> PersistentCollRequest:

@@ -134,13 +134,28 @@ class Comm:
         (src/comm.jl MPI_Comm_free analog — no C resources, but the
         I-collective executor is a real thread)."""
         self._freed = True
-        from .overlap import plans
+        from .overlap import plans, registry
         plans.invalidate(self._cid)   # cached collective plans die with us
+        # registered fast path (docs/performance.md "Registered buffers"):
+        # plan-pinned wire views, fold scratch and shm slot leases must not
+        # outlive the communicator
+        registry.release(self._cid)
         env = current_env()
         if env is not None:
             from .collective import nb_shutdown
             ctx, world_rank = env
             nb_shutdown(ctx, cid=self._cid, world_rank=world_rank)
+            ch = ctx._channels.get(self._cid) \
+                if hasattr(ctx, "_channels") else None
+            drop = getattr(ch, "drop_shm", None)
+            if drop is not None:
+                drop()
+        from . import config
+        if config.load().strict:
+            leaked = registry.leased(self._cid)
+            assert leaked == 0, (
+                f"Comm.free left {leaked} registered shm slot lease(s) on "
+                f"cid {self._cid} — a PlanRegistration escaped the registry")
 
     def py2f(self) -> int:
         return self._cid

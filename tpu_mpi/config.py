@@ -105,6 +105,12 @@ class Config:
     # use one mmap'd /dev/shm segment per communicator instead of O(P)
     # transport messages when all ranks share a host; 0 disables the lane.
     coll_shm_max_bytes: int = 1 << 16
+    # registered-buffer fast path (docs/performance.md "Registered
+    # buffers"): persistent collectives (Allreduce_init + Start/Wait)
+    # pre-pin their wire views and fold scratch at plan creation and run
+    # each round allocation-free on the calling thread; off = every round
+    # takes the generic per-call path (parse, plan lookup, worker hop).
+    registered_buffers: bool = True
     # performance-variable (pvar) collection level (docs/observability.md):
     # 0 disables every counter (one branch per op remains), 1 collects.
     # Pcontrol(level) overrides this at runtime without a config reload.
@@ -144,6 +150,7 @@ _ENV_MAP = {
     "tune_table": "TPU_MPI_TUNE_TABLE",
     "coll_algo": "TPU_MPI_COLL_ALGO",
     "coll_shm_max_bytes": "TPU_MPI_COLL_SHM_MAX_BYTES",
+    "registered_buffers": "TPU_MPI_REGISTERED_BUFFERS",
     "pvars": "TPU_MPI_PVARS",
     "pvars_dump": "TPU_MPI_PVARS_DUMP",
     "pvars_hist_bins": "TPU_MPI_PVARS_HIST_BINS",

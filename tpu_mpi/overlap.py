@@ -219,14 +219,150 @@ def progress_note(prog: Optional[ChunkProgress]) -> None:
         prog.note()
 
 
+class PlanRegistration:
+    """Plan-bound registered buffers + the pre-resolved round closure of one
+    persistent collective (the ISSUE-6 tentpole). Built once at
+    ``Allreduce_init`` by :func:`tpu_mpi.collective._register_allreduce`:
+    arguments parsed, wire views pinned, the fold scratch pre-allocated
+    (``buffers.register_scratch``), the combine / copy-out pre-bound — a
+    Start/Wait round is then one inline rendezvous with zero allocation,
+    no plan lookup and no worker hop. Tracked in :data:`registry` so
+    ``Comm.free`` releases the pinned buffers and any shm slot lease."""
+
+    __slots__ = ("cid", "generation", "scratch", "wire", "run_round",
+                 "shm_release", "released", "knob_on", "_nb_probe",
+                 "inplace_optin")
+
+    def __init__(self, cid: int, generation: int, run_round: Callable[[], Any],
+                 scratch: tuple = (), wire: Any = None,
+                 shm_release: Optional[Callable[[], None]] = None,
+                 knob_on: bool = True, nb_probe: Optional[Callable] = None,
+                 inplace_optin: bool = False):
+        self.cid = cid
+        self.generation = generation
+        self.run_round = run_round
+        self.scratch = scratch          # pinned fold accumulators (id-stable)
+        self.wire = wire                # pre-bound send wire view, if host
+        self.shm_release = shm_release
+        self.released = False
+        self.knob_on = knob_on
+        self._nb_probe = nb_probe       # () -> outstanding nb ops on the comm
+        self.inplace_optin = inplace_optin
+
+    def armable(self) -> bool:
+        """Whether a Start may take the fast path right now: the knob is on,
+        the run is untraced (traced runs keep the fully-evented legacy
+        path), and this comm's nonblocking worker is idle (in-flight ``I*``
+        ops own the initiation order)."""
+        if self.released or not self.knob_on:
+            return False
+        from .analyze import events as _ev
+        if _ev.enabled():
+            return False
+        return self._nb_probe is None or self._nb_probe() == 0
+
+    def release(self) -> None:
+        """Drop the pinned buffers and any shm slot lease (``Comm.free``)."""
+        if self.released:
+            return
+        self.released = True
+        self.scratch = ()
+        self.wire = None
+        rel, self.shm_release = self.shm_release, None
+        if rel is not None:
+            rel()
+
+
+class BufferRegistry:
+    """Process-wide registry of live :class:`PlanRegistration` instances,
+    keyed by communicator cid. ``Comm.free`` calls :meth:`release` so plan-
+    registered wire buffers and shm segment slots never outlive their
+    communicator (the ISSUE-6 leak fix); ``TPU_MPI_STRICT`` asserts the
+    lease count actually hit zero."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_cid: dict[Any, list] = {}
+
+    def add(self, reg: PlanRegistration) -> PlanRegistration:
+        with self._lock:
+            self._by_cid.setdefault(reg.cid, []).append(reg)
+        return reg
+
+    def release(self, cid: Any) -> int:
+        """Release every registration of one communicator; returns how many
+        were released."""
+        with self._lock:
+            regs = self._by_cid.pop(cid, [])
+        for reg in regs:
+            reg.release()
+        return len(regs)
+
+    def leased(self, cid: Any = None) -> int:
+        """Outstanding shm slot leases (one comm, or all) — the strict-mode
+        refcount the ``Comm.free`` assert reads."""
+        with self._lock:
+            regs = [r for k, rs in self._by_cid.items()
+                    if cid is None or k == cid for r in rs]
+        return sum(1 for r in regs if r.shm_release is not None
+                   and not r.released)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"comms": len(self._by_cid),
+                    "registrations": sum(len(v) for v in self._by_cid.values())}
+
+
+#: Live plan registrations; ``Comm.free`` releases per-cid.
+registry = BufferRegistry()
+
+
+_fast_tls = threading.local()     # .armed: {cid: [PersistentCollRequest]}
+
+
+def _armed_list(cid: Any) -> list:
+    armed = getattr(_fast_tls, "armed", None)
+    if armed is None:
+        armed = _fast_tls.armed = {}
+    lst = armed.get(cid)
+    if lst is None:
+        lst = armed[cid] = []
+    return lst
+
+
+def demote_fast_armed(cid: Any = None) -> None:
+    """Push every fast-armed persistent request on THIS thread (of one comm,
+    or of all comms) onto the legacy worker path, in Start order. Called
+    before anything else initiates on the same communicator — a blocking
+    collective (``collective._ordered_run``), a nonblocking submit
+    (``collective._nb_submit``), or a second Start — so initiation order
+    stays the program order even though fast-armed rounds defer their
+    rendezvous to ``Wait``."""
+    armed = getattr(_fast_tls, "armed", None)
+    if not armed:
+        return
+    cids = [cid] if cid is not None else list(armed)
+    for c in cids:
+        for req in list(armed.get(c, ())):
+            req._demote()
+
+
 class PersistentCollRequest:
     """Persistent collective request (MPI-4 ``MPI_Allreduce_init`` family),
     mirroring :class:`tpu_mpi.pointtopoint.Prequest`: created INACTIVE with
     the operation's arguments bound (and its plan pre-resolved), armed by
     ``Start``/``Startall``, completed by the whole Wait/Test family, then
-    inactive-but-reusable for the next round. Each Start initiates the
-    collective on this rank's per-comm worker, so rounds progress in the
-    background exactly like the one-shot ``I*`` ops."""
+    inactive-but-reusable for the next round.
+
+    Two execution lanes. The **registered fast path** (a
+    :class:`PlanRegistration` bound via :meth:`bind_registration`, the
+    default when the operands are eligible): Start arms the round and Wait
+    runs it INLINE on the calling thread against the pre-pinned buffers —
+    one rendezvous round trip, zero allocation. The **legacy lane**: each
+    Start initiates the collective on this rank's per-comm worker, so
+    rounds progress in the background exactly like the one-shot ``I*``
+    ops; Test on a fast-armed round demotes to this lane (Test must not
+    block)."""
 
     def __init__(self, make: Callable[[], Any], kind: str, buffer: Any):
         self._make = make           # () -> a live CollRequest
@@ -235,23 +371,76 @@ class PersistentCollRequest:
         self.buffer = buffer
         self.status = None
         self.result = None          # allocating flavors: last round's value
+        self._reg: Optional[PlanRegistration] = None
+        self._reg_factory: Optional[Callable[[], Any]] = None
+        self._fast_armed = False
 
-    def start(self) -> "PersistentCollRequest":
-        if self._inner is not None and self._inner.active:
-            raise MPIError("Start on an already-active persistent request",
-                           code=_ec.ERR_REQUEST)
-        self._inner = self._make()
+    def bind_registration(self, factory: Callable[[], Any]
+                          ) -> "PersistentCollRequest":
+        """Attach the registered-buffer fast path: ``factory()`` builds a
+        :class:`PlanRegistration` (or None when the operands are not
+        eligible) and is re-run to rebind buffers after a config-generation
+        change."""
+        self._reg_factory = factory
+        self._reg = factory()
         return self
 
     @property
+    def registration(self) -> Optional[PlanRegistration]:
+        """The live registration (None = generic path). Exposed for tests
+        and benchmarks asserting id-stable pinned buffers."""
+        return self._reg
+
+    def start(self) -> "PersistentCollRequest":
+        if self.active:
+            raise MPIError("Start on an already-active persistent request",
+                           code=_ec.ERR_REQUEST)
+        reg = self._reg
+        if reg is not None:
+            from . import config
+            if reg.generation != config.GENERATION \
+                    and self._reg_factory is not None:
+                # config reload: rebind the registered buffers (the pipeline
+                # knobs feed the schedule; the knob itself may have flipped)
+                reg = self._reg = self._reg_factory()
+        if reg is not None and reg.armable():
+            lst = _armed_list(reg.cid)
+            if lst:
+                # a second Start on the same comm: demote the earlier armed
+                # rounds to the worker (initiation order = Start order);
+                # the worker is then busy, so this round goes legacy too
+                demote_fast_armed(reg.cid)
+            if reg.armable():
+                self._fast_armed = True
+                _armed_list(reg.cid).append(self)
+                return self
+        self._inner = self._make()
+        return self
+
+    def _demote(self) -> None:
+        """Move a fast-armed round onto the legacy worker path (initiation
+        happens NOW, preserving Start order for whatever follows)."""
+        if not self._fast_armed:
+            return
+        self._fast_armed = False
+        lst = _armed_list(self._reg.cid)
+        if self in lst:
+            lst.remove(self)
+        self._inner = self._make()
+
+    @property
     def active(self) -> bool:
-        return self._inner is not None and self._inner.active
+        return self._fast_armed or \
+            (self._inner is not None and self._inner.active)
 
     @property
     def progress(self) -> Optional[ChunkProgress]:
         return getattr(self._inner, "progress", None)
 
     def test(self) -> bool:
+        if self._fast_armed:
+            # Test must not block: hand the round to the worker and poll it
+            self._demote()
         if self._inner is None:
             return True
         done = self._inner.test()
@@ -261,19 +450,45 @@ class PersistentCollRequest:
 
     def wait(self):
         from .pointtopoint import STATUS_EMPTY
+        if self._fast_armed:
+            self._fast_armed = False
+            lst = _armed_list(self._reg.cid)
+            if self in lst:
+                lst.remove(self)
+            self.result = self._reg.run_round()
+            self.status = STATUS_EMPTY
+            return self.status
         if self._inner is None:
             return self.status or STATUS_EMPTY
-        self.status = self._inner.wait()
+        # Wait-time ownership (the outermost-owner rule, ISSUE-6 bugfix):
+        # the round's wall clock is already fully accounted by the op scope
+        # its worker owns (phase_ns + times), so the inner CollRequest.wait
+        # must not ALSO bump wait_ns for the same interval.
+        from . import perfvars as _pv
+        claimed = _pv.own_wait()
+        try:
+            self.status = self._inner.wait()
+        finally:
+            if claimed:
+                _pv.disown_wait()
         self.result = self._inner.result
         self._inner = None          # inactive, ready for the next Start
         return self.status
 
     def _consume(self):
         from .pointtopoint import STATUS_EMPTY
+        if self._fast_armed:
+            return self.wait()
         if self._inner is None:
             return self.status or STATUS_EMPTY
-        self.status = self._inner.wait() if self._inner.active \
-            else (self._inner.status or STATUS_EMPTY)
+        from . import perfvars as _pv
+        claimed = _pv.own_wait()
+        try:
+            self.status = self._inner.wait() if self._inner.active \
+                else (self._inner.status or STATUS_EMPTY)
+        finally:
+            if claimed:
+                _pv.disown_wait()
         self.result = self._inner.result
         self._inner = None
         return self.status

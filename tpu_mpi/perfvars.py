@@ -63,6 +63,7 @@ class _TLS(threading.local):
     # AttributeError/getattr-default dance on the hot path
     scope = None                      # the open _OpScope of this thread
     acct = None                       # (store_gen, {key: CommPvars}) cache
+    wait_owned = False                # a wait-time owner is on the stack
 
 
 _tls = _TLS()
@@ -324,6 +325,38 @@ def add_wait(wait_s: float, comm: Any = None, cid: Optional[int] = None) -> None
         return
     with _store_lock:
         acct.wait_ns += int(wait_s * 1e9)
+
+
+# -- wait-time ownership (the outermost-owner rule for wait_ns) -------------
+#
+# A persistent collective round is fully accounted by the op scope its
+# worker (or the inline registered fast path) owns: the round's wall clock
+# lands in ``times`` and its blocked share in ``phase_ns["rendezvous"]``.
+# The caller blocked in ``Wait`` covers the SAME wall clock, so letting the
+# inner ``CollRequest.wait`` also bump ``wait_ns`` double-counts it — the
+# overhead_probe --pvars bug ISSUE-6 names. ``PersistentCollRequest`` claims
+# ownership around its inner wait; nested add_wait callers check
+# :func:`wait_owned` first and stand down.
+
+def own_wait() -> bool:
+    """Claim wait-time ownership for this thread. Returns True when the
+    claim is fresh (caller must :func:`disown_wait` in a finally); False
+    when an outer owner already holds it."""
+    if _tls.wait_owned:
+        return False
+    _tls.wait_owned = True
+    return True
+
+
+def disown_wait() -> None:
+    """Release the wait-time claim taken by :func:`own_wait`."""
+    _tls.wait_owned = False
+
+
+def wait_owned() -> bool:
+    """True while an outer wait-time owner is on this thread's stack —
+    nested waits must not call :func:`add_wait`."""
+    return _tls.wait_owned
 
 
 def note_rma(comm: Any, kind: str) -> None:
