@@ -57,6 +57,12 @@ the always-on performance-variable counters (docs/observability.md):
 host-path ping-pong and star Allreduce with collection off vs on. The
 off lane must stay within noise of the pre-pvars baseline — its fast
 path is one generation-checked tuple compare per op.
+
+An online-autotuner lane (``--online [out.json]``, default
+``benchmarks/results/overhead-online-cpusim.json``) runs the same cases
+with the bandit's decision point live: exploration off (the deployment
+default, compared against the committed pre-bandit pvars-on baseline —
+must be neutral) and exploration on at 10% (the exploration tax).
 """
 
 from __future__ import annotations
@@ -150,8 +156,11 @@ def case_floor_vs_size(jax, jnp) -> list[dict]:
 
 
 def _pvars_case(pvars_on: bool, pp_iters: int = 2000,
-                ar_iters: int = 300, repeats: int = 5) -> dict:
-    """Per-op host-path latencies (µs) with pvar collection off/on."""
+                ar_iters: int = 300, repeats: int = 5,
+                extra_env: dict | None = None) -> dict:
+    """Per-op host-path latencies (µs) with pvar collection off/on.
+    ``extra_env`` overlays the lane's env after the defaults (the online
+    lane uses it to flip the bandit knobs)."""
     import numpy as np
 
     import tpu_mpi as MPI
@@ -160,6 +169,8 @@ def _pvars_case(pvars_on: bool, pp_iters: int = 2000,
 
     os.environ["TPU_MPI_PVARS"] = "1" if pvars_on else "0"
     os.environ["TPU_MPI_COLL_ALGO"] = "allreduce=star"
+    for k, v in (extra_env or {}).items():
+        os.environ[k] = v
     config.load(refresh=True)
     perfvars.reset()
     out = {}
@@ -282,11 +293,74 @@ def pvars_lane(out_path: str) -> None:
     })
 
 
+def online_lane(out_path: str, baseline_path: str | None = None) -> None:
+    """Online-autotuner decision-point overhead: the pvars-on cases with
+    the bandit code present but exploration OFF (the deployment default —
+    must stay within noise of the committed pre-bandit baseline's pvars-on
+    lane) and with exploration ON at 10% (the exploration tax: decide()
+    bookkeeping plus the rerouted calls; the thread tier executes in
+    process either way, so this isolates the engine's own cost)."""
+    import json
+
+    platform = detect_platform()
+    _log(f"platform: {platform}")
+    knobs = ("TPU_MPI_PVARS", "TPU_MPI_COLL_ALGO", "TPU_MPI_TUNE_EXPLORE",
+             "TPU_MPI_TUNE_SWAP_PERIOD")
+    saved = {k: os.environ.get(k) for k in knobs}
+    try:
+        off = _pvars_case(True, extra_env={"TPU_MPI_TUNE_EXPLORE": "0"})
+        _log(f"explore off: {off}")
+        # unpin the algorithm (a force-pin suppresses exploration) and
+        # park the swap milestone out of reach so the lane times decide()
+        # itself, not the amortized TuneSwap rendezvous
+        on = _pvars_case(True, extra_env={
+            "TPU_MPI_TUNE_EXPLORE": "0.1",
+            "TPU_MPI_TUNE_SWAP_PERIOD": "1000000",
+            "TPU_MPI_COLL_ALGO": ""})
+        _log(f"explore on:  {on}")
+    finally:
+        for k, v in saved.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+        from tpu_mpi import config, tune_online
+        config.load(refresh=True)
+        tune_online.reset()
+    common = [k for k in off if k in on and isinstance(off[k], float)
+              and off[k] > 0]
+    on_pct = {k: round((on[k] - off[k]) / off[k] * 100, 2) for k in common}
+    _log(f"explore-on overhead %: {on_pct}")
+    baseline = None
+    base_pct = None
+    if baseline_path and os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            baseline = json.load(f).get("pvars_on_us")
+    if baseline:
+        base_pct = {k: round((off[k] - baseline[k]) / baseline[k] * 100, 2)
+                    for k in baseline
+                    if k in off and isinstance(baseline[k], float)
+                    and baseline[k] > 0}
+        _log(f"explore-off vs pre-bandit baseline %: {base_pct}")
+    emit(out_path, {
+        "benchmark": "overhead_online",
+        "platform": platform,
+        "explore_off_us": off,
+        "explore_on_us": on,
+        "explore_on_overhead_pct": on_pct,
+        "baseline_pvars_on_us": baseline,
+        "off_vs_baseline_pct": base_pct,
+    })
+
+
 def main() -> None:
     if sys.argv[1:2] == ["--pvars"]:
         out = sys.argv[2] if len(sys.argv) > 2 else \
             os.path.join(_HERE, "results", "overhead-pvars-cpusim.json")
         pvars_lane(out)
+        return
+    if sys.argv[1:2] == ["--online"]:
+        out = sys.argv[2] if len(sys.argv) > 2 else \
+            os.path.join(_HERE, "results", "overhead-online-cpusim.json")
+        online_lane(out, baseline_path=os.path.join(
+            _HERE, "results", "overhead-pvars-cpusim.json"))
         return
     out_path = sys.argv[1] if len(sys.argv) > 1 else \
         os.path.join(_HERE, "results", "overhead-probe-tpu.json")

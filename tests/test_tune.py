@@ -142,6 +142,29 @@ def test_table_roundtrip_and_lookup(tmp_path):
     assert tune._table_lookup(loaded, "bcast", 8, 64) is None
 
 
+def test_nearest_nranks_clamps_both_edges():
+    # interior: nearest measured size below
+    assert tune._nearest_nranks([4, 8], 6) == 4
+    assert tune._nearest_nranks([2, 4, 8], 7) == 4
+    # exact match wins
+    assert tune._nearest_nranks([4, 8], 8) == 8
+    # below the smallest measured size: clamp UP to the smallest — an n=3
+    # query against a {4, 8} table must not invent an unmeasured regime
+    assert tune._nearest_nranks([4, 8], 3) == 4
+    assert tune._nearest_nranks([4, 8], 2) == 4
+    # above the largest: clamp DOWN to the largest
+    assert tune._nearest_nranks([4, 8], 16) == 8
+    assert tune._nearest_nranks([4, 8], 1000) == 8
+
+
+def test_table_lookup_pins_nranks_edges():
+    table = {("allreduce", 4): [(0, "shm")],
+             ("allreduce", 8): [(0, "ring")]}
+    # both edges of the measured range serve the clamped ladder
+    assert tune._table_lookup(table, "allreduce", 3, 64) == "shm"
+    assert tune._table_lookup(table, "allreduce", 16, 64) == "ring"
+
+
 def test_malformed_table_falls_back(tmp_path, capsys):
     path = str(tmp_path / "bad.toml")
     with open(path, "w") as f:

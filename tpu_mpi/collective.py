@@ -42,6 +42,7 @@ from .comm import Comm, Intercomm, ROOT
 from ._runtime import PROC_NULL
 from . import error as _ec
 from . import perfvars as _pv
+from . import tune_online as _tune_online
 from .analyze import events as _ev
 from .error import CollectiveMismatchError, MPIError
 from .operators import Op, as_op
@@ -160,6 +161,25 @@ def _coll_select(comm: Comm, coll: str, nbytes: Optional[int], *,
         while len(_select_cache) > _SELECT_CAP:
             _select_cache.popitem(last=False)
     return algo
+
+
+def _maybe_explore(comm: Comm, coll: str, nbytes: Optional[int], algo: str, *,
+                   commutative: bool = False, elementwise: bool = False,
+                   numeric: bool = True) -> str:
+    """Online-autotuner hook at the decision point (docs/performance.md
+    "Online tuning"): with exploration off — the default — this costs one
+    generation-cached tuple compare; with it on, the bandit may reroute
+    this call to an eligible alternate arm on its deterministic lockstep
+    schedule. Called exactly once per user-facing collective call (never
+    from plan build or registration), so the shared counters advance
+    identically on every rank."""
+    st = _tune_online.state()
+    if st is None:
+        return algo
+    chk = getattr(getattr(comm, "ctx", None), "coll_shm_ok", None)
+    shm = bool(chk(comm.group)) if chk is not None else False
+    return st.decide(comm, coll, nbytes, algo, commutative=commutative,
+                     elementwise=elementwise, numeric=numeric, shm=shm)
 
 
 def _wire_nbytes(payload: Any) -> Optional[int]:
@@ -458,7 +478,8 @@ def Barrier(comm: Comm) -> None:
     On an intercommunicator: until every rank of BOTH groups arrives."""
     if isinstance(comm, Intercomm):
         return _inter_barrier(comm)
-    algo = _coll_select(comm, "barrier", None)
+    algo = _maybe_explore(comm, "barrier", None,
+                          _coll_select(comm, "barrier", None))
     _run(comm, None, lambda cs: [None] * len(cs), f"Barrier@{comm.cid}",
          plan=("barrier", algo), _sig={"algo": algo})
 
@@ -489,7 +510,10 @@ def Bcast(buf: Any, *args) -> Any:
 
     dt = getattr(extract_array(buf), "dtype", None)
     nbytes = int(n) * dt.itemsize if dt is not None and dt != object else None
-    algo = _coll_select(comm, "bcast", nbytes, numeric=nbytes is not None)
+    algo = _maybe_explore(
+        comm, "bcast", nbytes,
+        _coll_select(comm, "bcast", nbytes, numeric=nbytes is not None),
+        numeric=nbytes is not None)
     val = _run_rooted(comm, root, payload, combine, f"Bcast@{comm.cid}",
                       plan=("bcast", root, algo),
                       _sig={"count": int(n), "dtype": str(dt), "algo": algo})
@@ -522,7 +546,9 @@ def bcast(obj: Any, root: int, comm: Comm) -> Any:
         val = cs[rt]
         return [val] * len(cs)
 
-    algo = _coll_select(comm, "bcast", None, numeric=False)
+    algo = _maybe_explore(comm, "bcast", None,
+                          _coll_select(comm, "bcast", None, numeric=False),
+                          numeric=False)
     kind, data = _run_rooted(comm, root, payload, combine, f"bcast@{comm.cid}",
                              plan=("bcast", root, algo), _sig={"algo": algo})
     if rank == root:
@@ -576,7 +602,8 @@ def Scatter(*args) -> Any:
                      "dtype", None)
         nbytes = (count * size * dt.itemsize
                   if dt is not None and dt != object else None)
-    algo = _coll_select(comm, "scatter", nbytes)
+    algo = _maybe_explore(comm, "scatter", nbytes,
+                          _coll_select(comm, "scatter", nbytes))
     chunk = _run_rooted(comm, root, payload, combine, f"Scatter@{comm.cid}",
                         plan=("scatter", algo), _sig={"algo": algo})
     if alloc:
@@ -717,12 +744,16 @@ def _gather_impl(sendbuf, recvbuf, count, root, comm, alloc, all_ranks):
         # block per step) instead of star ingress + P x egress at the root;
         # the selection is keyed on the per-rank block size, matching the
         # ring's per-hop cost
-        algo = _coll_select(comm, "allgather", nb, numeric=nb is not None)
+        algo = _maybe_explore(
+            comm, "allgather", nb,
+            _coll_select(comm, "allgather", nb, numeric=nb is not None),
+            numeric=nb is not None)
         full = _run(comm, payload, combine, f"Allgather@{comm.cid}",
                     plan=("allgather", algo), _sig={"algo": algo})
     else:
-        algo = _coll_select(comm, "gather",
-                            nb * size if nb is not None else None)
+        gnb = nb * size if nb is not None else None
+        algo = _maybe_explore(comm, "gather", gnb,
+                              _coll_select(comm, "gather", gnb))
         full = _run_rooted(comm, root, payload, combine, f"Gather@{comm.cid}",
                            plan=("gather", algo), _sig={"algo": algo})
     if not isroot:
@@ -798,8 +829,11 @@ def _gatherv_impl(sendbuf, recvbuf, counts, root, comm, alloc, all_ranks):
             getattr(payload, "dtype", None), "itemsize", 0)
         dt = getattr(payload, "dtype", None)
         numeric = dt is not None and dt != object
-        algo = _coll_select(comm, "allgatherv",
-                            total_bytes if numeric else None, numeric=numeric)
+        gnb = total_bytes if numeric else None
+        algo = _maybe_explore(comm, "allgatherv", gnb,
+                              _coll_select(comm, "allgatherv", gnb,
+                                           numeric=numeric),
+                              numeric=numeric)
         full = _run(comm, payload, combine, f"Allgatherv@{comm.cid}",
                     plan=("allgatherv", total_bytes, tuple(counts), algo),
                     _sig={"algo": algo})
@@ -849,7 +883,10 @@ def Alltoall(*args) -> Any:
     # multi-process tier: large exchanges go direct pairwise (each segment
     # one hop) instead of O(P²·seg) through the star root
     nb = _wire_nbytes(payload)
-    algo = _coll_select(comm, "alltoall", nb, numeric=nb is not None)
+    algo = _maybe_explore(
+        comm, "alltoall", nb,
+        _coll_select(comm, "alltoall", nb, numeric=nb is not None),
+        numeric=nb is not None)
     mine = _run(comm, payload, combine, f"Alltoall@{comm.cid}",
                 plan=("alltoall", algo), _sig={"algo": algo})
     if alloc:
@@ -894,8 +931,11 @@ def Alltoallv(*args) -> Any:
     # per-rank send totals differ, so the size-blind (None) decision keeps
     # the selection rank-uniform; pairwise is gated on dtype alone
     dt = getattr(payload[0], "dtype", None)
-    algo = _coll_select(comm, "alltoallv", None,
-                        numeric=dt is not None and dt != object)
+    numeric = dt is not None and dt != object
+    algo = _maybe_explore(comm, "alltoallv", None,
+                          _coll_select(comm, "alltoallv", None,
+                                       numeric=numeric),
+                          numeric=numeric)
     mine = _run(comm, payload, combine, f"Alltoallv@{comm.cid}",
                 plan=("alltoallv", algo), _sig={"algo": algo})
     if alloc:
@@ -991,6 +1031,31 @@ def _reduce_plan(comm: Comm, name: str, mode: str, op: Op, count: int,
     return plan
 
 
+def _explore_reduce_variant(comm: Comm, cplan: CollectivePlan, op: Op,
+                            count: int, payload: Any) -> CollectivePlan:
+    """Online-tuning hook for the reduce family: plan-cache hits skip
+    ``_coll_select`` entirely, so with the bandit live we re-run the
+    decision through :func:`_maybe_explore` per call and — only on the
+    exploration slots — hand back a shallow variant of the cached plan
+    with the algorithm rebound. The variant shares the combine closure and
+    chunk schedule; the cached plan itself is never mutated, so steady
+    traffic keeps its zero-overhead path."""
+    from .operators import is_elementwise
+    coll, hop, _ = cplan.hint
+    dtype = getattr(payload, "dtype", None)
+    itemsize = getattr(dtype, "itemsize", 0)
+    numeric = dtype is not None and str(dtype) != "object"
+    nbytes = int(count) * itemsize if numeric and itemsize else None
+    algo = _maybe_explore(comm, coll, nbytes, cplan.algo,
+                          commutative=bool(op.commutative),
+                          elementwise=is_elementwise(op), numeric=numeric)
+    if algo == cplan.algo:
+        return cplan
+    return CollectivePlan(cplan.opname, cplan.op, cplan.combine,
+                          dict(cplan.sig, algo=algo), (coll, hop, algo),
+                          cplan.schedule, cplan.generation, algo=algo)
+
+
 def _reduce_family(args, has_root: bool, mode: str, name: str) -> Any:
     sendbuf, recvbuf, count, op, root, comm, alloc = _parse_reduce_args(args, has_root, name)
     rank, size = comm.rank(), comm.size()
@@ -1018,6 +1083,8 @@ def _reduce_family(args, has_root: bool, mode: str, name: str) -> Any:
         payload = to_wire(sendbuf, count)
 
     cplan = _reduce_plan(comm, name, mode, op, count, payload)
+    if mode == "reduce" and _tune_online.state() is not None:
+        cplan = _explore_reduce_variant(comm, cplan, op, count, payload)
     # Own the pvar op scope across BOTH the rendezvous (_run) and the
     # result consumption below, so the copy-out into the user's recvbuf
     # lands in the same phase breakdown as the channel's rendezvous/fold

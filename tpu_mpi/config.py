@@ -100,6 +100,32 @@ class Config:
     # pins (e.g. "allreduce=rdouble,barrier=star"), clamped by per-
     # algorithm eligibility; "" = no override.
     coll_algo: str = ""
+    # online bandit autotuner (tpu_mpi.tune_online, docs/performance.md
+    # "Online tuning"): fraction of live collective calls routed to an
+    # eligible alternate algorithm for measurement (epsilon-greedy over a
+    # shared deterministic schedule so every rank explores the same arm on
+    # the same call). 0.0 disables the loop entirely — the default.
+    tune_explore: float = 0.0
+    # minimum observations a (coll, algo, nbytes) cell needs before it may
+    # set a crossover (noise guard for `tune --from-pvars`, fleet merges,
+    # and the online loop's hot-swap).
+    tune_min_samples: int = 8
+    # online loop: recompute + hot-swap the crossover table every this many
+    # algorithm decisions per communicator (a lockstep internal round
+    # merges per-rank arm stats so every rank derives the same table).
+    tune_swap_period: int = 256
+    # seed of the shared deterministic exploration schedule (every rank
+    # must use the same value — it's part of the lockstep contract).
+    tune_seed: int = 0
+    # fleet tuning database written by `python -m tpu_mpi.tune merge`
+    # (schema 2: sample-weighted merge of per-rank pvar dumps + measured
+    # tables). Consulted by select() after tune_table, before the
+    # heuristic; "" = no database layer.
+    tune_db: str = ""
+    # test/debug latency shim: comma list of coll:algo=microseconds added
+    # to the measured op span (e.g. "allreduce:star=2000" slows the star
+    # arm) so bandit convergence is deterministic under test; "" = off.
+    tune_shim: str = ""
     # same-host shared-memory collective fold (the libmpi coll/sm analog):
     # Allreduce payloads strictly below this many bytes — and Barrier —
     # use one mmap'd /dev/shm segment per communicator instead of O(P)
@@ -149,6 +175,12 @@ _ENV_MAP = {
     "trace_buffer": "TPU_MPI_TRACE_BUFFER",
     "tune_table": "TPU_MPI_TUNE_TABLE",
     "coll_algo": "TPU_MPI_COLL_ALGO",
+    "tune_explore": "TPU_MPI_TUNE_EXPLORE",
+    "tune_min_samples": "TPU_MPI_TUNE_MIN_SAMPLES",
+    "tune_swap_period": "TPU_MPI_TUNE_SWAP_PERIOD",
+    "tune_seed": "TPU_MPI_TUNE_SEED",
+    "tune_db": "TPU_MPI_TUNE_DB",
+    "tune_shim": "TPU_MPI_TUNE_SHIM",
     "coll_shm_max_bytes": "TPU_MPI_COLL_SHM_MAX_BYTES",
     "registered_buffers": "TPU_MPI_REGISTERED_BUFFERS",
     "pvars": "TPU_MPI_PVARS",

@@ -243,7 +243,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     if argv[:1] == ["--tune"]:
         # `tpurun --tune [...]` — the collective-algorithm autotuner
         # (tpu_mpi.tune): sweep the portfolio on this substrate and write
-        # a tuning table. All following args belong to the tuner.
+        # a tuning table; `--tune merge` folds pvar dumps + tables into
+        # the shared fleet database, `--tune sentinel` replays committed
+        # artifacts as a regression check, and `--tune --online <dumps>`
+        # reports the online bandit's exploration. All following args
+        # belong to the tuner.
         from . import tune
         return tune.main(argv[1:])
     if argv[:1] == ["--stats"]:

@@ -116,7 +116,8 @@ class CommPvars:
     __slots__ = ("rank", "cid", "size", "bytes_sent", "bytes_recv", "sends",
                  "recvs", "wait_ns", "ops", "times", "phase_ns", "rma",
                  "hist", "pipe_ops", "pipe_chunks", "pipe_fold_ns",
-                 "pipe_wait_ns")
+                 "pipe_wait_ns", "explore_calls", "explore_explored",
+                 "table_swaps", "last_swap_gen")
 
     def __init__(self, rank: int, cid: int):
         self.rank = rank
@@ -141,6 +142,13 @@ class CommPvars:
         self.pipe_chunks = 0
         self.pipe_fold_ns = 0
         self.pipe_wait_ns = 0
+        # online bandit autotuner (tpu_mpi.tune_online): decisions seen,
+        # decisions routed to an alternate arm, hot-swaps performed on this
+        # comm, and the config generation of the last swap.
+        self.explore_calls = 0
+        self.explore_explored = 0
+        self.table_swaps = 0
+        self.last_swap_gen = 0
 
     def snapshot(self) -> dict:
         bins = max(4, int(config.load().pvars_hist_bins))
@@ -168,6 +176,15 @@ class CommPvars:
                 # fully hidden behind compute); 0.0 = fully serial
                 "overlap_fraction": (round(self.pipe_fold_ns / pipe_busy, 4)
                                      if pipe_busy else None),
+            },
+            "explore": {
+                "calls": self.explore_calls,
+                "explored": self.explore_explored,
+                "fraction": (round(self.explore_explored
+                                   / self.explore_calls, 4)
+                             if self.explore_calls else None),
+                "table_swaps": self.table_swaps,
+                "last_swap_gen": self.last_swap_gen,
             },
         }
 
@@ -241,6 +258,15 @@ def op_end(sc: _OpScope, comm: Any = None, coll: Optional[str] = None,
     """Close the scope: stamp the op's trace event (t_start/t_end/phases)
     and fold duration + spans into the per-comm counters."""
     _tls.scope = None
+    shim = _shim_map()
+    if shim and coll is not None:
+        # test/debug latency shim (config.tune_shim): the sleep lands
+        # BEFORE t1 so it is part of the measured span and is attributed
+        # to this (coll, algo) arm — the knob the bandit-convergence tests
+        # use to make one arm deterministically lose.
+        pause = shim.get((coll, algo or "star"))
+        if pause:
+            time.sleep(pause)
     t1 = monotonic()
     ev = sc.ev
     if ev is not None:
@@ -277,6 +303,39 @@ def op_end(sc: _OpScope, comm: Any = None, coll: Optional[str] = None,
             hist = acct.hist[coll] = [0] * bins
         idx = (dur_ns // 1000).bit_length()   # log2 bucket of the µs latency
         hist[min(idx, len(hist) - 1)] += 1
+
+
+# -- test/debug latency shim (config.tune_shim) ------------------------------
+
+_shim_cache: Tuple[Any, Optional[Dict[Tuple[str, str], float]]] = (_UNSET, None)
+
+
+def _shim_map() -> Optional[Dict[Tuple[str, str], float]]:
+    """Parsed ``tune_shim`` spec ("coll:algo=microseconds,...") as
+    {(coll, algo): seconds}, or None when unset. Generation-cached: the
+    default (empty) spec costs one tuple compare per op."""
+    global _shim_cache
+    cached_gen, val = _shim_cache
+    if cached_gen == config.GENERATION:
+        return val
+    spec = config.load().tune_shim
+    out: Optional[Dict[Tuple[str, str], float]] = None
+    if spec:
+        out = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, us = part.partition("=")
+            coll, _, algo = key.partition(":")
+            try:
+                out[(coll.strip(), (algo or "star").strip())] = \
+                    float(us) / 1e6
+            except ValueError:
+                pass
+        out = out or None
+    _shim_cache = (config.GENERATION, out)
+    return out
 
 
 def payload_nbytes(contrib: Any) -> Optional[int]:
@@ -382,6 +441,46 @@ def note_pipelined(cid: int, nchunks: int, fold_ns: int,
         acct.pipe_chunks += int(nchunks)
         acct.pipe_fold_ns += int(fold_ns)
         acct.pipe_wait_ns += int(wait_after_first_ns)
+
+
+def note_explore(comm: Any, explored: bool) -> None:
+    """One online-autotuner decision on this comm (tpu_mpi.tune_online):
+    ``explored`` when the call was routed to an alternate arm."""
+    acct = _acct(comm)
+    if acct is None:
+        return
+    with _store_lock:
+        acct.explore_calls += 1
+        if explored:
+            acct.explore_explored += 1
+
+
+def note_swap(comm: Any, generation: int) -> None:
+    """One online table hot-swap on this comm."""
+    acct = _acct(comm)
+    if acct is None:
+        return
+    with _store_lock:
+        acct.table_swaps += 1
+        acct.last_swap_gen = int(generation)
+
+
+def arm_stats(comm: Any) -> List[Tuple[str, str, int, int, int]]:
+    """This rank's accumulated latency stats on one comm as
+    ``(coll, algo, nbytes, count, total_ns)`` rows — the payload the
+    online autotuner's lockstep swap round allgathers so that every rank
+    merges the IDENTICAL cross-rank arm statistics."""
+    from ._runtime import current_env
+    env = current_env()
+    if env is None:
+        return []
+    key = (env[1], comm.cid)
+    with _store_lock:
+        acct = _store.get(key)
+        if acct is None:
+            return []
+        return [(c, a, b, t[0], t[1])
+                for (c, a, b), t in sorted(acct.times.items())]
 
 
 # ---------------------------------------------------------------------------

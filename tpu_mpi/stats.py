@@ -19,6 +19,7 @@ import sys
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import perfvars
+from . import tune
 
 _BAR = 30    # histogram bar width (characters at the largest bucket)
 
@@ -33,6 +34,9 @@ def aggregate(records: Sequence[dict]) -> dict:
            "wait_s": 0.0}
     pipe = {"ops": 0, "chunks": 0, "fold_s": 0.0, "wait_after_first_s": 0.0}
     plan = {"hits": 0, "misses": 0}
+    explore = {"calls": 0, "explored": 0, "table_swaps": 0,
+               "last_swap_gen": 0}
+    arm_counts: Dict[Tuple[str, str], int] = {}
     nranks = set()
     for rec in records:
         pc = rec.get("plan_cache") or {}
@@ -49,8 +53,19 @@ def aggregate(records: Sequence[dict]) -> dict:
             pl = comm.get("pipeline") or {}
             for k in pipe:
                 pipe[k] += pl.get(k, 0)
+            ex = comm.get("explore") or {}
+            explore["calls"] += int(ex.get("calls") or 0)
+            explore["explored"] += int(ex.get("explored") or 0)
+            explore["table_swaps"] = max(explore["table_swaps"],
+                                         int(ex.get("table_swaps") or 0))
+            explore["last_swap_gen"] = max(explore["last_swap_gen"],
+                                           int(ex.get("last_swap_gen") or 0))
             for t in comm.get("times", ()):
                 key = (t["coll"], t["algo"], int(t["nbytes"]))
+                if t["coll"] in tune.PORTFOLIO:
+                    # arm view skips internal rendezvous (e.g. TuneSwap)
+                    ak = (t["coll"], t["algo"])
+                    arm_counts[ak] = arm_counts.get(ak, 0) + int(t["count"])
                 ent = colls.setdefault(key, [0.0, 0.0, float("inf"), 0.0])
                 ent[0] += t["count"]
                 ent[1] += t["total_s"]
@@ -69,6 +84,10 @@ def aggregate(records: Sequence[dict]) -> dict:
         "totals": tot, "plan_cache": plan, "pipeline": pipe,
         "overlap_fraction": (round(pipe["fold_s"] / busy, 4) if busy
                              else None),
+        "explore": explore,
+        "explore_fraction": (round(explore["explored"] / explore["calls"], 4)
+                             if explore["calls"] else None),
+        "arm_counts": arm_counts,
     }
 
 
@@ -142,6 +161,17 @@ def render(agg: dict, out=None) -> None:
         w(f"chunk pipeline: {int(p['ops'])} ops / {int(p['chunks'])} chunks, "
           f"overlap fraction {agg['overlap_fraction']:.3f} "
           f"(1.0 = transfers fully hidden behind folds)\n")
+    ex = agg.get("explore") or {}
+    if ex.get("calls"):
+        w(f"\nonline tuning: {ex['calls']} decision-point calls, "
+          f"{ex['explored']} explored "
+          f"({agg['explore_fraction']:.1%}), "
+          f"{ex['table_swaps']} table swaps"
+          + (f" (last at config generation {ex['last_swap_gen']})"
+             if ex["table_swaps"] else "") + "\n")
+        w("  per-arm samples: " + "  ".join(
+            f"{c}/{a}={n}" for (c, a), n in sorted(agg["arm_counts"].items()))
+          + "\n")
 
 
 def _launch_and_collect(launch_args: List[str]) -> List[dict]:
@@ -201,6 +231,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                "totals": agg["totals"], "rma": agg["rma"],
                "plan_cache": agg["plan_cache"], "pipeline": agg["pipeline"],
                "overlap_fraction": agg["overlap_fraction"],
+               "explore": agg["explore"],
+               "explore_fraction": agg["explore_fraction"],
+               "arm_counts": {f"{c}|{a}": n
+                              for (c, a), n in sorted(
+                                  agg["arm_counts"].items())},
                "nranks": agg["nranks"]}
         if args.json == "-":
             json.dump(rec, sys.stdout, indent=1)

@@ -49,7 +49,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from . import config
 
 __all__ = ["PORTFOLIO", "eligible", "candidates", "select", "heuristic",
-           "parse_override", "load_table", "write_table", "autotune", "main"]
+           "parse_override", "load_table", "write_table", "autotune",
+           "merge_db", "main"]
 
 
 # Every algorithm the proc-tier engine implements, per collective. "star"
@@ -178,7 +179,11 @@ def parse_override(spec: str) -> Dict[str, str]:
 # crossover points, so at every measured (size, nranks) the table selects
 # the argmin algorithm exactly.
 
-_table_cache: Tuple[Any, Any, Dict] = (None, None, {})
+# per-path (mtime, table) cache — a dict, not a single slot, because the
+# table layer and the fleet database (config.tune_db) are consulted on the
+# same select() call and a one-slot cache would thrash between them
+_table_cache: Dict[str, Tuple[Any, Dict]] = {}
+_TABLE_CACHE_CAP = 8
 _table_warned: set = set()
 
 
@@ -230,7 +235,6 @@ def load_table(path: str) -> Dict[Tuple[str, int], List[Tuple[int, str]]]:
     """Load (and cache on mtime) a tuning table. A missing or malformed
     file disables the table layer with a one-time warning — the heuristic
     still serves, a bad table never takes the job down."""
-    global _table_cache
     path = os.path.expanduser(path)
     try:
         mtime = os.stat(path).st_mtime_ns
@@ -240,8 +244,9 @@ def load_table(path: str) -> Dict[Tuple[str, int], List[Tuple[int, str]]]:
             print(f"tpu_mpi: tuning table {path!r} not readable; "
                   f"using the built-in heuristic", file=sys.stderr)
         return {}
-    if _table_cache[0] == path and _table_cache[1] == mtime:
-        return _table_cache[2]
+    hit = _table_cache.get(path)
+    if hit is not None and hit[0] == mtime:
+        return hit[1]
     table: Dict[Tuple[str, int], List[Tuple[int, str]]] = {}
     try:
         raw = _read_table_toml(path)
@@ -264,7 +269,9 @@ def load_table(path: str) -> Dict[Tuple[str, int], List[Tuple[int, str]]]:
             print(f"tpu_mpi: tuning table {path!r} unusable ({e}); "
                   f"using the built-in heuristic", file=sys.stderr)
         table = {}
-    _table_cache = (path, mtime, table)
+    while len(_table_cache) >= _TABLE_CACHE_CAP:
+        _table_cache.pop(next(iter(_table_cache)))
+    _table_cache[path] = (mtime, table)
     return table
 
 
@@ -288,20 +295,29 @@ def write_table(path: str,
     os.replace(tmp, path)
 
 
+def _nearest_nranks(ns: Sequence[int], nranks: int) -> int:
+    """The measured communicator size a query interpolates to: exact match,
+    else the nearest measured size below (libmpi decision tables
+    interpolate the same way), CLAMPED at the table edges — queries below
+    the smallest measured size use the smallest, queries above the largest
+    use the largest. No extrapolation: an n=3 query against a table
+    measured at {4, 8} must not invent an unmeasured regime, and an n=16
+    query against the same table pins to n=8."""
+    if nranks in ns:
+        return nranks
+    below = [n for n in ns if n < nranks]
+    return below[-1] if below else min(ns)
+
+
 def _table_lookup(table: Dict[Tuple[str, int], List[Tuple[int, str]]],
                   coll: str, nranks: int,
                   nbytes: Optional[int]) -> Optional[str]:
-    """The table's pick: exact nranks entry, else the nearest measured
-    communicator size below (libmpi decision tables interpolate the same
-    way), else the smallest above."""
+    """The table's pick for one (coll, nranks, nbytes) query, via
+    :func:`_nearest_nranks` interpolation over the measured sizes."""
     ns = sorted(n for (c, n) in table if c == coll)
     if not ns:
         return None
-    if nranks in ns:
-        n = nranks
-    else:
-        below = [n for n in ns if n < nranks]
-        n = below[-1] if below else ns[0]
+    n = _nearest_nranks(ns, nranks)
     size = 0 if nbytes is None else int(nbytes)
     # order-independent walk: loaded tables arrive descending-sorted, but
     # the in-memory table from _crossovers is built ascending
@@ -357,10 +373,15 @@ def select(coll: str, nranks: int, nbytes: Optional[int] = None, *,
            shm: bool = False, numeric: bool = True) -> str:
     """THE algorithm decision for one collective signature.
 
-    Resolution: force-override → measured table → heuristic, each clamped
-    by :func:`eligible`. Called once per plan signature (the result is
-    cached inside the CollectivePlan); must stay deterministic across
-    ranks for fixed rank-uniform inputs + uniform config.
+    Resolution: force-override → online hot-swap table (the in-memory
+    table the bandit loop recomputes from live arm stats,
+    :mod:`tpu_mpi.tune_online`) → measured table → fleet database
+    (``config.tune_db``, written by ``tune merge``) → heuristic, each
+    clamped by :func:`eligible`. Called once per plan signature (the
+    result is cached inside the CollectivePlan); must stay deterministic
+    across ranks for fixed rank-uniform inputs + uniform config — the
+    online table satisfies this because every rank derives it from the
+    SAME merged cross-rank stats in a lockstep swap round.
     """
     if nranks < 2:
         return "star"
@@ -373,8 +394,19 @@ def select(coll: str, nranks: int, nbytes: Optional[int] = None, *,
     forced = parse_override(cfg.coll_algo).get(coll)
     if forced is not None and ok(forced):
         return forced
+    if cfg.tune_explore > 0.0:
+        from . import tune_online
+        online = tune_online.table()
+        if online:
+            algo = _table_lookup(online, coll, nranks, nbytes)
+            if algo is not None and ok(algo):
+                return algo
     if cfg.tune_table:
         algo = _table_lookup(load_table(cfg.tune_table), coll, nranks, nbytes)
+        if algo is not None and ok(algo):
+            return algo
+    if cfg.tune_db:
+        algo = _table_lookup(load_table(cfg.tune_db), coll, nranks, nbytes)
         if algo is not None and ok(algo):
             return algo
     return heuristic(coll, nranks, nbytes, commutative=commutative,
@@ -532,12 +564,21 @@ def _crossovers(rows: List[dict]) -> Dict[Tuple[str, int],
     return best
 
 
-def rows_from_pvars(records: Sequence[dict]) -> List[dict]:
+def rows_from_pvars(records: Sequence[dict],
+                    min_samples: Optional[int] = None,
+                    skipped: Optional[List[Tuple]] = None) -> List[dict]:
     """Measured rows (the autotune sweep's row schema) from pvar dump
     records (``perfvars.snapshot``): mean latency per (collective, world
     size, payload bytes, algorithm) aggregated across ranks and comms. The
     production workload's own counters become tuning input — the table is
-    fed by the same measurements it will later be judged against."""
+    fed by the same measurements it will later be judged against.
+
+    Cells with fewer than ``min_samples`` observations (default
+    ``config.tune_min_samples``) are dropped — a single cold-start outlier
+    must not set a crossover. Pass a list as ``skipped`` to collect the
+    dropped (coll, nranks, nbytes, algo) keys."""
+    if min_samples is None:
+        min_samples = max(1, int(config.load().tune_min_samples))
     acc: Dict[Tuple[str, int, int, str], List[float]] = {}
     for rec in records:
         for comm in rec.get("comms", ()):
@@ -545,14 +586,27 @@ def rows_from_pvars(records: Sequence[dict]) -> List[dict]:
             if n < 2:
                 continue
             for t in comm.get("times", ()):
+                # non-portfolio names (internal rendezvous like the online
+                # tuner's own TuneSwap round) are not tunable cells
+                if t["coll"] not in PORTFOLIO:
+                    continue
                 nbytes = int(t["nbytes"])
                 key = (t["coll"], n, max(0, nbytes), t["algo"])
                 ent = acc.setdefault(key, [0.0, 0.0])
                 ent[0] += t["count"]
                 ent[1] += t["total_s"]
-    return [{"coll": c, "nranks": n, "bytes": b, "algo": a,
-             "lat_us": round(tot / cnt * 1e6, 3)}
-            for (c, n, b, a), (cnt, tot) in sorted(acc.items()) if cnt]
+    rows = []
+    for (c, n, b, a), (cnt, tot) in sorted(acc.items()):
+        if not cnt:
+            continue
+        if cnt < min_samples:
+            if skipped is not None:
+                skipped.append((c, n, b, a, int(cnt)))
+            continue
+        rows.append({"coll": c, "nranks": n, "bytes": b, "algo": a,
+                     "count": int(cnt),
+                     "lat_us": round(tot / cnt * 1e6, 3)})
+    return rows
 
 
 def table_from_pvars(paths: Sequence[str],
@@ -564,17 +618,456 @@ def table_from_pvars(paths: Sequence[str],
     table refines, not replaces, a sweep-built one."""
     from . import perfvars
     records = perfvars.load_dumps(paths)
-    rows = rows_from_pvars(records)
+    skipped: List[Tuple] = []
+    rows = rows_from_pvars(records, skipped=skipped)
     table = _crossovers(rows)
     rec = {"bench": "coll_algos_from_pvars", "rows": rows,
            "table": {f"{c}.n{n}": {str(th): algo for th, algo in ent}
                      for (c, n), ent in table.items()},
+           "min_samples": max(1, int(config.load().tune_min_samples)),
+           "skipped_cells": len(skipped),
+           "skipped": [{"coll": c, "nranks": n, "bytes": b, "algo": a,
+                        "count": cnt} for c, n, b, a, cnt in skipped],
            "sources": [r["_path"] for r in records]}
     if out_table:
         write_table(out_table, table,
                     header=f"from pvar dumps: {len(records)} ranks")
         rec["table_path"] = os.path.expanduser(out_table)
     return rec
+
+
+# ---------------------------------------------------------------------------
+# Fleet database (schema 2): shared crossover ladders + the samples behind
+# them
+# ---------------------------------------------------------------------------
+
+# DB shape on disk — a schema-1 table every existing consumer can load
+# as-is (the ladder sections are byte-identical and load_table skips
+# unknown top-level keys), plus the evidence behind the ladders:
+#
+#   schema = 2
+#   [allreduce.n4]
+#   "0" = "shm"
+#   [meta]
+#   topology = "single-host/x86_64"
+#   [provenance.s0]
+#   source = "pvars-rank0.json"
+#   kind = "pvars"
+#   [samples.allreduce.n4.shm]
+#   "1024" = "32:41.5"              # observation count : mean latency (us)
+#
+# Keeping raw (count, mean) cells makes re-merges sample-count-weighted by
+# construction: a node contributing 1000 observations of a cell outweighs
+# one contributing 10, and folding the same DB again is idempotent on the
+# ladders. The [meta] topology string is the database's fleet key — merge
+# refuses nothing, but stamps what substrate the numbers describe so a DB
+# measured on TCP loopback is not silently trusted on a real fabric.
+
+
+def _db_read(path: str) -> Tuple[Dict[Tuple[str, int, int, str], List[float]],
+                                 List[dict], Dict]:
+    """(samples, provenance, meta) from an existing fleet DB, for
+    incremental re-merges; all-empty when the file is absent or predates
+    schema 2 (plain tables contribute ladders via the overlay path, not
+    samples)."""
+    samples: Dict[Tuple[str, int, int, str], List[float]] = {}
+    prov: List[dict] = []
+    meta: Dict = {}
+    try:
+        raw = _read_table_toml(os.path.expanduser(path))
+    except Exception:
+        return samples, prov, meta
+    meta = dict(raw.get("meta") or {})
+    pv = raw.get("provenance") or {}
+    for skey in sorted(pv, key=str):
+        if isinstance(pv[skey], dict):
+            prov.append(dict(pv[skey]))
+    for coll, per_n in (raw.get("samples") or {}).items():
+        if coll not in PORTFOLIO or not isinstance(per_n, dict):
+            continue
+        for nkey, per_algo in per_n.items():
+            if not (isinstance(per_algo, dict) and str(nkey).startswith("n")):
+                continue
+            n = int(str(nkey)[1:])
+            for algo, cells in per_algo.items():
+                if algo not in PORTFOLIO[coll] or not isinstance(cells, dict):
+                    continue
+                for bkey, val in cells.items():
+                    cnt_s, _, mean_s = str(val).partition(":")
+                    try:
+                        cnt, mean = int(cnt_s), float(mean_s)
+                    except ValueError:
+                        continue
+                    ent = samples.setdefault((coll, n, int(bkey), algo),
+                                             [0, 0.0])
+                    ent[0] += cnt
+                    ent[1] += cnt * mean
+    return samples, prov, meta
+
+
+def _write_db(path: str,
+              samples: Dict[Tuple[str, int, int, str], List[float]],
+              overlay: Dict[Tuple[str, int], List[Tuple[int, str]]],
+              provenance: List[dict], meta: Dict,
+              min_samples: int) -> dict:
+    """Derive the ladders from the merged samples (min-samples guard
+    applied per cell), overlay sample-less measured-table ladders for
+    (coll, nranks) keys the samples don't cover, and persist the schema-2
+    DB atomically. Returns the merge record."""
+    rows: List[dict] = []
+    skipped: List[Tuple] = []
+    for (c, n, b, a), (cnt, tot_us) in sorted(samples.items()):
+        if cnt < min_samples:
+            skipped.append((c, n, b, a, int(cnt)))
+            continue
+        rows.append({"coll": c, "nranks": n, "bytes": b, "algo": a,
+                     "count": int(cnt), "lat_us": round(tot_us / cnt, 3)})
+    table = _crossovers(rows)
+    overlaid = []
+    for k, ent in sorted(overlay.items()):
+        if k not in table:
+            table[k] = list(ent)
+            overlaid.append(f"{k[0]}.n{k[1]}")
+
+    path = os.path.expanduser(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    lines = ["# tpu_mpi fleet tuning database (python -m tpu_mpi.tune merge)",
+             "schema = 2"]
+    for (coll, n) in sorted(table):
+        lines.append(f"\n[{coll}.n{n}]")
+        for th, algo in sorted(table[(coll, n)]):
+            lines.append(f'"{th}" = "{algo}"')
+    lines.append("\n[meta]")
+    for k in sorted(meta):
+        v = meta[k]
+        lines.append(f"{k} = {v}" if isinstance(v, int)
+                     else f'{k} = "{v}"')
+    for i, ent in enumerate(provenance):
+        lines.append(f"\n[provenance.s{i}]")
+        for k in sorted(ent):
+            v = ent[k]
+            lines.append(f"{k} = {v}" if isinstance(v, int)
+                         else f'{k} = "{v}"')
+    by_sec: Dict[Tuple[str, int, str], List[Tuple[int, int, float]]] = {}
+    for (c, n, b, a), (cnt, tot_us) in samples.items():
+        by_sec.setdefault((c, n, a), []).append((b, int(cnt), tot_us / cnt))
+    for (c, n, a) in sorted(by_sec):
+        lines.append(f"\n[samples.{c}.n{n}.{a}]")
+        for b, cnt, mean in sorted(by_sec[(c, n, a)]):
+            lines.append(f'"{b}" = "{cnt}:{round(mean, 3)}"')
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.replace(tmp, path)
+
+    return {"bench": "tune_merge", "db_path": path,
+            "schema": 2, "meta": dict(meta),
+            "min_samples": min_samples,
+            "cells": len(samples), "rows": rows,
+            "skipped_cells": len(skipped),
+            "skipped": [{"coll": c, "nranks": n, "bytes": b, "algo": a,
+                         "count": cnt} for c, n, b, a, cnt in skipped],
+            "overlaid": overlaid,
+            "table": {f"{c}.n{n}": {str(th): algo for th, algo in ent}
+                      for (c, n), ent in table.items()},
+            "provenance": provenance}
+
+
+def merge_db(out_path: str, pvar_paths: Sequence[str] = (),
+             table_paths: Sequence[str] = (),
+             min_samples: Optional[int] = None,
+             topology: Optional[str] = None) -> dict:
+    """Fold per-rank pvar dumps and measured tuning tables into one shared
+    fleet database at ``out_path`` (``select()`` loads it through
+    ``config.tune_db`` with the same nearest-nranks interpolation as the
+    per-job table). An existing DB at the path is folded back in first, so
+    repeated merges accumulate fleet evidence instead of overwriting it;
+    measured v1 tables carry no samples and contribute their ladders only
+    where the samples are silent."""
+    from . import perfvars
+    if min_samples is None:
+        min_samples = max(1, int(config.load().tune_min_samples))
+    out_path = os.path.expanduser(out_path)
+    samples, prov, meta = (_db_read(out_path) if os.path.exists(out_path)
+                           else ({}, [], {}))
+    if topology is not None:
+        meta["topology"] = topology
+    elif not meta.get("topology"):
+        meta["topology"] = f"single-host/{os.uname().machine}"
+    meta["merged_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+    records = perfvars.load_dumps(pvar_paths) if pvar_paths else []
+    for rec in records:
+        ncomms = 0
+        for comm in rec.get("comms", ()):
+            n = int(comm.get("size") or 0)
+            if n < 2:
+                continue
+            ncomms += 1
+            for t in comm.get("times", ()):
+                coll, algo = t["coll"], t["algo"]
+                if coll not in PORTFOLIO or algo not in PORTFOLIO[coll]:
+                    continue
+                key = (coll, n, max(0, int(t["nbytes"])), algo)
+                ent = samples.setdefault(key, [0, 0.0])
+                ent[0] += int(t["count"])
+                ent[1] += float(t["total_s"]) * 1e6
+        prov.append({"source": os.path.basename(rec["_path"]),
+                     "kind": "pvars", "comms": ncomms})
+    overlay: Dict[Tuple[str, int], List[Tuple[int, str]]] = {}
+    for tp in table_paths:
+        t = load_table(tp)
+        for k, ent in t.items():
+            overlay.setdefault(k, list(ent))
+        prov.append({"source": os.path.basename(os.path.expanduser(tp)),
+                     "kind": "table", "entries": len(t)})
+    return _write_db(out_path, samples, overlay, prov, meta, min_samples)
+
+
+def merge_main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI: ``python -m tpu_mpi.tune merge`` / ``tpurun --tune merge``."""
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_mpi.tune merge",
+        description="Fold per-rank pvar dumps and measured tuning tables "
+                    "into one shared fleet database (schema 2, "
+                    "sample-count-weighted), loaded by select() via "
+                    "TPU_MPI_TUNE_DB.")
+    p.add_argument("sources", nargs="*", metavar="PVAR_DUMP",
+                   help="pvar dump files/dirs (TPU_MPI_PVARS_DUMP output)")
+    p.add_argument("--table", action="append", default=[], metavar="TOML",
+                   help="measured tuning table to fold in (ladder overlay "
+                        "for (coll, nranks) keys without samples); repeat "
+                        "for several")
+    p.add_argument("-o", "--out", default=None,
+                   help="fleet DB path (default: $TPU_MPI_TUNE_DB or "
+                        "~/.config/tpu_mpi/tune-db.toml)")
+    p.add_argument("--min-samples", type=int, default=None,
+                   help="noise guard: drop cells with fewer observations "
+                        "(default $TPU_MPI_TUNE_MIN_SAMPLES)")
+    p.add_argument("--topology", default=None,
+                   help="fleet key stamped into [meta] (default: keep the "
+                        "existing DB's, else single-host/<machine>)")
+    p.add_argument("--json", default=None,
+                   help="also write the merge record as JSON")
+    args = p.parse_args(argv)
+    if not args.sources and not args.table:
+        p.error("nothing to merge: give pvar dumps and/or --table files")
+    out = (args.out or config.load().tune_db
+           or os.path.join("~", ".config", "tpu_mpi", "tune-db.toml"))
+    rec = merge_db(out, args.sources, args.table,
+                   min_samples=args.min_samples, topology=args.topology)
+    if args.json:
+        with open(os.path.expanduser(args.json), "w") as f:
+            json.dump(rec, f, indent=1)
+    print(f"tune merge: {rec['db_path']} <- {len(rec['provenance'])} "
+          f"sources, {rec['cells']} sample cells "
+          f"({rec['skipped_cells']} below min_samples="
+          f"{rec['min_samples']}), topology {rec['meta']['topology']}")
+    for sect, ladder in sorted(rec["table"].items()):
+        tag = " (overlay)" if sect in rec["overlaid"] else ""
+        print(f"  [{sect}]{tag} " + "  ".join(
+            f"{th}B->{algo}" for th, algo in sorted(
+                ladder.items(), key=lambda kv: int(kv[0]))))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Regression sentinel: does the committed table still win here?
+# ---------------------------------------------------------------------------
+
+def sentinel_main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI: ``python -m tpu_mpi.tune sentinel`` — re-measure the committed
+    tuning artifacts' points on the current runner (best-of-N repeats to
+    suppress scheduler noise) and fail when the committed table's selection
+    loses to an eligible alternate by more than the threshold, printing the
+    offending cells. CI runs this against the committed cpusim artifacts so
+    a substrate drift that invalidates them fails loudly instead of
+    silently serving a stale table."""
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_mpi.tune sentinel",
+        description="Replay the committed tuning artifacts and fail when a "
+                    "committed selection loses to an eligible alternate on "
+                    "this runner.")
+    p.add_argument("--table", default="benchmarks/results/tune-cpusim.toml",
+                   help="committed tuning table to judge")
+    p.add_argument("--record", default="benchmarks/results/"
+                                       "coll-algos-cpusim.json",
+                   help="committed sweep record naming the measured points")
+    p.add_argument("--threshold", type=float, default=1.10,
+                   help="fail ratio, committed selection vs best measured "
+                        "(default 1.10 = loses by >10%%)")
+    p.add_argument("--repeat", type=int, default=3,
+                   help="best-of-N sweep repeats per world size (noise "
+                        "suppression; default 3)")
+    p.add_argument("--nranks", default=None,
+                   help="restrict to these world sizes (comma list; "
+                        "default: every size in the record)")
+    p.add_argument("--max-points", type=int, default=0,
+                   help="cap (coll, size) points per world size (0 = all)")
+    p.add_argument("--scale", type=float, default=0.5,
+                   help="iteration-count multiplier per point (default 0.5)")
+    p.add_argument("--json", default=None,
+                   help="also write the sentinel record as JSON")
+    args = p.parse_args(argv)
+
+    committed = load_table(args.table)
+    if not committed:
+        print(f"tune sentinel: no committed table at {args.table!r}",
+              file=sys.stderr)
+        return 2
+    with open(os.path.expanduser(args.record)) as f:
+        rec = json.load(f)
+    want_n = ([int(x) for x in args.nranks.split(",") if x]
+              if args.nranks else None)
+    pts: Dict[int, Dict[Tuple[str, int], List[str]]] = {}
+    for r in rec.get("rows", []):
+        n = int(r["nranks"])
+        if (want_n and n not in want_n) or r["coll"] not in SWEEP_COLLS:
+            continue
+        algos = pts.setdefault(n, {}).setdefault(
+            (r["coll"], int(r["bytes"])), [])
+        if r["algo"] not in algos:
+            algos.append(r["algo"])
+    if not pts:
+        print("tune sentinel: record names no replayable points",
+              file=sys.stderr)
+        return 2
+
+    best_lat: Dict[Tuple[str, int, int, str], float] = {}
+    for n, cells in sorted(pts.items()):
+        points = [[coll, b, algos]
+                  for (coll, b), algos in sorted(cells.items())]
+        if args.max_points:
+            points = points[:args.max_points]
+        for rep in range(max(1, args.repeat)):
+            print(f"tune sentinel: n{n} pass {rep + 1}/{args.repeat} "
+                  f"({len(points)} points) ...", file=sys.stderr)
+            for r in _run_sweep(n, points, args.scale):
+                k = (r["coll"], int(r["nranks"]), int(r["bytes"]), r["algo"])
+                lat = float(r["lat_us"])
+                if k not in best_lat or lat < best_lat[k]:
+                    best_lat[k] = lat
+
+    by_point: Dict[Tuple[str, int, int], Dict[str, float]] = {}
+    for (coll, n, b, a), lat in best_lat.items():
+        by_point.setdefault((coll, n, b), {})[a] = lat
+    offending, checked = [], 0
+    for (coll, n, b), algs in sorted(by_point.items()):
+        picked = _table_lookup(committed, coll, n, b)
+        if picked is None or picked not in algs:
+            continue            # heuristic-governed or unmeasurable here
+        checked += 1
+        best_algo = min(algs, key=algs.get)
+        ratio = algs[picked] / max(algs[best_algo], 1e-9)
+        if ratio > args.threshold:
+            offending.append({"coll": coll, "nranks": n, "bytes": b,
+                              "committed": picked,
+                              "committed_lat_us": round(algs[picked], 2),
+                              "best": best_algo,
+                              "best_lat_us": round(algs[best_algo], 2),
+                              "ratio": round(ratio, 3)})
+    out_rec = {"bench": "tune_sentinel", "table": args.table,
+               "record": args.record, "threshold": args.threshold,
+               "repeat": args.repeat, "checked_cells": checked,
+               "offending": offending}
+    if args.json:
+        with open(os.path.expanduser(args.json), "w") as f:
+            json.dump(out_rec, f, indent=1)
+    if offending:
+        print(f"tune sentinel: FAIL — {len(offending)}/{checked} committed "
+              f"selections lose by >{(args.threshold - 1) * 100:.0f}% on "
+              f"this runner:")
+        for c in offending:
+            print(f"  {c['coll']:<10} n{c['nranks']} {c['bytes']:>9d}B "
+                  f"committed {c['committed']:<13} "
+                  f"{c['committed_lat_us']:>10.1f}us vs best {c['best']} "
+                  f"{c['best_lat_us']:.1f}us (x{c['ratio']})")
+        print("  -> re-run `python -m tpu_mpi.tune` on this runner and "
+              "commit the refreshed artifacts")
+        return 1
+    print(f"tune sentinel: OK — {checked} committed selections hold within "
+          f"{(args.threshold - 1) * 100:.0f}% on this runner")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Online-exploration report (tpurun --tune --online)
+# ---------------------------------------------------------------------------
+
+def _online_report(paths: Sequence[str], json_out: Optional[str] = None,
+                   ) -> int:
+    """What the in-process bandit did, reconstructed from pvar dumps:
+    explored-call fraction through the decision point, per-arm sample
+    counts, table swaps and the last swap's config generation, plus the
+    crossover table the accumulated arms imply (what the next lockstep
+    swap would install)."""
+    from . import perfvars
+    records = perfvars.load_dumps(paths)
+    calls = explored = swaps = 0
+    last_gen = 0
+    for rec in records:
+        for comm in rec.get("comms", ()):
+            ex = comm.get("explore") or {}
+            calls += int(ex.get("calls") or 0)
+            explored += int(ex.get("explored") or 0)
+            swaps = max(swaps, int(ex.get("table_swaps") or 0))
+            last_gen = max(last_gen, int(ex.get("last_swap_gen") or 0))
+    rows = rows_from_pvars(records, min_samples=1)
+    implied = _crossovers(rows_from_pvars(records))
+    rec_out = {"bench": "tune_online_report", "ranks": len(records),
+               "explore": {"calls": calls, "explored": explored,
+                           "fraction": (round(explored / calls, 4)
+                                        if calls else None),
+                           "table_swaps": swaps, "last_swap_gen": last_gen},
+               "arms": rows,
+               "implied_table": {
+                   f"{c}.n{n}": {str(th): algo for th, algo in ent}
+                   for (c, n), ent in implied.items()}}
+    if json_out:
+        with open(os.path.expanduser(json_out), "w") as f:
+            json.dump(rec_out, f, indent=1)
+    frac = f"{explored / calls:.1%}" if calls else "n/a"
+    print(f"online: {len(records)} ranks, {calls} decision-point calls, "
+          f"{explored} explored ({frac}), {swaps} table swaps "
+          f"(last at config generation {last_gen})")
+    if rows:
+        print("arms (count-weighted mean latency):")
+        for r in rows:
+            print(f"  {r['coll']:<10} n{r['nranks']} {r['bytes']:>9d}B "
+                  f"{r['algo']:<13} count={r['count']:<6d} "
+                  f"{r['lat_us']:>10.1f} us")
+    if implied:
+        print("implied table (what the next lockstep swap would install):")
+        for (c, n), ent in sorted(implied.items()):
+            print(f"  [{c}.n{n}] " + "  ".join(
+                f"{th}B->{algo}" for th, algo in sorted(ent)))
+    return 0
+
+
+def _run_sweep(nranks: int, points: list, scale: float) -> List[dict]:
+    """Run the lockstep ``_WORKER`` bench over ``points`` on ``nranks``
+    real child processes and return the measured rows (shared by the
+    autotune sweep and the regression sentinel)."""
+    import tempfile
+    from .launcher import launch_processes
+    with tempfile.TemporaryDirectory(prefix="tpu_mpi_tune_") as td:
+        worker = os.path.join(td, "tune_worker.py")
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(worker, "w") as f:
+            f.write(f"import sys; sys.path.insert(0, {pkg_parent!r})\n"
+                    + _WORKER)
+        spec_path = os.path.join(td, f"spec{nranks}.json")
+        out_path = os.path.join(td, f"rows{nranks}.json")
+        with open(spec_path, "w") as f:
+            json.dump({"scale": scale, "points": points}, f)
+        rc = launch_processes(worker, nranks,
+                              script_args=[spec_path, out_path], sim=1)
+        if rc != 0:
+            raise RuntimeError(f"tune sweep on {nranks} ranks exited {rc}")
+        with open(out_path) as f:
+            return json.load(f)
 
 
 def autotune(nranks_list: Sequence[int] = (2, 4, 8),
@@ -585,33 +1078,15 @@ def autotune(nranks_list: Sequence[int] = (2, 4, 8),
              out_json: Optional[str] = None,
              verbose: bool = True) -> dict:
     """Run the sweep, write the tuning table, return the full record."""
-    import tempfile
-    from .launcher import launch_processes
-
     t_start = time.time()
     rows: List[dict] = []
-    with tempfile.TemporaryDirectory(prefix="tpu_mpi_tune_") as td:
-        worker = os.path.join(td, "tune_worker.py")
-        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        with open(worker, "w") as f:
-            f.write(f"import sys; sys.path.insert(0, {pkg_parent!r})\n"
-                    + _WORKER)
-        for n in nranks_list:
-            spec = {"scale": scale, "points": _sweep_spec(n, sizes, colls)}
-            spec_path = os.path.join(td, f"spec{n}.json")
-            out_path = os.path.join(td, f"rows{n}.json")
-            with open(spec_path, "w") as f:
-                json.dump(spec, f)
-            if verbose:
-                npts = sum(len(p[2]) for p in spec["points"])
-                print(f"tune: sweeping {npts} (coll, size, algo) points "
-                      f"on {n} ranks ...", file=sys.stderr)
-            rc = launch_processes(worker, n, script_args=[spec_path, out_path],
-                                  sim=1)
-            if rc != 0:
-                raise RuntimeError(f"tune sweep on {n} ranks exited {rc}")
-            with open(out_path) as f:
-                rows.extend(json.load(f))
+    for n in nranks_list:
+        points = _sweep_spec(n, sizes, colls)
+        if verbose:
+            npts = sum(len(p[2]) for p in points)
+            print(f"tune: sweeping {npts} (coll, size, algo) points "
+                  f"on {n} ranks ...", file=sys.stderr)
+        rows.extend(_run_sweep(n, points, scale))
 
     table = _crossovers(rows)
     # selection audit: what the freshly-written table picks at every
@@ -655,8 +1130,15 @@ def autotune(nranks_list: Sequence[int] = (2, 4, 8),
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    """CLI: ``python -m tpu_mpi.tune`` / ``tpurun --tune``."""
+    """CLI: ``python -m tpu_mpi.tune`` / ``tpurun --tune``. Subcommands:
+    ``merge`` (fleet database), ``sentinel`` (committed-artifact regression
+    check); default is the measurement sweep."""
     import argparse
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["merge"]:
+        return merge_main(argv[1:])
+    if argv[:1] == ["sentinel"]:
+        return sentinel_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="tpurun --tune",
         description="Measure every collective algorithm on this substrate "
@@ -681,7 +1163,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--from-pvars", nargs="+", default=None, metavar="PATH",
                    help="build the table from pvar dump files/dirs "
                         "(TPU_MPI_PVARS_DUMP output) instead of sweeping")
+    p.add_argument("--online", nargs="+", default=None, metavar="PATH",
+                   help="report the online autotuner's exploration from "
+                        "pvar dumps (explored fraction, per-arm samples, "
+                        "table swaps) instead of sweeping")
     args = p.parse_args(argv)
+
+    if args.online:
+        return _online_report(args.online, json_out=args.json)
 
     if args.from_pvars:
         out_table = (args.out or config.load().tune_table
