@@ -1,0 +1,125 @@
+"""Fault-tolerant Jacobi: the ULFM shrink → restore → continue recipe.
+
+The same Laplace solver as examples/06-jacobi.py, wrapped in the recovery
+loop from docs/fault-tolerance.md: every rank checkpoints its slab every
+CKPT_EVERY sweeps (checkpoint.save_sharded — atomic rename + CRCs, so a
+crash mid-save can never publish a torn file). When a rank dies, the
+failure detector turns the survivors' pending halo exchanges and
+Allreduces into typed ProcFailedError/RevokedError instead of hangs; they
+revoke the communicator, shrink to the survivor set, reassemble the global
+grid from the last checkpoint (reading the dead rank's shard with
+``load_sharded(..., shard=i)``), re-partition it over the smaller world and
+keep sweeping to the SAME tolerance.
+
+Run (no failure — behaves like 06):
+    tpurun --sim 4 examples/11-jacobi-ft.py
+
+Run with an injected failure (rank 1 SIGKILLs itself at sweep 30):
+    TPU_MPI_HEARTBEAT_MS=100 TPU_MPI_FT_KILL_SWEEP=30 \
+        tpurun -n 4 --procs --sim 1 examples/11-jacobi-ft.py
+"""
+
+import os
+import signal
+
+import numpy as np
+
+import tpu_mpi as MPI
+from tpu_mpi import checkpoint
+from tpu_mpi.error import ProcFailedError, RevokedError
+
+N = 64          # global grid is N x N
+TOL = 1e-4
+MAX_SWEEPS = 5000
+CKPT_EVERY = 20
+
+KILL_SWEEP = int(os.environ.get("TPU_MPI_FT_KILL_SWEEP", "-1"))
+KILL_RANK = int(os.environ.get("TPU_MPI_FT_KILL_RANK", "1"))
+
+MPI.Init()
+world = MPI.COMM_WORLD
+world_rank = world.rank()
+# one path per job, identical on every rank (the launcher is the parent)
+CKPT = os.environ.get("TPU_MPI_FT_CKPT",
+                      f"/tmp/jacobi-ft-{os.getppid()}.ckpt")
+
+
+def partition(size: int):
+    counts = [N // size + (1 if i < N % size else 0) for i in range(size)]
+    starts = [0]
+    for c in counts:
+        starts.append(starts[-1] + c)
+    return counts, starts
+
+
+def restore_global(comm):
+    """Reassemble the full grid from the last checkpoint, whatever world
+    size wrote it (each survivor reads every shard — N is small; a large
+    solver would read only the shards its new slab overlaps)."""
+    shards = checkpoint.shard_count(CKPT, comm)
+    blocks, sweep = [], 0
+    for s in range(shards):
+        t = checkpoint.load_sharded(CKPT, comm, shard=s)
+        blocks.append(np.asarray(t["rows"]))
+        sweep = int(np.asarray(t["sweep"])[0])
+    return np.vstack(blocks), sweep
+
+
+grid = np.zeros((N, N))      # interior rows; the hot edge is a halo row
+sweeps = 0
+comm = world
+while True:
+    rank, size = comm.rank(), comm.size()
+    up = rank - 1 if rank > 0 else MPI.PROC_NULL
+    down = rank + 1 if rank < size - 1 else MPI.PROC_NULL
+    counts, starts = partition(size)
+    rows = counts[rank]
+    u = np.zeros((rows + 2, N))
+    u[1:rows + 1] = grid[starts[rank]:starts[rank] + rows]
+    if rank == 0:
+        u[0, :] = 1.0                       # fixed hot top edge
+    try:
+        while sweeps < MAX_SWEEPS:
+            MPI.Sendrecv(u[1], up, 0, u[rows + 1], down, 0, comm)
+            MPI.Sendrecv(u[rows], down, 1, u[0], up, 1, comm)
+
+            new = u[1:rows + 1].copy()
+            new[:, 1:-1] = 0.25 * (u[:rows, 1:-1] + u[2:, 1:-1]
+                                   + u[1:rows + 1, :-2] + u[1:rows + 1, 2:])
+            local_res = float(np.max(np.abs(new - u[1:rows + 1])))
+            u[1:rows + 1] = new
+            sweeps += 1
+
+            res = MPI.Allreduce(local_res, MPI.MAX, comm)
+            if res < TOL:
+                break
+            if sweeps % CKPT_EVERY == 0:
+                checkpoint.save_sharded(
+                    CKPT, {"rows": u[1:rows + 1].copy(),
+                           "sweep": np.array([sweeps])}, comm)
+            if sweeps == KILL_SWEEP and world_rank == KILL_RANK:
+                os.kill(os.getpid(), signal.SIGKILL)
+        break                               # converged (or gave up)
+    except (ProcFailedError, RevokedError) as e:
+        print(f"rank {world_rank}: {type(e).__name__} at sweep {sweeps} — "
+              f"revoking, shrinking, restoring", flush=True)
+        MPI.Comm_revoke(comm)
+        comm = MPI.Comm_shrink(comm)
+        if comm is MPI.COMM_NULL:           # not a survivor
+            MPI.Finalize()
+            raise SystemExit(0)
+        if os.path.exists(CKPT):
+            grid, sweeps = restore_global(comm)
+        else:
+            grid, sweeps = np.zeros((N, N)), 0   # fault before first save
+        continue
+
+rank = comm.rank()
+total_heat = MPI.Reduce(float(u[1:rows + 1].sum()), MPI.SUM, 0, comm)
+if rank == 0:
+    print(f"converged after {sweeps} sweeps on {comm.size()} rank(s) "
+          f"(residual < {TOL}); total heat = {total_heat:.3f}", flush=True)
+    assert sweeps < MAX_SWEEPS, "did not converge"
+    assert total_heat > 0
+print(f"OK-{world_rank}", flush=True)
+MPI.Finalize()

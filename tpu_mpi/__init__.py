@@ -21,7 +21,8 @@ from ._runtime import (ANY_SOURCE, ANY_TAG, PROC_NULL, UNDEFINED,
                        SpmdContext, spmd_run)
 from .error import (AbortError, AnalyzerError, CollectiveMismatchError,
                     DeadlockError, Error_string, Get_error_string,
-                    InvalidCommError, MPIError, TruncationError)
+                    InvalidCommError, MPIError, ProcFailedError, RevokedError,
+                    TruncationError)
 
 # Communication-correctness analysis (docs/analysis.md): static lint,
 # cross-rank trace verifier, RMA race detector.
@@ -37,10 +38,11 @@ from .environment import (Abort, Finalize, Finalized, Init, Init_thread,
 
 # Communicators (src/comm.jl)
 from .comm import (COMM_NULL, COMM_SELF, COMM_TYPE_SHARED, COMM_WORLD,
-                   CONGRUENT, Comm, Comm_compare, Comm_dup, Comm_get_parent,
-                   Comm_rank, Comm_size, Comm_spawn, Comm_split,
-                   Comm_split_type, Comparison, IDENT, Intercomm,
-                   Intercomm_merge, ROOT, SIMILAR, UNEQUAL, free, spawn_argv)
+                   CONGRUENT, Comm, Comm_agree, Comm_compare, Comm_dup,
+                   Comm_get_parent, Comm_rank, Comm_revoke, Comm_shrink,
+                   Comm_size, Comm_spawn, Comm_split, Comm_split_type,
+                   Comparison, IDENT, Intercomm, Intercomm_merge, ROOT,
+                   SIMILAR, UNEQUAL, free, spawn_argv)
 
 # Object model
 from .info import INFO_NULL, Info, infoval

@@ -92,6 +92,10 @@ def load() -> ctypes.CDLL:
                                 ctypes.c_int, ctypes.c_int]
         lib.tm_poke.restype = None
         lib.tm_poke.argtypes = [ctypes.c_void_p]
+        lib.tm_hb_enable.restype = None
+        lib.tm_hb_enable.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.tm_peer_age_ms.restype = ctypes.c_longlong
+        lib.tm_peer_age_ms.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.tm_stop.restype = None
         lib.tm_stop.argtypes = [ctypes.c_void_p]
         lib.tm_destroy.restype = None
@@ -231,6 +235,19 @@ class NativeTransport:
         """Ask a non-direct recv holder (the drainer) to yield its lease."""
         if self._h:
             self._lib.tm_poke(self._h)
+
+    def hb_enable(self, interval_ms: int) -> None:
+        """Turn on heartbeat emission + liveness tracking (0 turns it off).
+        Every peer starts 'heard now' — the silence clock begins here."""
+        if self._h:
+            self._lib.tm_hb_enable(self._h, int(interval_ms))
+
+    def peer_age_ms(self, peer: int) -> int:
+        """ms since ``peer`` was last heard; -1 detection off / unknown,
+        -2 peer known dead (closed socket or refused heartbeat)."""
+        if not self._h:
+            return -1
+        return int(self._lib.tm_peer_age_ms(self._h, int(peer)))
 
     def stop(self) -> None:
         if self._h:

@@ -43,6 +43,10 @@
 namespace {
 
 constexpr uint32_t kMagic = 0x7D5A11E7u;
+// Heartbeat frames (fault detection, docs/fault-tolerance.md): header-only
+// frames under a second magic so they never enter the inbox — the liveness
+// plane shares the data plane's sockets but not its delivery queue.
+constexpr uint32_t kHbMagic = 0x7D5AFEEDu;
 
 // Corrupt-stream guard: a garbled-but-magic-valid header must not make the
 // connection buffer grow unboundedly waiting for bytes that never arrive.
@@ -81,6 +85,7 @@ struct Conn {
   Frame cur;                 // in-progress frame (body being filled)
   size_t filled = 0;         // bytes of cur.data received so far
   bool in_body = false;
+  int src_hint = -1;         // last src seen on this conn (death attribution)
 };
 
 bool write_all(int fd, const void* p, size_t n) {
@@ -190,6 +195,13 @@ class Transport {
     while (static_cast<int>(peer_fds_.size()) < new_size) {
       peer_fds_.push_back(-1);
       peer_locks_.emplace_back();
+    }
+    if (hb_enabled_.load(std::memory_order_relaxed)) {
+      std::lock_guard<std::mutex> hg(hb_mtx_);
+      while (static_cast<int>(last_heard_us_.size()) < new_size) {
+        last_heard_us_.push_back(now_us());
+        peer_dead_.push_back(0);
+      }
     }
     size_.store(new_size);
     return true;
@@ -382,6 +394,30 @@ class Transport {
     }
   }
 
+  // -- failure detection (heartbeats + closed-socket attribution) -----------
+  // Enable liveness tracking: every peer starts "heard now" (grace from
+  // enable time), heartbeats go out every interval_ms from whichever thread
+  // drives pump_io. interval_ms <= 0 turns the whole plane back off.
+  void hb_enable(int interval_ms) {
+    std::lock_guard<std::mutex> g(hb_mtx_);
+    int n = size_.load();
+    last_heard_us_.assign(n, now_us());
+    peer_dead_.assign(n, 0);
+    hb_interval_ms_.store(interval_ms, std::memory_order_relaxed);
+    hb_enabled_.store(interval_ms > 0, std::memory_order_relaxed);
+  }
+
+  // Milliseconds since the peer was last heard (any frame counts as
+  // liveness); -1 when detection is off / rank out of range, -2 when the
+  // peer is known dead (socket closed or heartbeat send refused).
+  long long peer_age_ms(int peer) {
+    if (!hb_enabled_.load(std::memory_order_relaxed)) return -1;
+    std::lock_guard<std::mutex> g(hb_mtx_);
+    if (peer < 0 || peer >= static_cast<int>(last_heard_us_.size())) return -1;
+    if (peer_dead_[peer]) return -2;
+    return (now_us() - last_heard_us_[peer]) / 1000;
+  }
+
   // Ask any thread blocked in a NON-direct recv (the Python drainer) to
   // yield its lease immediately; also breaks the progress thread's poll.
   void request_yield() {
@@ -488,6 +524,89 @@ class Transport {
     }
   }
 
+  void note_heard(int src) {
+    if (!hb_enabled_.load(std::memory_order_relaxed)) return;
+    std::lock_guard<std::mutex> g(hb_mtx_);
+    if (src >= 0 && src < static_cast<int>(last_heard_us_.size()))
+      last_heard_us_[src] = now_us();
+  }
+
+  void mark_dead(int src) {
+    if (!hb_enabled_.load(std::memory_order_relaxed)) return;
+    std::lock_guard<std::mutex> g(hb_mtx_);
+    if (src >= 0 && src < static_cast<int>(peer_dead_.size()))
+      peer_dead_[src] = 1;
+  }
+
+  // Emit one heartbeat header to every live peer when the interval elapsed.
+  // Runs under io_mtx_ (top of pump_io) so it fires no matter which thread
+  // — the progress thread or a direct receiver — currently drives the
+  // engine. Per-peer locks are only try_lock'd: a rank thread mid-send IS
+  // liveness traffic, skipping is correct. Sends use MSG_DONTWAIT — a
+  // backed-up socket must not wedge the io engine; EAGAIN just skips this
+  // beat (the peer isn't reading, the age check will say so). A refused
+  // connect or a hard send error marks the peer dead immediately: on a
+  // SIGKILLed peer that is the fast path, far ahead of the silence timeout.
+  void maybe_send_heartbeats() {
+    if (!hb_enabled_.load(std::memory_order_relaxed)) return;
+    int64_t interval_us =
+        static_cast<int64_t>(hb_interval_ms_.load(std::memory_order_relaxed)) *
+        1000;
+    int64_t now = now_us();
+    if (now - hb_last_sent_us_.load(std::memory_order_relaxed) < interval_us)
+      return;
+    hb_last_sent_us_.store(now, std::memory_order_relaxed);
+    int n = size_.load();
+    for (int dst = 0; dst < n; ++dst) {
+      if (dst == rank_) continue;
+      {
+        std::lock_guard<std::mutex> g(hb_mtx_);
+        if (dst < static_cast<int>(peer_dead_.size()) && peer_dead_[dst])
+          continue;
+      }
+      std::mutex* plk;
+      int* fd_slot;
+      {
+        std::lock_guard<std::mutex> g(peers_mtx_);
+        if (dst >= static_cast<int>(peer_fds_.size())) continue;
+        plk = &peer_locks_[dst];
+        fd_slot = &peer_fds_[dst];
+      }
+      if (!plk->try_lock()) continue;
+      int fd = *fd_slot;
+      if (fd < 0) {
+        fd = connect_peer(dst);
+        if (fd < 0) {
+          plk->unlock();
+          mark_dead(dst);
+          continue;
+        }
+        *fd_slot = fd;
+      }
+      FrameHeader h{kHbMagic, rank_, 0};
+      ssize_t w = ::send(fd, &h, sizeof(h), MSG_NOSIGNAL | MSG_DONTWAIT);
+      bool ok = true;
+      if (w == sizeof(h)) {
+      } else if (w < 0) {
+        ok = (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR);
+      } else {
+        // Partial header write (socket buffer brim-full at exactly the
+        // wrong byte): the stream is committed — finish it blocking, the
+        // remainder is < 16 bytes. A dead peer fails this fast (RST).
+        ok = write_all(fd, reinterpret_cast<const uint8_t*>(&h) + w,
+                       sizeof(h) - static_cast<size_t>(w));
+      }
+      if (!ok) {
+        ::close(fd);
+        *fd_slot = -1;
+        plk->unlock();
+        mark_dead(dst);
+        continue;
+      }
+      plk->unlock();
+    }
+  }
+
   bool direct_hot() const {
     return direct_waiters_.load(std::memory_order_relaxed) > 0 ||
            now_us() - last_direct_us_.load(std::memory_order_relaxed) < 20000;
@@ -511,6 +630,13 @@ class Transport {
   // connections (io_mtx_ held by the caller: the progress thread or a
   // direct-receiving rank thread).
   void pump_io(int timeout_ms) {
+    if (hb_enabled_.load(std::memory_order_relaxed)) {
+      maybe_send_heartbeats();
+      // the poll slice must not outlive the heartbeat period, or beats
+      // stall behind an idle 200 ms progress-thread poll
+      int iv = hb_interval_ms_.load(std::memory_order_relaxed);
+      if (iv > 0 && timeout_ms > iv) timeout_ms = iv;
+    }
     {
       std::vector<pollfd> pfds;
       pfds.push_back({listen_fd_, POLLIN, 0});
@@ -563,6 +689,13 @@ class Transport {
               if (c.hdr.size() == sizeof(FrameHeader)) {
                 FrameHeader h;
                 memcpy(&h, c.hdr.data(), sizeof(h));
+                if (h.magic == kHbMagic && h.len == 0) {
+                  // liveness beat: never enters the inbox
+                  c.src_hint = h.src;
+                  note_heard(h.src);
+                  c.hdr.clear();
+                  continue;
+                }
                 // Corrupt stream (bad magic, negative or absurd length):
                 // drop the connection rather than buffering unboundedly.
                 if (h.magic != kMagic || h.len < 0 ||
@@ -570,6 +703,8 @@ class Transport {
                   dead = true;
                   break;
                 }
+                c.src_hint = h.src;
+                note_heard(h.src);
                 c.cur.src = h.src;
                 c.cur.len = static_cast<size_t>(h.len);
                 c.cur.data.reset(c.cur.len ? new uint8_t[c.cur.len] : nullptr);
@@ -609,6 +744,9 @@ class Transport {
           break;
         }
         if (dead) {
+          // a conn that ever carried a frame names its rank: a closed
+          // socket is peer death, not just a stream error
+          if (c.src_hint >= 0) mark_dead(c.src_hint);
           ::close(c.fd);
           c.fd = -1;
           continue;
@@ -645,6 +783,13 @@ class Transport {
   std::atomic<int> direct_waiters_{0};
   std::atomic<int64_t> last_direct_us_{0};
   std::atomic<int> yield_req_{0};
+  // failure detection (hb_enable): per-world-rank liveness, off by default
+  std::atomic<bool> hb_enabled_{false};
+  std::atomic<int> hb_interval_ms_{0};
+  std::atomic<int64_t> hb_last_sent_us_{0};
+  std::mutex hb_mtx_;
+  std::vector<int64_t> last_heard_us_;
+  std::vector<uint8_t> peer_dead_;
   std::thread progress_;
   std::atomic<bool> stopped_{false};
   std::vector<Conn> conns_;
@@ -695,6 +840,14 @@ int tm_recv(void* h, void* buf, long long cap, int* src_out,
 }
 
 void tm_poke(void* h) { static_cast<Transport*>(h)->request_yield(); }
+
+void tm_hb_enable(void* h, int interval_ms) {
+  static_cast<Transport*>(h)->hb_enable(interval_ms);
+}
+
+long long tm_peer_age_ms(void* h, int peer) {
+  return static_cast<Transport*>(h)->peer_age_ms(peer);
+}
 
 void tm_stop(void* h) { static_cast<Transport*>(h)->stop(); }
 

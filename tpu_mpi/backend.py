@@ -43,11 +43,11 @@ from . import config
 from . import perfvars as _pv
 from . import serialization
 from .buffers import is_wire_snapshot
-from ._runtime import (ANY_SOURCE, Mailbox, Message, SpmdContext, _Waitable,
-                       collective_wait_limit, deadlock_timeout, set_env,
-                       set_process_env)
+from ._runtime import (ANY_SOURCE, FailureDetector, Mailbox, Message,
+                       SpmdContext, _Waitable, collective_wait_limit,
+                       deadlock_timeout, set_env, set_process_env)
 from .error import (AbortError, CollectiveMismatchError, DeadlockError,
-                    MPIError)
+                    MPIError, ProcFailedError)
 
 _POLL_MS = 50
 
@@ -377,6 +377,11 @@ class _RemoteMailbox:
             with ctx._choke_cond:
                 while self.world_rank in ctx.choked_by:
                     ctx.check_failure()
+                    if self.world_rank in ctx.failed_ranks:
+                        raise ProcFailedError(
+                            f"rank {self.world_rank} died while it had this "
+                            f"sender choked ({what})",
+                            ranks=(self.world_rank,))
                     if time.monotonic() > deadline:
                         raise DeadlockError(
                             f"deadlock suspected: rank {self.world_rank} kept "
@@ -412,6 +417,7 @@ class _RemoteMailbox:
         # whole predicate on the threshold compare alone
         shm_wins = (nbytes is not None and (m := _shm_min_bytes())
                     and nbytes >= m and ctx.shm_ok(self.world_rank))
+        parts = None
         if not shm_wins:
             try:
                 parts = _fast_p2p_parts(msg, seq)
@@ -420,16 +426,25 @@ class _RemoteMailbox:
                 # an encode hiccup must never poison the job (found live:
                 # tuple cids from sub-communicators)
                 parts = None
+        try:
             if parts is not None:
                 if len(parts) == 1:
                     ctx.transport.send(self.world_rank, parts[0])
                 else:
                     ctx.transport.sendv(self.world_rank, parts)
                 return
-        ctx.send_frame(self.world_rank,
-                       ("p2p", msg.src, msg.tag, msg.cid,
-                        _pack(msg.payload), msg.count, msg.dtype,
-                        msg.kind, seq))
+            ctx.send_frame(self.world_rank,
+                           ("p2p", msg.src, msg.tag, msg.cid,
+                            _pack(msg.payload), msg.count, msg.dtype,
+                            msg.kind, seq))
+        except ConnectionError:
+            if ctx._detector is None:
+                raise
+            # typed ULFM error for a send to a dead peer (detector active)
+            ctx.peer_failed(self.world_rank)
+            raise ProcFailedError(
+                f"rank {self.world_rank} died before this send completed",
+                ranks=(self.world_rank,)) from None
 
     def notify(self) -> None:  # failure broadcast reaches processes via abort
         pass
@@ -467,6 +482,7 @@ class _ShmColl:
     def __init__(self, ctx: "ProcContext", cid: Any, group: tuple):
         import mmap as _mmap
         self.ctx = ctx
+        self.cid = cid
         self.n = n = len(group)
         self.cap = max(int(config.load().coll_shm_max_bytes), 1)
         slug = ("-".join(str(p) for p in cid) if isinstance(cid, tuple)
@@ -538,6 +554,8 @@ class _ShmColl:
                 self.ctx.fail(err)
                 raise err
             self.ctx.check_failure()
+            if self.ctx.failed_ranks or self.ctx.revoked_cids:
+                self.ctx.check_fault(self.cid)   # dead peer / revoked comm
             it += 1
             if it < 200 and yield_ is not None:
                 yield_()
@@ -615,7 +633,7 @@ class ProcChannel(_Waitable):
         (_runtime.pump_wait, the shared loop)."""
         from ._runtime import pump_wait
         return pump_wait(self.ctx, self.cond, pred, what,
-                         timeout=timeout, limit=limit)
+                         timeout=timeout, limit=limit, fault_cid=self.cid)
 
     def _mismatch(self, theirs: str, mine: str) -> None:
         """Record a cross-tier mismatch (drainer-side: fail, don't raise —
@@ -1418,7 +1436,13 @@ class ProcChannel(_Waitable):
                                               chunked[0], chunked[1], opname)
             return self._run_star(rank, rnd, contrib, combine, opname)
         except BaseException as e:
-            if ctx.failure is None:
+            # ULFM errors stay LOCAL: the failure detector already woke
+            # every survivor, and each raises its own typed error —
+            # broadcasting an abort here would replace recoverable
+            # ProcFailedError/RevokedError with fatal AbortError job-wide
+            # and poison this rank's own recovery path (Comm_shrink).
+            from .error import ProcFailedError as _PF, RevokedError as _RV
+            if ctx.failure is None and not isinstance(e, (_PF, _RV)):
                 ctx.fail(e)
             raise
         finally:
@@ -1661,7 +1685,17 @@ class ProcChannel(_Waitable):
                 f"multi-process ranks do not share an address space: {e}")
             self.ctx.fail(err)
             raise err from None
-        self.ctx.transport.sendv(world_dst, parts)
+        try:
+            self.ctx.transport.sendv(world_dst, parts)
+        except ConnectionError:
+            if self.ctx._detector is None:
+                raise
+            # failure detection is on: a refused protocol send IS a death
+            # signal — surface the typed ULFM error instead of fate-sharing
+            self.ctx.peer_failed(world_dst)
+            raise ProcFailedError(
+                f"rank {world_dst} died mid-collective ({opname})",
+                ranks=(world_dst,)) from None
 
 
 class ProcContext(SpmdContext):
@@ -1729,6 +1763,24 @@ class ProcContext(SpmdContext):
         mb.direct_pump = self._direct_pump
         mb.pump_begin = self._pump_begin
         mb.pump_end = self._pump_end
+        # Fault-tolerant agreement state (Comm_agree/Comm_shrink substrate):
+        # contributions and decisions keyed by ("ftag", cid, epoch). Decisions
+        # are kept for the life of the job so a rank that finished an
+        # agreement round can answer a straggler's late (re)contribution from
+        # its dispatch loop (coordinator-failover correctness).
+        self._ft_lock = threading.Lock()
+        self._ft_cond = threading.Condition(self._ft_lock)
+        self._ft_contribs: dict[Any, dict[int, tuple[int, frozenset]]] = {}
+        self._ft_decided: dict[Any, tuple[int, frozenset]] = {}
+        # Failure detection (ULFM-shaped fault tolerance): heartbeat frames
+        # on the transport poll loop plus a poll-side silence clock. Off by
+        # default (heartbeat_ms == 0) — the fault path is pay-for-use.
+        # Created BEFORE the drainer starts: the drain loop reads it.
+        cfg = config.load()
+        self._detector = None
+        if cfg.heartbeat_ms > 0 and hasattr(transport, "hb_enable"):
+            self._detector = FailureDetector(
+                self, transport, cfg.heartbeat_ms, cfg.failure_timeout_ms)
         self._drainer = threading.Thread(target=self._drain, daemon=True,
                                          name="tpu-mpi-drainer")
         self._drainer_stop = threading.Event()
@@ -1880,6 +1932,8 @@ class ProcContext(SpmdContext):
             if done is not None and done():
                 return True                 # delivered while we waited
             self._last_direct = time.monotonic()
+            if self._detector is not None:
+                self._detector.poll()
             self._flush_unchokes()
             try:
                 got = self.transport.recv(max(1, int(timeout_s * 1000)),
@@ -1895,6 +1949,8 @@ class ProcContext(SpmdContext):
 
     def _drain(self) -> None:
         while not self._drainer_stop.is_set():
+            if self._detector is not None:
+                self._detector.poll()
             self._flush_unchokes()
             # park while any rank thread is pumping its own socket — zero
             # CPU from this thread during a blocked receive (the wait has a
@@ -2016,6 +2072,179 @@ class ProcContext(SpmdContext):
             for ch in list(self._channels.values()):
                 with ch.cond:
                     ch.cond.notify_all()
+        elif kind == "revoke":
+            # Comm_revoke flood. Re-flood once before marking (dedup via
+            # revoked_cids): if the original revoker died mid-flood, every
+            # receiver completes the propagation, so all survivors converge.
+            _, cid, group = item
+            if cid not in self.revoked_cids:
+                self.revoke_comm(cid)
+                for r in group:
+                    if r != self.local_rank and r not in self.failed_ranks:
+                        try:
+                            self.send_frame(r, ("revoke", cid, tuple(group)))
+                        except Exception:
+                            pass
+        elif kind == "bye":
+            # clean Finalize announcement: this peer is about to close its
+            # sockets on purpose — the failure detector must not read the
+            # resulting EOF as a death (staggered-shutdown false positive)
+            self.peer_departed(src_world)
+        elif kind == "ftag":
+            # agreement contribution (possibly resent after a coordinator
+            # failover). If the decision is already known here, answer the
+            # straggler directly instead of stashing.
+            _, cid, epoch, src, flag, dead = item
+            key = ("ftag", cid, epoch)
+            with self._ft_cond:
+                dec = self._ft_decided.get(key)
+                if dec is None:
+                    self._ft_contribs.setdefault(key, {})[src] = (
+                        int(flag), frozenset(dead))
+                    self._ft_cond.notify_all()
+            if dec is not None and src != self.local_rank:
+                try:
+                    self.send_frame(src, ("ftagd", cid, epoch, dec[0],
+                                          tuple(sorted(dec[1]))))
+                except Exception:
+                    pass
+        elif kind == "ftagd":
+            _, cid, epoch, flag, dead = item
+            key = ("ftag", cid, epoch)
+            with self._ft_cond:
+                self._ft_decided[key] = (int(flag), frozenset(dead))
+                self._ft_cond.notify_all()
+
+    # -- fault tolerance (ULFM-shaped: revoke / agree / shrink substrate) -----
+    def peer_failed(self, rank: int) -> None:
+        if rank in self.failed_ranks:
+            return
+        super().peer_failed(rank)
+        # a dead peer can never unchoke us; drop its choke so blocked
+        # senders wake (they re-check failed_ranks and raise typed)
+        with self._choke_cond:
+            self.choked_by.discard(rank)
+            self._choke_cond.notify_all()
+        with self._ft_cond:
+            self._ft_cond.notify_all()
+        self._drainer_resume.set()
+
+    def flood(self, group: Sequence[int], item: Any) -> None:
+        """Best-effort broadcast of a control frame to every live member of
+        ``group`` (revoke/bye propagation — failures along the way are the
+        very condition being handled)."""
+        for r in group:
+            if r != self.local_rank and r not in self.failed_ranks:
+                try:
+                    self.send_frame(r, item)
+                except Exception:
+                    pass
+
+    def drain_failed_state(self, old_cid: Any) -> None:
+        """Drop per-communicator state tied to a revoked communicator before
+        its shrink replacement goes live: the collective channel (and any
+        frames a dead rank parked in its inbox) and the overlap plan cache."""
+        with self._channels_lock:
+            self._channels.pop(old_cid, None)
+        try:
+            from .overlap import plans
+            plans.invalidate(old_cid)
+        except Exception:
+            pass
+
+    def ft_agree(self, me: int, group: Sequence[int], cid: Any, epoch: int,
+                 flag: int) -> tuple[int, frozenset]:
+        """Fault-tolerant agreement round over ``group`` (world ranks).
+
+        Returns ``(value, dead)`` where ``value`` is the bitwise AND of every
+        contributing rank's ``flag`` and ``dead`` the union of every
+        contributor's failed-set view restricted to the group — the same
+        round serves MPI_Comm_agree (callers use the value) and Comm_shrink
+        (callers use the dead set).
+
+        Protocol: the lowest-indexed live member of the group coordinates;
+        everyone else sends it ``("ftag", ...)`` and waits for the
+        ``("ftagd", ...)`` decision. A coordinator death mid-round is
+        detected by the heartbeat plane; survivors fail over to the next
+        live member and resend. Decisions are remembered for the life of
+        the job so late resends are answered from _dispatch even after the
+        caller has moved on."""
+        group = tuple(group)
+        key = ("ftag", cid, epoch)
+        deadline = time.monotonic() + deadlock_timeout()
+        with self._ft_cond:
+            self._ft_contribs.setdefault(key, {})[me] = (
+                int(flag), frozenset(self.failed_ranks & set(group)))
+        while True:
+            if time.monotonic() > deadline:
+                raise DeadlockError(
+                    f"Comm_agree(cid={cid!r}, epoch={epoch}) did not "
+                    f"complete within {deadlock_timeout()}s")
+            with self._ft_cond:
+                dec = self._ft_decided.get(key)
+            if dec is not None:
+                return dec
+            live = [r for r in group if r not in self.failed_ranks]
+            coord = live[0] if live else me
+            if coord == me:
+                dec = self._ft_coordinate(key, group, deadline)
+                for r in group:
+                    if r != me and r not in self.failed_ranks:
+                        try:
+                            self.send_frame(r, ("ftagd", key[1], key[2],
+                                                dec[0],
+                                                tuple(sorted(dec[1]))))
+                        except Exception:
+                            pass
+                return dec
+            # participant: (re)send our contribution to the current
+            # coordinator, then wait for a decision or its death
+            with self._ft_cond:
+                my_flag, my_dead = self._ft_contribs[key][me]
+            try:
+                self.send_frame(coord, ("ftag", key[1], key[2], me,
+                                        my_flag, tuple(sorted(my_dead))))
+            except Exception:
+                # a refused control send IS a death signal
+                self.peer_failed(coord)
+                continue
+            resend_at = time.monotonic() + 0.5
+            with self._ft_cond:
+                while (key not in self._ft_decided
+                       and coord not in self.failed_ranks
+                       and time.monotonic() < resend_at):
+                    self._ft_cond.wait(0.02)
+                dec = self._ft_decided.get(key)
+            if dec is not None:
+                return dec
+            # coordinator dead or slow: loop (re-elect / resend)
+
+    def _ft_coordinate(self, key: Any, group: tuple[int, ...],
+                       deadline: float) -> tuple[int, frozenset]:
+        """Coordinator side of ft_agree: wait for every live member's
+        contribution (members that die mid-round are excluded as the
+        detector marks them), then fold and record the decision."""
+        with self._ft_cond:
+            while True:
+                if key in self._ft_decided:
+                    return self._ft_decided[key]
+                contribs = self._ft_contribs.get(key, {})
+                if all(r in contribs or r in self.failed_ranks
+                       for r in group):
+                    break
+                if time.monotonic() > deadline:
+                    raise DeadlockError(
+                        f"Comm_agree coordinator (cid={key[1]!r}) timed out "
+                        f"waiting for contributions")
+                self._ft_cond.wait(0.02)
+            value = ~0
+            dead = set(self.failed_ranks)
+            for f, d in contribs.values():
+                value &= f
+                dead |= set(d)
+            dec = (value, frozenset(dead & set(group)))
+            self._ft_decided[key] = dec
+            return dec
 
     # -- channel management ---------------------------------------------------
     def _proc_channel(self, cid: Any) -> ProcChannel:
@@ -2200,6 +2429,12 @@ class ProcContext(SpmdContext):
                         p.wait(timeout=5)
                     except Exception:
                         pass
+        # Clean departure announcement: with the failure detector active,
+        # closing our sockets looks exactly like dying. The "bye" frame
+        # tells survivors this EOF is a Finalize, not a failure
+        # (staggered-shutdown false-positive suppression).
+        if self._detector is not None:
+            self.flood(range(self.size), ("bye",))
         self._drainer_stop.set()
         self._drainer_resume.set()      # wake a parked drainer promptly
         self.transport.stop()

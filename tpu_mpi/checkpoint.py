@@ -26,7 +26,9 @@ Arrays come back as numpy (device placement is the caller's policy —
 
 from __future__ import annotations
 
+import os
 import pickle
+import zlib
 from typing import Any
 
 import numpy as np
@@ -38,7 +40,15 @@ from .comm import Comm
 from . import error as _ec
 from .error import MPIError
 
-_MAGIC = 0x7D5AC4B7_00000001
+# v2: 32-byte fixed head [magic u64][hdr_cap u64][hdr_len u64][hdr_crc u32]
+# [pad u32], CRC32 over the unpadded pickled header, per-leaf payload CRCs
+# in the header, and writes go to a temp file atomically renamed into place
+# — a torn write (killed rank, full disk) can never masquerade as a valid
+# checkpoint (docs/fault-tolerance.md: the shrink→restore→continue recipe
+# leans on this).
+_MAGIC = 0x7D5AC4B7_00000002
+_MAGIC_V1 = 0x7D5AC4B7_00000001
+_HEAD = 32
 
 
 def _esc(key: str) -> str:
@@ -94,13 +104,23 @@ def _tree_spec(tree: Any):
 
 
 def save_sharded(path: str, tree: Any, comm: Comm) -> None:
-    """Collectively write every rank's local ``tree`` into one file."""
+    """Collectively write every rank's local ``tree`` into one file.
+
+    Torn-write hardening: all ranks write a temp file next to ``path``;
+    after every shard is synced, rank 0 atomically renames it into place.
+    A reader never observes a half-written checkpoint — either the old
+    file or the complete new one. Header and every leaf payload carry
+    CRC32s that ``load_sharded`` verifies."""
     rank, size = comm.rank(), comm.size()
     leaves = _flatten(tree)
+    flats = [np.ascontiguousarray(a).reshape(-1).view(np.uint8)
+             for _, a in leaves]
     my_meta = (_tree_spec(tree),
-               # structured dtypes keep their field layout via descr
+               # structured dtypes keep their field layout via descr;
+               # trailing field: CRC32 of the leaf's raw bytes
                [(k, a.dtype.str if a.dtype.names is None else a.dtype.descr,
-                 a.shape, int(a.nbytes)) for k, a in leaves])
+                 a.shape, int(a.nbytes), zlib.crc32(f))
+                for (k, a), f in zip(leaves, flats)])
     # allgather of python meta objects (dynamic sizes) via the rendezvous
     from .collective import _run
     all_metas = _run(comm, my_meta, lambda cs: [list(cs)] * len(cs),
@@ -113,7 +133,7 @@ def save_sharded(path: str, tree: Any, comm: Comm) -> None:
     # pickled width — break the cycle by padding the header to a stable
     # capacity (every rank computes the identical value)
     hdr_cap = len(pickle.dumps(header)) + 16 * size + 64
-    off = 16 + hdr_cap
+    off = _HEAD + hdr_cap
     for r, (spec, leafmeta) in enumerate(all_metas):
         header["ranks"][r]["offset"] = off
         off += sum(m[3] for m in leafmeta)
@@ -121,29 +141,74 @@ def save_sharded(path: str, tree: Any, comm: Comm) -> None:
     if len(hdr) > hdr_cap:
         raise MPIError("checkpoint header overflow (internal)",
                        code=_ec.ERR_INTERN)
-    hdr = hdr + b"\x00" * (hdr_cap - len(hdr))
+    hdr_len, hdr_crc = len(hdr), zlib.crc32(hdr)
+    hdr = hdr + b"\x00" * (hdr_cap - hdr_len)
 
-    fh = File.open(comm, path, write=True, create=True)
+    tmp = path + ".tmp"
+    if rank == 0 and os.path.exists(tmp):
+        os.unlink(tmp)      # a stale temp from a killed job must not linger
+    Barrier(comm)
+    fh = File.open(comm, tmp, write=True, create=True)
     if rank == 0:
         head = np.frombuffer(
             _MAGIC.to_bytes(8, "little") + hdr_cap.to_bytes(8, "little")
-            + hdr, np.uint8)
+            + hdr_len.to_bytes(8, "little") + hdr_crc.to_bytes(4, "little")
+            + b"\x00" * 4 + hdr, np.uint8)
         File.write_at(fh, 0, head)
     my_off = header["ranks"][rank]["offset"]
     # independent (non-collective) writes: leaf COUNTS may differ per rank,
     # and write_at_all requires matched call sequences; the closing Barrier
     # is the completion point
-    for k, a in leaves:
-        flat = np.ascontiguousarray(a).reshape(-1).view(np.uint8)
+    for flat in flats:
         File.write_at(fh, my_off, flat)
-        my_off += a.nbytes
+        my_off += flat.nbytes
     File.sync(fh)
     File.close(fh)
     Barrier(comm)
+    if rank == 0:
+        os.replace(tmp, path)   # the atomic publication point
+    Barrier(comm)
 
 
-def load_sharded(path: str, comm: Comm) -> Any:
+def shard_count(path: str, comm: Comm) -> int:
+    """Number of rank shards in a save_sharded file (collective over
+    ``comm`` only in that every caller may open the file; no rendezvous).
+    The fault-tolerance restore path uses this to re-partition a checkpoint
+    written by a LARGER (pre-shrink) communicator."""
+    fh = File.open(comm, path, read=True)
+    try:
+        fsize = File.get_size(fh)
+        head = np.zeros(_HEAD, np.uint8)
+        if fsize >= _HEAD:
+            File.read_at(fh, 0, head)
+        magic = int.from_bytes(head[:8].tobytes(), "little")
+        hdr_cap = int.from_bytes(head[8:16].tobytes(), "little")
+        hdr_len = int.from_bytes(head[16:24].tobytes(), "little")
+        if (fsize < _HEAD or magic != _MAGIC or hdr_cap <= 0
+                or _HEAD + hdr_cap > fsize or not (0 < hdr_len <= hdr_cap)):
+            raise MPIError(f"{path!r} is not a readable tpu_mpi sharded "
+                           f"checkpoint", code=_ec.ERR_FILE)
+        raw = np.zeros(hdr_cap, np.uint8)
+        File.read_at(fh, _HEAD, raw)
+        try:
+            return len(pickle.loads(raw[:hdr_len].tobytes())["ranks"])
+        except Exception as e:
+            raise MPIError(
+                f"undecodable checkpoint header in {path!r}: "
+                f"{type(e).__name__}: {e}", code=_ec.ERR_FILE) from None
+    finally:
+        File.close(fh)
+
+
+def load_sharded(path: str, comm: Comm, *, shard: int | None = None) -> Any:
     """Collectively restore this rank's tree from a save_sharded file.
+
+    ``shard`` overrides which rank shard this caller reads (default: its
+    own comm rank, requiring the comm size to match the writer's). The
+    override exists for fault-tolerant restore: after Comm_shrink, the
+    survivor communicator is SMALLER than the one that wrote the
+    checkpoint, and each survivor re-reads whichever shards its new
+    partition covers (docs/fault-tolerance.md).
 
     Trust model: the header is a pickle — loading executes code, exactly
     like ``np.load(allow_pickle=True)`` or a torch checkpoint. Only load
@@ -151,40 +216,83 @@ def load_sharded(path: str, comm: Comm) -> Any:
     """
     rank, size = comm.rank(), comm.size()
     fh = File.open(comm, path, read=True)
-    head = np.zeros(16, np.uint8)
-    File.read_at(fh, 0, head)
-    magic = int.from_bytes(head[:8].tobytes(), "little")
-    if magic != _MAGIC:
+    try:
+        fsize = File.get_size(fh)
+        if fsize < _HEAD:
+            raise MPIError(
+                f"{path!r} is truncated ({fsize} bytes; no checkpoint head)",
+                code=_ec.ERR_FILE)
+        head = np.zeros(_HEAD, np.uint8)
+        File.read_at(fh, 0, head)
+        magic = int.from_bytes(head[:8].tobytes(), "little")
+        if magic == _MAGIC_V1:
+            raise MPIError(
+                f"{path!r} is a v1 sharded checkpoint (no integrity "
+                f"metadata); re-save it with this version",
+                code=_ec.ERR_FILE)
+        if magic != _MAGIC:
+            raise MPIError(f"{path!r} is not a tpu_mpi sharded checkpoint",
+                           code=_ec.ERR_FILE)
+        hdr_cap = int.from_bytes(head[8:16].tobytes(), "little")
+        hdr_len = int.from_bytes(head[16:24].tobytes(), "little")
+        hdr_crc = int.from_bytes(head[24:28].tobytes(), "little")
+        # bound the header-capacity field by the actual file size before
+        # allocating: a truncated/corrupt file with valid magic must fail
+        # cleanly, not trigger an arbitrary-size allocation
+        if (hdr_cap <= 0 or _HEAD + hdr_cap > fsize
+                or not (0 < hdr_len <= hdr_cap)):
+            raise MPIError(
+                f"corrupt checkpoint header: capacity {hdr_cap} / length "
+                f"{hdr_len} inconsistent with file size {fsize}",
+                code=_ec.ERR_FILE)
+        raw = np.zeros(hdr_cap, np.uint8)
+        File.read_at(fh, _HEAD, raw)
+        hdr_bytes = raw[:hdr_len].tobytes()
+        if zlib.crc32(hdr_bytes) != hdr_crc:
+            raise MPIError(
+                f"checkpoint header CRC mismatch in {path!r} — torn or "
+                f"corrupted write", code=_ec.ERR_FILE)
+        try:
+            header = pickle.loads(hdr_bytes)
+            ranks_meta = header["ranks"]
+            if rank < len(ranks_meta):
+                _ = (ranks_meta[rank]["spec"], ranks_meta[rank]["offset"],
+                     ranks_meta[rank]["leaves"])
+        except MPIError:
+            raise
+        except Exception as e:
+            raise MPIError(
+                f"undecodable checkpoint header in {path!r}: "
+                f"{type(e).__name__}: {e}", code=_ec.ERR_FILE) from None
+        if shard is None and len(ranks_meta) != size:
+            raise MPIError(
+                f"checkpoint has {len(ranks_meta)} shards, comm has "
+                f"{size} ranks (elastic resharding is not supported; pass "
+                f"shard= to read a specific one)", code=_ec.ERR_SIZE)
+        want = rank if shard is None else int(shard)
+        if not (0 <= want < len(ranks_meta)):
+            raise MPIError(
+                f"checkpoint has {len(ranks_meta)} shards; shard {want} "
+                f"does not exist", code=_ec.ERR_ARG)
+        entry = ranks_meta[want]
+        off = entry["offset"]
+        leaves: dict[str, np.ndarray] = {}
+        for k, dt, shape, nbytes, crc in entry["leaves"]:
+            if off + nbytes > fsize:
+                raise MPIError(
+                    f"checkpoint shard for rank {rank} is truncated: leaf "
+                    f"{k!r} needs bytes [{off}, {off + nbytes}) but "
+                    f"{path!r} is {fsize} bytes", code=_ec.ERR_FILE)
+            buf = np.zeros(nbytes, np.uint8)
+            File.read_at(fh, off, buf)      # independent: counts differ
+            if zlib.crc32(buf) != crc:
+                raise MPIError(
+                    f"checkpoint payload CRC mismatch for leaf {k!r} "
+                    f"(rank {rank}) in {path!r} — torn or corrupted write",
+                    code=_ec.ERR_FILE)
+            leaves[k] = buf.view(np.dtype(dt)).reshape(shape)
+            off += nbytes
+    finally:
         File.close(fh)
-        raise MPIError(f"{path!r} is not a tpu_mpi sharded checkpoint",
-                       code=_ec.ERR_FILE)
-    hdr_cap = int.from_bytes(head[8:].tobytes(), "little")
-    # bound the header-capacity field by the actual file size before
-    # allocating: a truncated/corrupt file with valid magic must fail
-    # cleanly, not trigger an arbitrary-size allocation
-    fsize = File.get_size(fh)
-    if hdr_cap <= 0 or 16 + hdr_cap > fsize:
-        File.close(fh)
-        raise MPIError(
-            f"corrupt checkpoint header: capacity {hdr_cap} exceeds file "
-            f"size {fsize}", code=_ec.ERR_FILE)
-    raw = np.zeros(hdr_cap, np.uint8)
-    File.read_at(fh, 16, raw)
-    header = pickle.loads(raw.tobytes())
-    if len(header["ranks"]) != size:
-        File.close(fh)
-        raise MPIError(
-            f"checkpoint has {len(header['ranks'])} shards, comm has "
-            f"{size} ranks (elastic resharding is not supported)",
-            code=_ec.ERR_SIZE)
-    entry = header["ranks"][rank]
-    off = entry["offset"]
-    leaves: dict[str, np.ndarray] = {}
-    for k, dt, shape, nbytes in entry["leaves"]:
-        buf = np.zeros(nbytes, np.uint8)
-        File.read_at(fh, off, buf)          # independent: counts differ
-        leaves[k] = buf.view(np.dtype(dt)).reshape(shape)
-        off += nbytes
-    File.close(fh)
     Barrier(comm)
     return _unflatten(entry["spec"], leaves)

@@ -109,7 +109,10 @@ class Comm:
     def channel(self) -> CollectiveChannel:
         """The collective rendezvous channel for this communicator."""
         self._check()
-        return self.ctx.channel(self._cid, len(self._group), group=self._group)
+        ctx = self.ctx
+        if ctx.failed_ranks or ctx.revoked_cids:  # fault path is pay-for-use
+            ctx.check_fault(self._cid)
+        return ctx.channel(self._cid, len(self._group), group=self._group)
 
     def get_pvars(self, reset: bool = False) -> dict:
         """This rank's performance-variable snapshot on this communicator
@@ -334,6 +337,97 @@ def Comm_split_type(comm: Comm, split_type: int, key: int) -> Comm:
     color = comm.channel().run(comm.rank(), comm.ctx.host_token, combine,
                                f"Comm_split_type@{comm.cid}")
     return Comm_split(comm, color, key)
+
+
+# ---------------------------------------------------------------------------
+# ULFM-shaped fault tolerance: Comm_revoke / Comm_agree / Comm_shrink
+# (MPI 4.x User-Level Failure Mitigation surface; docs/fault-tolerance.md)
+# ---------------------------------------------------------------------------
+
+def _next_epoch(ctx, cid, world_rank) -> int:
+    """Per-communicator agreement epoch: this rank's own call count.
+    Comm_agree/Comm_shrink are collective, so every member advances its
+    counter in lockstep and the epochs align without communication. Keyed by
+    (cid, rank) — in the thread tier ``ctx`` is SHARED by all rank threads,
+    and a shared per-cid counter would interleave."""
+    seq = getattr(ctx, "_agree_seq", None)
+    if seq is None:
+        seq = ctx._agree_seq = {}
+    e = seq.get((cid, world_rank), 0) + 1
+    seq[(cid, world_rank)] = e
+    return e
+
+
+def Comm_revoke(comm: Comm) -> None:
+    """Revoke the communicator after a failure (MPI_Comm_revoke analog).
+
+    Non-collective: any member may call it. Every pending and future
+    operation on the communicator — on every member that learns of the
+    revocation — raises :class:`~tpu_mpi.error.RevokedError` instead of
+    hanging on a dead peer. Only Comm_agree and Comm_shrink remain legal.
+    Multi-process tier: a revoke frame is flooded to the group and each
+    receiver re-floods once, so propagation completes even if the original
+    caller dies mid-flood."""
+    comm._check()
+    ctx = comm.ctx
+    _record_coll(comm, f"Comm_revoke@{comm.cid}")
+    ctx.revoke_comm(comm.cid)
+    flood = getattr(ctx, "flood", None)
+    if flood is not None:
+        flood(comm.group, ("revoke", comm.cid, tuple(comm.group)))
+
+
+def Comm_agree(comm: Comm, flag: int = 1) -> int:
+    """Fault-tolerant agreement (MPI_Comm_agree analog): returns the bitwise
+    AND of every live member's ``flag``. Works on a revoked communicator and
+    completes despite concurrent member failures — the recovery path's
+    decision primitive ("did everyone succeed / shall we shrink?")."""
+    comm._check()
+    ctx, world_rank = require_env()
+    _record_coll(comm, f"Comm_agree@{comm.cid}")
+    epoch = _next_epoch(ctx, comm.cid, world_rank)
+    value, _dead = ctx.ft_agree(world_rank, comm.group, comm.cid, epoch,
+                                int(flag))
+    return value
+
+
+def Comm_shrink(comm: Comm) -> Comm:
+    """Build the survivor communicator (MPI_Comm_shrink analog).
+
+    Collective over the LIVE members: agrees on the union of everyone's
+    failed-rank views, then forms a new communicator of the survivors in
+    group order. The new context id is derived deterministically from the
+    agreement — ``("shrink", old_cid, epoch)`` — so no rendezvous through a
+    (possibly dead) root is needed. Dead-rank state tied to the old
+    communicator (collective channel, cached overlap plans) is drained
+    before the replacement goes live."""
+    comm._check()
+    ctx, world_rank = require_env()
+    _record_coll(comm, f"Comm_shrink@{comm.cid}")
+    epoch = _next_epoch(ctx, comm.cid, world_rank)
+    _value, dead = ctx.ft_agree(world_rank, comm.group, comm.cid, epoch, 1)
+    survivors = tuple(r for r in comm.group if r not in dead)
+    drain = getattr(ctx, "drain_failed_state", None)
+    if drain is not None:
+        drain(comm.cid)
+    if world_rank not in survivors:
+        return COMM_NULL
+    if not dead:
+        # nothing failed (e.g. the thread tier, where ranks share a
+        # process): still a fresh communicator, via the ordinary collective
+        # cid allocation — the channel combine runs alloc_cid once
+        new_cid = ctx.channel(("ftshrink", comm.cid, epoch), len(survivors),
+                              survivors).run(
+            survivors.index(world_rank), None,
+            lambda contribs: [ctx.alloc_cid()] * len(contribs),
+            f"Comm_shrink@{comm.cid}")
+    else:
+        new_cid = ("shrink", comm.cid, epoch)
+    # register the survivor channel EAGERLY with its group: check_fault
+    # consults the channel's group to scope failures, which is what lets a
+    # shrunk communicator keep operating while failed_ranks stays non-empty
+    ctx.channel(new_cid, len(survivors), survivors)
+    return Comm(survivors, new_cid, name=f"{comm.name}.shrink")
 
 
 class Intercomm(Comm):

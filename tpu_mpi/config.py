@@ -147,6 +147,18 @@ class Config:
     # per-collective latency histogram width (log2-microsecond buckets):
     # bucket i counts ops with latency in [2^(i-1), 2^i) us.
     pvars_hist_bins: int = 24
+    # fault tolerance (docs/fault-tolerance.md): heartbeat period in
+    # milliseconds on the native-transport poll loop. 0 (the default)
+    # disables the failure detector entirely — the fault path is strictly
+    # pay-for-use; fate-sharing semantics are unchanged.
+    heartbeat_ms: int = 0
+    # milliseconds of heartbeat silence before a peer is declared dead
+    # (ProcFailedError). 0 derives 10x heartbeat_ms (min 1000 ms).
+    failure_timeout_ms: int = 0
+    # deadline for any single blocking recv / request Wait, milliseconds:
+    # past it the op raises DeadlockError with the per-rank pending-op dump
+    # even when the global deadlock_timeout is longer. 0 disables (default).
+    op_timeout_ms: int = 0
 
     def replace(self, **kw: Any) -> "Config":
         d = {f.name: getattr(self, f.name) for f in fields(self)}
@@ -186,6 +198,9 @@ _ENV_MAP = {
     "pvars": "TPU_MPI_PVARS",
     "pvars_dump": "TPU_MPI_PVARS_DUMP",
     "pvars_hist_bins": "TPU_MPI_PVARS_HIST_BINS",
+    "heartbeat_ms": "TPU_MPI_HEARTBEAT_MS",
+    "failure_timeout_ms": "TPU_MPI_FAILURE_TIMEOUT_MS",
+    "op_timeout_ms": "TPU_MPI_OP_TIMEOUT_MS",
 }
 
 _lock = threading.Lock()

@@ -57,6 +57,8 @@ ERR_COLLECTIVE_MISMATCH = 65
 ERR_ABORTED = 66
 ERR_RMA_RACE = 67
 ERR_ANALYZE = 68
+ERR_PROC_FAILED = 69
+ERR_REVOKED = 70
 
 _ERROR_STRINGS = {
     SUCCESS: "MPI_SUCCESS: no error",
@@ -107,6 +109,10 @@ _ERROR_STRINGS = {
                   "one exposure epoch (tpu_mpi.analyze race detector)",
     ERR_ANALYZE: "TPU_ERR_ANALYZE: communication-correctness diagnostic "
                  "(tpu_mpi.analyze)",
+    ERR_PROC_FAILED: "TPU_ERR_PROC_FAILED: a peer process died (heartbeat "
+                     "timeout or closed transport socket) — shrink or abort",
+    ERR_REVOKED: "TPU_ERR_REVOKED: communicator revoked by Comm_revoke after "
+                 "a failure; only Comm_shrink/Comm_agree remain legal on it",
 }
 
 # tpu_mpi.analyze diagnostic code -> MPI error class. The analyzer's own
@@ -199,6 +205,34 @@ class InvalidCommError(MPIError):
     """Operation on COMM_NULL or a freed communicator."""
 
     CODE = ERR_COMM
+
+
+class ProcFailedError(MPIError):
+    """A peer process died while this rank was communicating with it.
+
+    The ULFM MPI_ERR_PROC_FAILED analog: raised out of a blocked receive or a
+    collective rendezvous when the failure detector (heartbeat timeout or a
+    closed transport socket — docs/fault-tolerance.md) declares a peer of the
+    operation dead, instead of hanging until the deadlock timeout. ``ranks``
+    lists the world ranks known dead at raise time."""
+
+    CODE = ERR_PROC_FAILED
+
+    def __init__(self, msg: str = "peer process failed",
+                 code: "int | None" = None,
+                 ranks: "tuple[int, ...] | None" = None):
+        super().__init__(msg, code=code)
+        self.ranks = tuple(ranks) if ranks else ()
+
+
+class RevokedError(MPIError):
+    """The communicator was revoked (ULFM MPI_ERR_REVOKED analog).
+
+    After ``Comm_revoke`` floods the group, every pending and future
+    operation on the communicator raises this deterministically on every
+    surviving rank; only ``Comm_shrink``/``Comm_agree`` remain legal."""
+
+    CODE = ERR_REVOKED
 
 
 class AnalyzerError(MPIError):

@@ -28,6 +28,15 @@ from typing import Optional
 from ._runtime import spmd_run
 from .error import MPIError
 
+# Distinct job exit codes for the fault-tolerant launch mode
+# (TPU_MPI_HEARTBEAT_MS > 0; docs/fault-tolerance.md):
+# EXIT_SHRUNK_OK  — a rank died by signal, but every survivor finished
+#                   cleanly (revoked + shrunk + completed).
+# EXIT_RANK_FAILED — a rank failed and the job did NOT recover (a survivor
+#                   also exited nonzero, or the failure wasn't a signal).
+EXIT_SHRUNK_OK = 66
+EXIT_RANK_FAILED = 65
+
 
 def _force_sim_devices(n: int) -> None:
     """Force n fake XLA CPU devices; must run before JAX backend init."""
@@ -161,6 +170,16 @@ def launch_processes(path: str, nprocs: int,
             procs.append(subprocess.Popen(
                 [sys.executable, path] + list(script_args or []), env=env))
         code = 0
+        # Fault-tolerant mode: with the failure detector enabled in the
+        # children (TPU_MPI_HEARTBEAT_MS > 0), a dead rank is the SCRIPT's
+        # problem — survivors detect it, revoke, shrink and continue — so
+        # the launcher must not fate-share-kill them. Without it, the
+        # classic mpiexec behavior stands: one rank fails, all die.
+        try:
+            ft_mode = int(os.environ.get("TPU_MPI_HEARTBEAT_MS", "0") or 0) > 0
+        except ValueError:
+            ft_mode = False
+        failures: list[tuple[int, int]] = []      # (rank, returncode)
         deadline = None if timeout is None else (time.monotonic() + timeout)
         pending = list(procs)
         while pending:
@@ -169,11 +188,28 @@ def launch_processes(path: str, nprocs: int,
                 if rc is None:
                     continue
                 pending.remove(p)
-                if rc != 0 and code == 0:
-                    code = rc
-                    # fate-sharing: one rank failed, kill the rest
-                    for q in pending:
-                        q.terminate()
+                if rc != 0:
+                    rank = rank_base + procs.index(p)
+                    if rc < 0:
+                        try:
+                            desc = f"signal {signal.Signals(-rc).name}"
+                        except ValueError:
+                            desc = f"signal {-rc}"
+                    else:
+                        desc = f"exit code {rc}"
+                    stamp = time.strftime("%Y-%m-%dT%H:%M:%S",
+                                          time.localtime())
+                    print(f"tpurun: rank {rank} died ({desc}) at {stamp}"
+                          + ("" if failures else " [first failure]"),
+                          file=sys.stderr, flush=True)
+                    failures.append((rank, rc))
+                    if ft_mode:
+                        continue          # survivors shrink and carry on
+                    if code == 0:
+                        code = rc
+                        # fate-sharing: one rank failed, kill the rest
+                        for q in pending:
+                            q.terminate()
             if pending:
                 if deadline is not None and time.monotonic() > deadline:
                     for q in pending:
@@ -189,6 +225,14 @@ def launch_processes(path: str, nprocs: int,
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
+        if ft_mode and failures and code == 0:
+            # Distinct exit codes for the two fault outcomes: survivors all
+            # finished cleanly after a signal death (revoked + shrunk +
+            # completed) vs. the job genuinely failing.
+            only_signals = all(rc < 0 for _, rc in failures)
+            survivors_ok = len(failures) < nprocs
+            code = (EXIT_SHRUNK_OK if only_signals and survivors_ok
+                    else EXIT_RANK_FAILED)
         return code
     finally:
         if coord is not None:

@@ -236,6 +236,8 @@ def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
     if ctx is None:                      # _send_typed already resolved it
         ctx, _ = require_env()
     ctx.check_failure()
+    if ctx.failed_ranks or ctx.revoked_cids:   # fault path is pay-for-use
+        ctx.check_fault(comm.cid)
     my_rank = comm.rank()
     # no seq stamp here: thread-tier delivery is atomic with ordering (one
     # mailbox lock), so there is nothing to check and the hot path stays
