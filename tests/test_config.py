@@ -64,6 +64,33 @@ def test_bad_value_rejected(clean_env, monkeypatch):
     config.load(refresh=True)
 
 
+def test_serve_knobs(clean_env, monkeypatch):
+    cfg = config.load(refresh=True)
+    assert cfg.serve_socket == ""
+    assert cfg.serve_max_tenants == 8
+    assert cfg.serve_quota_bytes == 0
+    assert cfg.session_token == ""
+    monkeypatch.setenv("TPU_MPI_SERVE_SOCKET", "127.0.0.1:7900")
+    monkeypatch.setenv("TPU_MPI_SERVE_MAX_TENANTS", "3")
+    monkeypatch.setenv("TPU_MPI_SERVE_QUOTA_BYTES", "1048576")
+    monkeypatch.setenv("TPU_MPI_SESSION_TOKEN", "s3cret")
+    cfg = config.load(refresh=True)
+    assert cfg.serve_socket == "127.0.0.1:7900"
+    assert cfg.serve_max_tenants == 3
+    assert cfg.serve_quota_bytes == 1048576
+    assert cfg.session_token == "s3cret"
+    # malformed values fail loudly, matching every other knob
+    monkeypatch.setenv("TPU_MPI_SERVE_MAX_TENANTS", "many")
+    with pytest.raises(MPIError):
+        config.load(refresh=True)
+    monkeypatch.setenv("TPU_MPI_SERVE_MAX_TENANTS", "3")
+    monkeypatch.setenv("TPU_MPI_SERVE_QUOTA_BYTES", "a-lot")
+    with pytest.raises(MPIError):
+        config.load(refresh=True)
+    monkeypatch.setenv("TPU_MPI_SERVE_QUOTA_BYTES", "0")
+    config.load(refresh=True)
+
+
 def test_runtime_deadlock_timeout_uses_env(clean_env, monkeypatch):
     from tpu_mpi._runtime import deadlock_timeout
     monkeypatch.setenv("TPU_MPI_DEADLOCK_TIMEOUT", "7")

@@ -33,3 +33,19 @@ def test_example_runs(name, nsim):
          os.path.join(EXAMPLES, name)],
         capture_output=True, text=True, timeout=180, env=env, cwd=REPO)
     assert res.returncode == 0, res.stderr
+
+
+def test_serve_example_runs():
+    # 12-serve.py hosts its own broker + tenants in one process, so it runs
+    # under plain python rather than tpurun --sim
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("TPU_MPI_PROC_RANK", None)
+    env.pop("TPU_MPI_SERVE_SOCKET", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "12-serve.py")],
+        capture_output=True, text=True, timeout=180, env=env, cwd=REPO)
+    assert res.returncode == 0, res.stderr
+    assert "two tenants, one warm pool" in res.stdout

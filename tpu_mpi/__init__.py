@@ -21,8 +21,9 @@ from ._runtime import (ANY_SOURCE, ANY_TAG, PROC_NULL, UNDEFINED,
                        SpmdContext, spmd_run)
 from .error import (AbortError, AnalyzerError, CollectiveMismatchError,
                     DeadlockError, Error_string, Get_error_string,
-                    InvalidCommError, MPIError, ProcFailedError, RevokedError,
-                    TruncationError)
+                    InvalidCommError, MPIError, ProcFailedError,
+                    QuotaExceededError, RevokedError, ServeBusyError,
+                    SessionError, TruncationError)
 
 # Communication-correctness analysis (docs/analysis.md): static lint,
 # cross-rank trace verifier, RMA race detector.
@@ -129,6 +130,11 @@ def __getattr__(name):
     # lazily computed: building the version string imports jax
     if name == "MPI_LIBRARY_VERSION_STRING":
         return Get_library_version()
+    if name == "serve":
+        # lazy: the serve tier (broker + client sessions, docs/serving.md)
+        # is only paid for by processes that use it
+        import importlib
+        return importlib.import_module(".serve", __name__)
     raise AttributeError(f"module 'tpu_mpi' has no attribute {name!r}")
 
 

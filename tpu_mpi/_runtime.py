@@ -29,7 +29,7 @@ import time
 from typing import Any, Callable, Optional, Sequence
 
 from .error import (AbortError, CollectiveMismatchError, DeadlockError,
-                    MPIError, ProcFailedError, RevokedError)
+                    MPIError, ProcFailedError, RevokedError, SessionError)
 from . import perfvars as _pv
 
 # Wildcards / sentinels (values mirror the MPI spec's spirit; they are our own).
@@ -157,6 +157,52 @@ def require_env() -> tuple["SpmdContext", int]:
         raise MPIError("MPI has not been initialized on this thread; call Init() "
                        "or run under spmd_run()/tpurun")
     return env
+
+
+def current_tenant() -> Optional[str]:
+    """Tenant id the calling thread executes on behalf of (serve tier),
+    or None for single-tenant / non-broker execution."""
+    return getattr(_tls, "tenant", None)
+
+
+def set_current_tenant(tenant: Optional[str]) -> None:
+    """Bind the calling thread to a tenant (broker worker threads only:
+    every cid allocated and every collective channel touched while bound is
+    attributed to — and confined to — that tenant's leased namespace)."""
+    _tls.tenant = tenant
+
+
+class CidNamespace:
+    """A tenant's disjoint slice of the communicator context-id space
+    (docs/serving.md). ``alloc`` is the only mutation; exhaustion is a
+    typed error rather than a silent spill into a neighbor's range."""
+
+    __slots__ = ("tenant", "base", "limit", "_next", "_lock")
+
+    def __init__(self, tenant: str, base: int, limit: int):
+        self.tenant = tenant
+        self.base = base          # first cid of the range (== the world cid)
+        self.limit = limit        # one past the last usable cid
+        self._next = base
+        self._lock = threading.Lock()
+
+    def alloc(self) -> int:
+        with self._lock:
+            if self._next >= self.limit:
+                raise SessionError(
+                    f"tenant {self.tenant!r} exhausted its cid namespace "
+                    f"[{self.base}, {self.limit}) — free communicators or "
+                    f"lease a wider span")
+            cid = self._next
+            self._next += 1
+            return cid
+
+    def owns(self, cid: Any) -> bool:
+        return isinstance(cid, int) and self.base <= cid < self.limit
+
+    def __repr__(self) -> str:
+        return (f"<CidNamespace {self.tenant} [{self.base},{self.limit}) "
+                f"next={self._next}>")
 
 
 _UNSET_CID = object()   # "derive fault_cid from the waitable" sentinel
@@ -686,6 +732,12 @@ class SpmdContext:
         self.failed_ranks: set[int] = set()
         self.departed_ranks: set[int] = set()
         self.revoked_cids: set = set()
+        # Multi-tenant serve tier (docs/serving.md): tenant -> leased cid
+        # namespace. Empty outside a broker — the cross-tenant channel guard
+        # is then a single truth test (pay-for-use, like the fault path).
+        self.cid_namespaces: dict[str, CidNamespace] = {}
+        self._ns_lock = threading.Lock()
+        self._ns_next_base = 1 << 20   # far above itertools.count(2)'s reach
         # Per-rank lifecycle flags (src/environment.jl:267-287 queries).
         self.initialized = [False] * size
         self.finalized = [False] * size
@@ -830,13 +882,100 @@ class SpmdContext:
     # -- communicator context ids -------------------------------------------
     def alloc_cid(self) -> int:
         """Allocate a fresh communicator context id (call from combine only,
-        so all members of the parent communicator agree on the value)."""
+        so all members of the parent communicator agree on the value). A
+        thread bound to a tenant (broker worker) allocates from that
+        tenant's leased namespace so Comm_dup/Comm_split stay in-range."""
+        tenant = current_tenant()
+        if tenant is not None:
+            ns = self.cid_namespaces.get(tenant)
+            if ns is None:
+                raise SessionError(
+                    f"tenant {tenant!r} has no leased cid namespace on this "
+                    f"world (lease revoked?)")
+            return ns.alloc()
         return next(self._next_cid)
+
+    # -- tenant cid namespaces (serve tier, docs/serving.md) ------------------
+    def lease_cid_namespace(self, tenant: str, span: int = 256) -> CidNamespace:
+        """Carve a disjoint cid range for a tenant. Ranges start far above
+        the sequential allocator so the two can never collide."""
+        if span < 1:
+            raise MPIError(f"cid namespace span must be >= 1, got {span}")
+        with self._ns_lock:
+            if tenant in self.cid_namespaces:
+                raise SessionError(f"tenant {tenant!r} already holds a lease "
+                                   f"on this world")
+            base = self._ns_next_base
+            self._ns_next_base += span
+            ns = CidNamespace(tenant, base, base + span)
+            self.cid_namespaces[tenant] = ns
+            return ns
+
+    def namespace_of_cid(self, cid: Any) -> Optional[CidNamespace]:
+        """The namespace owning a cid, or None for shared/pool cids. Tuple
+        cids (internal channels like ftagree) are keyed by their embedded
+        numeric cid."""
+        if isinstance(cid, tuple):
+            cid = next((c for c in cid if isinstance(c, int)), None)
+        if not isinstance(cid, int) or cid < (1 << 20):
+            return None
+        for ns in self.cid_namespaces.values():
+            if ns.owns(cid):
+                return ns
+        return None
+
+    def release_cid_namespace(self, tenant: str) -> list:
+        """Revoke a tenant's lease: drop its namespace and drain every
+        collective channel in its range (lease reclamation — the cids are
+        dead; a straggler op on one raises rather than rendezvousing with
+        nobody). Returns the drained cids."""
+        with self._ns_lock:
+            ns = self.cid_namespaces.pop(tenant, None)
+        if ns is None:
+            return []
+        drained = []
+        with self._channels_lock:
+            for key in list(self._channels):
+                cid = key
+                if isinstance(cid, tuple):
+                    cid = next((c for c in cid if isinstance(c, int)), None)
+                if isinstance(cid, int) and ns.owns(cid):
+                    ch = self._channels.pop(key)
+                    drained.append(key)
+                    drop = getattr(ch, "drop_shm", None)
+                    if drop is not None:
+                        try:
+                            drop()
+                        except Exception:
+                            pass
+        # every cid the tenant ever allocated is dead, channel or not — a
+        # straggler op on one must raise (RevokedError), not rendezvous
+        # with nobody and hang
+        self.revoked_cids.update(range(ns.base, ns._next))
+        self._notify_waiters()
+        return drained
+
+    def check_tenant_cid(self, cid: Any) -> None:
+        """Cross-tenant isolation guard (pay-for-use: callers skip it while
+        ``cid_namespaces`` is empty). A cid inside some tenant's leased
+        range may only be touched by threads bound to that tenant."""
+        ns = self.namespace_of_cid(cid)
+        if ns is None:
+            return
+        tenant = current_tenant()
+        if tenant != ns.tenant:
+            raise SessionError(
+                f"cid {cid} belongs to tenant {ns.tenant!r}; "
+                + (f"caller is tenant {tenant!r}" if tenant is not None
+                   else "caller holds no lease")
+                + " — cross-tenant communicator use is forbidden")
 
     def channel(self, cid: int, size: int,
                 group: Optional[tuple[int, ...]] = None) -> CollectiveChannel:
         # `group` (world ranks, comm order) is unused here — threads share an
         # address space — but the multi-process backend needs it for routing.
+        if self.cid_namespaces:          # serve tier only; else one truth test
+            self.check_tenant_cid(cid)
         with self._channels_lock:
             ch = self._channels.get(cid)
             if ch is None:

@@ -1309,18 +1309,38 @@ class _NbState:
             max_workers=1, thread_name_prefix=f"tpu-mpi-nbcoll-{world_rank}")
         self.outstanding = 0
         self.lock = threading.Lock()
+        # submission id -> op name, insertion-ordered: names the in-flight
+        # ops for diagnostics (Comm.free on a busy comm, lease reclamation)
+        self._seq = 0
+        self._pending: dict[int, str] = {}
 
-    def submit(self, fn):
+    def submit(self, fn, opname: str = "collective"):
         with self.lock:
             self.outstanding += 1
+            self._seq += 1
+            sid = self._seq
+            self._pending[sid] = (opname, None)
         fut = self.executor.submit(fn)
+        with self.lock:
+            if sid in self._pending:        # done() may already have pruned
+                self._pending[sid] = (opname, fut)
 
         def done(_):
             with self.lock:
                 self.outstanding -= 1
+                self._pending.pop(sid, None)
 
         fut.add_done_callback(done)
         return fut
+
+    def pending_ops(self) -> list:
+        """Names of the submissions not yet completed, oldest first. A
+        future can complete (its waiter unblocks) a beat before its done
+        callback prunes the table, so consult the future itself — a
+        ``Wait(); free()`` sequence must never see a phantom pending op."""
+        with self.lock:
+            return [name for name, fut in self._pending.values()
+                    if fut is None or not fut.done()]
 
     def shutdown(self) -> None:
         self.executor.shutdown(wait=False)
@@ -1339,6 +1359,15 @@ def _nb_state(ctx, cid, world_rank, create: bool):
         return st
 
 
+def nb_pending(ctx, cid, world_rank) -> list:
+    """Names of this rank's in-flight nonblocking collectives on one comm
+    (empty when the worker is idle or was never created). Consulted by
+    ``Comm.free`` so freeing under in-flight ops is a typed error naming
+    the offenders instead of a strict-mode-only leak assert."""
+    st = _nb_state(ctx, cid, world_rank, create=False)
+    return st.pending_ops() if st is not None else []
+
+
 def nb_shutdown(ctx, cid=None, world_rank=None) -> None:
     """Release nonblocking-collective workers: the ones of one comm+rank
     (Comm.free) or every one owned by a rank (Finalize)."""
@@ -1352,7 +1381,7 @@ def nb_shutdown(ctx, cid=None, world_rank=None) -> None:
         st.shutdown()
 
 
-def _nb_submit(comm: Comm, fn) -> CollRequest:
+def _nb_submit(comm: Comm, fn, opname: str = "collective") -> CollRequest:
     """Run ``fn`` on this rank's per-comm collective worker (the host-path
     progress engine: the worker thread advances the collective — including
     its pipeline chunks — while the caller is in user code; the request's
@@ -1383,7 +1412,7 @@ def _nb_submit(comm: Comm, fn) -> CollRequest:
             _nb_worker_tls.active = False
             set_env(None)
 
-    req = CollRequest(st.submit(run))
+    req = CollRequest(st.submit(run, opname=opname))
     req.progress = prog
     req.comm_cid = comm.cid       # attributes the caller's Wait time (pvars)
     return req
@@ -1430,53 +1459,56 @@ def _ordered_run(comm: Comm, call):
 
 def Ibarrier(comm: Comm) -> CollRequest:
     """Nonblocking barrier: complete once every rank has entered."""
-    return _nb_submit(comm, lambda: Barrier(comm))
+    return _nb_submit(comm, lambda: Barrier(comm), opname="Ibarrier")
 
 
 def Ibcast(buf: Any, root: int, comm: Comm) -> CollRequest:
     """Nonblocking Bcast; ``req.result`` is the (mutated) buffer."""
-    return _nb_submit(comm, lambda: Bcast(buf, root, comm))
+    return _nb_submit(comm, lambda: Bcast(buf, root, comm), opname="Ibcast")
 
 
 def Iallreduce(*args) -> CollRequest:
     """Nonblocking Allreduce (same flavors as :func:`Allreduce`); the
     allocating variant's value arrives in ``req.result``."""
-    return _nb_submit(_comm_of(args), lambda: Allreduce(*args))
+    return _nb_submit(_comm_of(args), lambda: Allreduce(*args),
+                      opname="Iallreduce")
 
 
 def Ireduce(*args) -> CollRequest:
     """Nonblocking rooted Reduce."""
-    return _nb_submit(_comm_of(args), lambda: Reduce(*args))
+    return _nb_submit(_comm_of(args), lambda: Reduce(*args), opname="Ireduce")
 
 
 def Igather(*args) -> CollRequest:
     """Nonblocking rooted Gather."""
-    return _nb_submit(_comm_of(args), lambda: Gather(*args))
+    return _nb_submit(_comm_of(args), lambda: Gather(*args), opname="Igather")
 
 
 def Iallgather(*args) -> CollRequest:
     """Nonblocking Allgather."""
-    return _nb_submit(_comm_of(args), lambda: Allgather(*args))
+    return _nb_submit(_comm_of(args), lambda: Allgather(*args),
+                      opname="Iallgather")
 
 
 def Iscatter(*args) -> CollRequest:
     """Nonblocking rooted Scatter."""
-    return _nb_submit(_comm_of(args), lambda: Scatter(*args))
+    return _nb_submit(_comm_of(args), lambda: Scatter(*args), opname="Iscatter")
 
 
 def Ialltoall(*args) -> CollRequest:
     """Nonblocking Alltoall."""
-    return _nb_submit(_comm_of(args), lambda: Alltoall(*args))
+    return _nb_submit(_comm_of(args), lambda: Alltoall(*args),
+                      opname="Ialltoall")
 
 
 def Iscan(*args) -> CollRequest:
     """Nonblocking inclusive Scan."""
-    return _nb_submit(_comm_of(args), lambda: Scan(*args))
+    return _nb_submit(_comm_of(args), lambda: Scan(*args), opname="Iscan")
 
 
 def Iexscan(*args) -> CollRequest:
     """Nonblocking exclusive Scan."""
-    return _nb_submit(_comm_of(args), lambda: Exscan(*args))
+    return _nb_submit(_comm_of(args), lambda: Exscan(*args), opname="Iexscan")
 
 
 def _comm_of(args) -> Comm:

@@ -135,7 +135,25 @@ class Comm:
         """Mark the communicator unusable and release this rank's
         nonblocking-collective worker thread, if one was created
         (src/comm.jl MPI_Comm_free analog — no C resources, but the
-        I-collective executor is a real thread)."""
+        I-collective executor is a real thread).
+
+        Freeing under in-flight nonblocking collectives is a typed error
+        naming the pending ops — Wait them first. (MPI_Comm_free's deferred
+        destruction has no analog here: the worker thread and the plan/
+        registry entries go away NOW, so completing the pending ops later
+        is impossible; silently shooting them down is how a broker bug
+        would masquerade as a tenant bug — docs/serving.md lease
+        reclamation depends on telling the two apart.)"""
+        pre_env = current_env()
+        if pre_env is not None:
+            from .collective import nb_pending
+            pending = nb_pending(pre_env[0], self._cid, pre_env[1])
+            if pending:
+                raise MPIError(
+                    f"Comm.free on {self.name} (cid={self._cid}) with "
+                    f"{len(pending)} in-flight nonblocking op(s): "
+                    f"{', '.join(pending)} — Wait/Test them to completion "
+                    f"before freeing", code=_ec.ERR_PENDING)
         self._freed = True
         from .overlap import plans, registry
         plans.invalidate(self._cid)   # cached collective plans die with us

@@ -159,6 +159,21 @@ class Config:
     # past it the op raises DeadlockError with the per-rank pending-op dump
     # even when the global deadlock_timeout is longer. 0 disables (default).
     op_timeout_ms: int = 0
+    # multi-tenant serve tier (docs/serving.md): the well-known socket the
+    # broker listens on and clients attach to. A value containing "/" is a
+    # Unix-domain socket path; otherwise "host:port" TCP. "" = the broker
+    # picks a loopback TCP port and prints it.
+    serve_socket: str = ""
+    # max concurrently-leased tenants the broker admits; attach past the
+    # limit fails with a typed SessionError instead of queueing.
+    serve_max_tenants: int = 8
+    # per-tenant traffic quota, bytes moved through collectives (charged at
+    # admission): past it ops are REJECTED with QuotaExceededError, never
+    # hung. 0 = unlimited.
+    serve_quota_bytes: int = 0
+    # shared secret a client must present in the session handshake; "" (the
+    # default) means the broker accepts any token — loopback/dev mode.
+    session_token: str = ""
 
     def replace(self, **kw: Any) -> "Config":
         d = {f.name: getattr(self, f.name) for f in fields(self)}
@@ -201,6 +216,10 @@ _ENV_MAP = {
     "heartbeat_ms": "TPU_MPI_HEARTBEAT_MS",
     "failure_timeout_ms": "TPU_MPI_FAILURE_TIMEOUT_MS",
     "op_timeout_ms": "TPU_MPI_OP_TIMEOUT_MS",
+    "serve_socket": "TPU_MPI_SERVE_SOCKET",
+    "serve_max_tenants": "TPU_MPI_SERVE_MAX_TENANTS",
+    "serve_quota_bytes": "TPU_MPI_SERVE_QUOTA_BYTES",
+    "session_token": "TPU_MPI_SESSION_TOKEN",
 }
 
 _lock = threading.Lock()

@@ -41,22 +41,37 @@ THREAD_SERIALIZED = ThreadLevel.THREAD_SERIALIZED
 THREAD_MULTIPLE = ThreadLevel.THREAD_MULTIPLE
 
 
-def Init() -> None:
+def Init(session: "str | None" = None) -> None:
     """Initialize the environment on this rank (src/environment.jl:80-89).
 
     Must be called exactly once per rank before any communication. Under
     ``spmd_run``/``tpurun`` it attaches to the launcher's world; standalone it
     creates a world of size 1.
+
+    ``session=`` is the serve-tier attach path (docs/serving.md): instead of
+    paying a cold start, the process attaches to a running ``tpurun --serve``
+    broker at the given address (or ``TPU_MPI_SERVE_SOCKET`` when the string
+    is empty) and receives a lease on the broker's warm world. The attached
+    :class:`~tpu_mpi.serve.ClientSession` is reachable via
+    ``MPI.serve.current_session()`` and is detached by ``Finalize``.
     """
-    Init_thread(ThreadLevel.THREAD_MULTIPLE)
+    Init_thread(ThreadLevel.THREAD_MULTIPLE, session=session)
 
 
-def Init_thread(required: ThreadLevel) -> ThreadLevel:
+def Init_thread(required: ThreadLevel,
+                session: "str | None" = None) -> ThreadLevel:
     """Initialize requesting a thread level (src/environment.jl:148-162).
 
     The host runtime is thread-safe by construction (it *is* threads), so the
-    granted level is always THREAD_MULTIPLE.
+    granted level is always THREAD_MULTIPLE. See :func:`Init` for the
+    ``session=`` serve-tier attach path.
     """
+    if session is not None:
+        from . import serve
+        if serve.current_session() is not None:
+            raise MPIError("MPI.Init(session=...) but a session is already "
+                           "attached on this process")
+        serve._set_current(serve.attach(session or None))
     env = current_env()
     if env is None:
         if os.environ.get("TPU_MPI_PROC_RANK") is not None:
@@ -128,6 +143,13 @@ def Finalize() -> None:
     # (TPU_MPI_PVARS_DUMP) — one branch when pvars are off
     from . import perfvars
     perfvars.finalize_dump()
+    # detach the serve-tier session Init(session=...) opened, releasing the
+    # lease cleanly (broker reclaims the cid namespace as detached)
+    import sys
+    serve = sys.modules.get("tpu_mpi.serve")
+    if serve is not None and serve.current_session() is not None:
+        serve.current_session().detach()
+        serve._set_current(None)
     ctx.finalized[rank] = True
 
 

@@ -59,6 +59,9 @@ ERR_RMA_RACE = 67
 ERR_ANALYZE = 68
 ERR_PROC_FAILED = 69
 ERR_REVOKED = 70
+ERR_QUOTA = 71
+ERR_SERVE_BUSY = 72
+ERR_SESSION = 73
 
 _ERROR_STRINGS = {
     SUCCESS: "MPI_SUCCESS: no error",
@@ -113,6 +116,13 @@ _ERROR_STRINGS = {
                      "timeout or closed transport socket) — shrink or abort",
     ERR_REVOKED: "TPU_ERR_REVOKED: communicator revoked by Comm_revoke after "
                  "a failure; only Comm_shrink/Comm_agree remain legal on it",
+    ERR_QUOTA: "TPU_ERR_QUOTA: tenant byte/op quota exhausted; the broker "
+               "rejected the operation (raise the quota or detach)",
+    ERR_SERVE_BUSY: "TPU_ERR_SERVE_BUSY: broker admission queue full for this "
+                    "tenant — retriable backpressure, resubmit after a backoff",
+    ERR_SESSION: "TPU_ERR_SESSION: session handshake or lease violation "
+                 "(bad token, tenant limit, revoked lease, or a cid outside "
+                 "the leased namespace)",
 }
 
 # tpu_mpi.analyze diagnostic code -> MPI error class. The analyzer's own
@@ -233,6 +243,51 @@ class RevokedError(MPIError):
     surviving rank; only ``Comm_shrink``/``Comm_agree`` remain legal."""
 
     CODE = ERR_REVOKED
+
+
+class QuotaExceededError(MPIError):
+    """A tenant's byte/op quota was exhausted (docs/serving.md).
+
+    Raised by the broker's admission path — the op is REJECTED, never run,
+    and never hangs. ``tenant`` names the offender; ``used``/``quota`` are
+    byte counts at rejection time."""
+
+    CODE = ERR_QUOTA
+
+    def __init__(self, msg: str = "tenant quota exhausted",
+                 code: "int | None" = None, tenant: "str | None" = None,
+                 used: int = 0, quota: int = 0):
+        super().__init__(msg, code=code)
+        self.tenant = tenant
+        self.used = int(used)
+        self.quota = int(quota)
+
+
+class ServeBusyError(MPIError):
+    """Broker admission queue full for this tenant (docs/serving.md).
+
+    The retriable backpressure status of the serve tier: nothing was
+    admitted or charged; resubmitting after a backoff is always safe.
+    ``retriable`` is True by construction so clients can branch on the
+    attribute instead of the code."""
+
+    CODE = ERR_SERVE_BUSY
+    retriable = True
+
+    def __init__(self, msg: str = "serve queue full, retry later",
+                 code: "int | None" = None, tenant: "str | None" = None,
+                 depth: int = 0):
+        super().__init__(msg, code=code)
+        self.tenant = tenant
+        self.depth = int(depth)
+
+
+class SessionError(MPIError):
+    """Session handshake or lease violation (docs/serving.md): bad session
+    token, tenant limit reached, an op on a revoked lease, or a cid outside
+    the leased namespace (cross-tenant cid use)."""
+
+    CODE = ERR_SESSION
 
 
 class AnalyzerError(MPIError):

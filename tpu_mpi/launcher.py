@@ -72,6 +72,104 @@ def launch_script(path: str, nprocs: int, script_args: Optional[list[str]] = Non
         sys.argv = old_argv
 
 
+class Rendezvous:
+    """The address-map bootstrap, factored so the classic ``tpurun --procs``
+    path and the serve broker's process pool (docs/serving.md) share one
+    implementation: children report their transport ports to a coordinator
+    and every child receives the full world address map.
+
+    Two construction modes mirror the two launch shapes:
+
+    - ``Rendezvous(world, ...)`` creates the coordinator (first host /
+      broker) — a :class:`tpu_mpi.backend.Coordinator` under the hood;
+    - ``Rendezvous.join(addr, world)`` wraps an existing coordinator's
+      address (hosts 2..H of a multi-host job) — same ``child_env`` surface,
+      no local server.
+
+    ``child_env(rank)`` builds the complete child environment: the
+    ``TPU_MPI_PROC_{RANK,SIZE,COORD}`` rendezvous triple, a PYTHONPATH
+    that resolves this tpu_mpi wherever the script lives, the exported
+    frame-size knob, and the CPU-sim substrate flags when requested.
+    """
+
+    def __init__(self, world: int, *, port: int = 0,
+                 host: Optional[str] = None,
+                 advertise: Optional[str] = None,
+                 rank_base: int = 0,
+                 base_addrs: Optional[list[str]] = None):
+        from . import config
+        from .backend import Coordinator
+        cfg = config.load()
+        self.world = world
+        self.coordinator = Coordinator(
+            world, host=host or cfg.coordinator_bind, port=port,
+            advertise=advertise if advertise is not None
+            else (cfg.coordinator_advertise or None),
+            rank_base=rank_base, base_addrs=base_addrs)
+        self.address = self.coordinator.address
+        self._swept = False
+
+    @classmethod
+    def join(cls, address: str, world: int) -> "Rendezvous":
+        """An already-running coordinator elsewhere; this instance only
+        builds child environments pointing at it."""
+        self = cls.__new__(cls)
+        self.world = world
+        self.coordinator = None
+        self.address = address
+        self._swept = False
+        return self
+
+    def child_env(self, rank: int, *, sim: Optional[int] = None,
+                  extra: Optional[dict] = None) -> dict:
+        from . import config
+        cfg = config.load()
+        env = dict(os.environ)
+        # Children run `python script.py`, whose sys.path[0] is the script's
+        # directory — make sure they can import this tpu_mpi no matter where
+        # the script lives (the mpiexecjl --project flag analog).
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        old_pp = env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = pkg_parent + (os.pathsep + old_pp if old_pp else "")
+        env["TPU_MPI_PROC_RANK"] = str(rank)
+        env["TPU_MPI_PROC_SIZE"] = str(self.world)
+        env["TPU_MPI_PROC_COORD"] = self.address
+        # The native transport reads knobs from the environment only;
+        # export the merged config so TOML-persisted values reach children.
+        env.setdefault("TPU_MPI_MAX_FRAME_BYTES", str(cfg.max_frame_bytes))
+        if sim is not None:
+            env["JAX_PLATFORMS"] = "cpu"
+            flags = env.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                env["XLA_FLAGS"] = (
+                    flags + f" --xla_force_host_platform_device_count={sim}"
+                ).strip()
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        if extra:
+            env.update(extra)
+        return env
+
+    def wait_map(self, timeout: float) -> list[str]:
+        """Block until every expected registrant arrived; the full world
+        address table."""
+        if self.coordinator is None:
+            raise MPIError("wait_map on a joined Rendezvous (the map lives "
+                           "at the remote coordinator)")
+        return self.coordinator.wait_map(timeout)
+
+    def close(self, sweep: bool = False) -> None:
+        """Stop the coordinator; ``sweep=True`` additionally reclaims
+        shm-lane segments orphaned by crashed children — only safe once
+        every child is really gone (a rank still mid-spill would recreate
+        segments after the sweep)."""
+        if self.coordinator is not None:
+            self.coordinator.close()
+        if sweep and not self._swept:
+            self._swept = True
+            from .backend import sweep_segments
+            sweep_segments(self.address.rsplit(":", 1)[-1])
+
+
 def launch_processes(path: str, nprocs: int,
                      script_args: Optional[list[str]] = None,
                      timeout: Optional[float] = None,
@@ -95,53 +193,26 @@ def launch_processes(path: str, nprocs: int,
     import signal
     import subprocess
 
-    from . import config
-    from .backend import Coordinator
-
-    cfg = config.load()
     world = world_size if world_size is not None else nprocs
     if not (0 <= rank_base and rank_base + nprocs <= world):
         raise MPIError(f"local ranks [{rank_base}, {rank_base + nprocs}) "
                        f"outside world of {world}")
-    coord = None
     if coordinator is None:
-        coord = Coordinator(world, host=cfg.coordinator_bind, port=coord_port,
-                            advertise=cfg.coordinator_advertise or None)
-        coord_addr = coord.address
+        rdv = Rendezvous(world, port=coord_port)
         if world > nprocs:
             # remaining hosts need this address; print it where a wrapping
             # scheduler can scrape it
-            print(f"tpurun: coordinator at {coord_addr} "
+            print(f"tpurun: coordinator at {rdv.address} "
                   f"(waiting for {world - nprocs} remote ranks)",
                   file=sys.stderr, flush=True)
     else:
-        coord_addr = coordinator
+        rdv = Rendezvous.join(coordinator, world)
+    coord_addr = rdv.address
     procs: list[subprocess.Popen] = []
     try:
-        # Children run `python script.py`, whose sys.path[0] is the script's
-        # directory — make sure they can import this tpu_mpi no matter where
-        # the script lives (the mpiexecjl --project flag analog).
-        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         for rank in range(rank_base, rank_base + nprocs):
-            env = dict(os.environ)
-            old_pp = env.get("PYTHONPATH", "")
-            env["PYTHONPATH"] = (pkg_parent + (os.pathsep + old_pp if old_pp else ""))
-            env["TPU_MPI_PROC_RANK"] = str(rank)
-            env["TPU_MPI_PROC_SIZE"] = str(world)
-            env["TPU_MPI_PROC_COORD"] = coord_addr
-            # The native transport reads knobs from the environment only;
-            # export the merged config so TOML-persisted values reach children.
-            env.setdefault("TPU_MPI_MAX_FRAME_BYTES", str(cfg.max_frame_bytes))
-            if sim is not None:
-                env["JAX_PLATFORMS"] = "cpu"
-                flags = env.get("XLA_FLAGS", "")
-                if "xla_force_host_platform_device_count" not in flags:
-                    env["XLA_FLAGS"] = (
-                        flags
-                        + f" --xla_force_host_platform_device_count={sim}"
-                    ).strip()
-                env.pop("PALLAS_AXON_POOL_IPS", None)
-            else:
+            env = rdv.child_env(rank, sim=sim)
+            if sim is None:
                 # Real-hardware procs tier: libtpu is process-exclusive, so
                 # without a per-child chip assignment every rank process
                 # would fight over the whole host's TPUs. Bind rank i of
@@ -235,8 +306,7 @@ def launch_processes(path: str, nprocs: int,
                     else EXIT_RANK_FAILED)
         return code
     finally:
-        if coord is not None:
-            coord.close()
+        rdv.close()
         for p in procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
@@ -251,8 +321,7 @@ def launch_processes(path: str, nprocs: int,
                 except subprocess.TimeoutExpired:
                     p.kill()
                     p.wait()
-        from .backend import sweep_segments
-        sweep_segments(coord_addr.rsplit(":", 1)[-1])
+        rdv.close(sweep=True)
 
 
 def install_tpurun(command: str = "tpurun",
@@ -294,6 +363,14 @@ def main(argv: Optional[list[str]] = None) -> int:
         # belong to the tuner.
         from . import tune
         return tune.main(argv[1:])
+    if argv[:1] == ["--serve"]:
+        # `tpurun --serve [...]` — the multi-tenant broker daemon
+        # (tpu_mpi.serve, docs/serving.md): own a warm world and lease
+        # slices of it to client sessions; `--serve --stats` queries a
+        # running broker's per-tenant ledger. All following args belong
+        # to the broker CLI.
+        from .serve import broker
+        return broker.main(argv[1:])
     if argv[:1] == ["--stats"]:
         # `tpurun --stats <dumps...>` / `tpurun --stats -- <launch args>` —
         # the pvar report CLI (tpu_mpi.stats): aggregate per-rank counter

@@ -1,0 +1,732 @@
+"""The ``tpurun --serve`` broker: a warm world leased to many tenants.
+
+One broker process owns one warm :class:`~tpu_mpi._runtime.SpmdContext` —
+rank threads that already ran ``MPI.Init`` and a priming collective, so the
+plan caches are hot — and leases slices of it to short-lived client
+sessions over the framed session protocol (``serve.protocol``). The shape
+(docs/serving.md):
+
+    client ──HELLO──▶ handler thread ──▶ Ledger.charge ─▶ FairQueue
+                                                             │ (DRR)
+    client ◀─RESULT── handler thread ◀── PoolOp.done ◀── dispatcher
+                                                             │
+                                              rank worker threads (warm)
+
+- one **handler thread** per connected client: authenticates, grants the
+  lease (tenant id + rank map + cid-namespace range), then turns OP frames
+  into :class:`PoolOp`\\ s and waits for their completion;
+- one **dispatcher thread** pops the fair queue in deficit-round-robin
+  order and fans each op out to the rank worker queues atomically, so
+  every rank initiates collectives in the same global order (the same
+  invariant the launcher tier gets from program order);
+- N **rank worker threads**, each bound to one world rank of the warm
+  context, executing closures serially. While executing for a tenant the
+  thread carries the tenant in TLS (``set_current_tenant``), which routes
+  ``alloc_cid`` into the tenant's namespace and arms the cross-tenant cid
+  guard in ``SpmdContext.channel``.
+
+Attach is <1 ms because nothing collective happens on the attach path: the
+lease's root cid comes straight from the tenant's freshly carved namespace
+(broker-side allocation, no rendezvous), and the world is already Init'd.
+
+Fate-sharing note: a combine-step exception would poison the whole pool
+via ``ctx.fail`` (thread-tier fate sharing), so the broker validates every
+op — shapes, dtypes, cid ownership, quota — at admission, before anything
+touches a rank queue. A malformed op is a typed ERROR frame to one tenant,
+never a pool-wide failure.
+"""
+
+from __future__ import annotations
+
+import hmac
+import itertools
+import json
+import os
+import queue
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import config
+from .. import error as _ec
+from ..error import MPIError, SessionError
+from .._runtime import SpmdContext, set_current_tenant, set_env
+from . import protocol
+from .ledger import Ledger
+from .queueing import FairQueue
+
+_OPS = None                       # lazy operator table (imports jax)
+
+
+def _reduce_op(name: str):
+    global _OPS
+    if _OPS is None:
+        from .. import operators
+        _OPS = {"sum": operators.SUM, "prod": operators.PROD,
+                "min": operators.MIN, "max": operators.MAX}
+    op = _OPS.get(name)
+    if op is None:
+        raise MPIError(f"unknown reduce op {name!r}; serve supports "
+                       f"{sorted(_OPS)}", code=_ec.ERR_OP)
+    return op
+
+
+class PoolOp:
+    """One admitted client op on its way through the fair queue to the
+    rank workers. ``done`` fires once every member rank finished."""
+
+    __slots__ = ("oid", "tenant", "kind", "cid", "parts", "reduce",
+                 "root", "nbytes", "done", "results", "error")
+
+    def __init__(self, oid: int, tenant: str, kind: str, cid: int,
+                 parts: List[np.ndarray], reduce: str, root: int):
+        self.oid = oid
+        self.tenant = tenant
+        self.kind = kind
+        self.cid = cid
+        self.parts = parts
+        self.reduce = reduce
+        self.root = root
+        self.nbytes = sum(int(p.nbytes) for p in parts)
+        self.done = threading.Event()
+        self.results: Optional[list] = None
+        self.error: Optional[BaseException] = None
+
+
+class _ThreadPool:
+    """The warm world: one SpmdContext, one worker thread per rank, each
+    Init'd once at broker start and reused by every tenant."""
+
+    kind = "threads"
+
+    def __init__(self, nranks: int):
+        self.nranks = int(nranks)
+        self.ctx = SpmdContext(self.nranks)
+        self._queues: List[queue.Queue] = [queue.Queue()
+                                           for _ in range(self.nranks)]
+        self._threads: List[threading.Thread] = []
+        self._dispatch_lock = threading.Lock()
+        self._comms: Dict[int, Any] = {}          # cid -> Comm (shared)
+        self._comms_lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        for r in range(self.nranks):
+            t = threading.Thread(target=self._worker, args=(r,),
+                                 name=f"serve-rank{r}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        self._warm()
+
+    def _worker(self, rank: int) -> None:
+        set_env((self.ctx, rank))
+        from .. import environment
+        environment.Init()
+        q = self._queues[rank]
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            tenant, fn = item
+            set_current_tenant(tenant)
+            try:
+                fn(rank)
+            finally:
+                set_current_tenant(None)
+
+    def _warm(self) -> None:
+        """Prime the pool before the first lease: a Barrier plus a tiny
+        Allreduce on a pool-internal comm walks the whole collective path
+        (channels, plan cache, jit warm-up) so the first tenant op pays
+        none of it."""
+        from ..comm import Comm
+        cid = self.ctx.alloc_cid()            # pool allocator (no tenant TLS)
+        comm = Comm(tuple(range(self.nranks)), cid, ctx=self.ctx,
+                    name="serve-warm")
+        with self._comms_lock:
+            self._comms[cid] = comm
+        self._run_on_all(None, lambda rank: self._warm_body(comm))
+
+    @staticmethod
+    def _warm_body(comm) -> None:
+        from .. import collective
+        collective.Barrier(comm)
+        collective.Allreduce(np.ones(8, np.float32), _reduce_op("sum"), comm)
+
+    def _run_on_all(self, tenant: Optional[str], fn) -> None:
+        """Run ``fn(rank)`` on every rank worker and wait; exceptions
+        propagate to the caller (used for warm-up only)."""
+        done = threading.Event()
+        errs: list = []
+        remaining = [self.nranks]
+        lock = threading.Lock()
+
+        def wrapped(rank):
+            try:
+                fn(rank)
+            except BaseException as e:          # noqa: BLE001 - reported below
+                errs.append(e)
+            finally:
+                with lock:
+                    remaining[0] -= 1
+                    if remaining[0] == 0:
+                        done.set()
+
+        with self._dispatch_lock:
+            for q in self._queues:
+                q.put((tenant, wrapped))
+        done.wait()
+        if errs:
+            raise errs[0]
+
+    def shutdown(self) -> None:
+        for q in self._queues:
+            q.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
+
+    # -- comm registry -------------------------------------------------------
+    def register_comm(self, group, cid: int, tenant: str):
+        from ..comm import Comm
+        comm = Comm(tuple(group), cid, ctx=self.ctx,
+                    name=f"serve:{tenant}")
+        with self._comms_lock:
+            self._comms[cid] = comm
+        return comm
+
+    def comm_for(self, cid: int):
+        with self._comms_lock:
+            return self._comms.get(cid)
+
+    def drop_comm(self, cid: int) -> None:
+        with self._comms_lock:
+            self._comms.pop(cid, None)
+
+    # -- op execution --------------------------------------------------------
+    def run_op(self, op: PoolOp, on_done) -> None:
+        """Fan ``op`` out to every member rank's queue atomically (one
+        dispatch lock → every rank sees the same initiation order) and
+        return immediately; ``on_done(op)`` fires from the last rank."""
+        comm = self.comm_for(op.cid)
+        if comm is None:
+            op.error = SessionError(f"cid {op.cid} has no live communicator")
+            on_done(op)
+            return
+        group = comm.group
+        results: list = [None] * len(group)
+        remaining = [len(group)]
+        lock = threading.Lock()
+
+        def make(i):
+            def run(rank):
+                try:
+                    results[i] = self._execute(op, comm, i, rank)
+                except BaseException as e:      # noqa: BLE001 - sent as ERROR
+                    op.error = e
+                finally:
+                    with lock:
+                        remaining[0] -= 1
+                        last = remaining[0] == 0
+                    if last:
+                        op.results = results
+                        on_done(op)
+            return run
+
+        with self._dispatch_lock:
+            for i, world_rank in enumerate(group):
+                self._queues[world_rank].put((op.tenant, make(i)))
+
+    def _execute(self, op: PoolOp, comm, i: int, rank: int):
+        from .. import collective
+        if op.kind == "allreduce":
+            part = op.parts[i] if len(op.parts) > 1 else op.parts[0]
+            return collective.Allreduce(part, _reduce_op(op.reduce), comm)
+        if op.kind == "bcast":
+            buf = (np.array(op.parts[0], copy=True) if i == op.root
+                   else np.empty_like(op.parts[0]))
+            return collective.Bcast(buf, op.root, comm)
+        if op.kind == "barrier":
+            collective.Barrier(comm)
+            return None
+        if op.kind == "dup":
+            from ..comm import Comm_dup
+            return Comm_dup(comm)
+        if op.kind == "free":
+            from ..collective import nb_shutdown
+            nb_shutdown(self.ctx, op.cid, rank)
+            if i == 0:
+                from ..overlap import plans
+                plans.invalidate(op.cid)
+            return None
+        raise MPIError(f"unknown serve op kind {op.kind!r}", code=_ec.ERR_ARG)
+
+    # -- namespace plumbing (delegates to the warm context) -------------------
+    def lease_ns(self, tenant: str, span: int):
+        return self.ctx.lease_cid_namespace(tenant, span=span)
+
+    def release_ns(self, tenant: str) -> list:
+        return self.ctx.release_cid_namespace(tenant)
+
+    def snapshot_pvars(self) -> dict:
+        from .. import perfvars
+        return perfvars.snapshot()
+
+    def info(self) -> dict:
+        return {"kind": self.kind, "nranks": self.nranks,
+            "comms": len(self._comms)}
+
+
+class Lease:
+    """A tenant's live attachment: its namespace, its communicators, and
+    the socket the handler serves it on."""
+
+    __slots__ = ("tenant", "ns", "group", "root_cid", "comms", "conn",
+                 "send_lock", "attached_at", "revoked")
+
+    def __init__(self, tenant: str, ns, group, root_cid: int, conn):
+        self.tenant = tenant
+        self.ns = ns
+        self.group = tuple(group)
+        self.root_cid = root_cid
+        self.comms = {root_cid}           # cids this lease may touch
+        self.conn = conn
+        self.send_lock = threading.Lock()
+        self.attached_at = time.time()
+        self.revoked = False
+
+
+class Broker:
+    """The serve daemon: listener + dispatcher + per-client handlers over
+    one warm pool. Construct, :meth:`start`, then :meth:`serve_forever`
+    (or drive :meth:`handle_connection` from tests)."""
+
+    def __init__(self, nranks: int = 4, socket_spec: Optional[str] = None,
+                 *, token: Optional[str] = None,
+                 max_tenants: Optional[int] = None,
+                 quota_bytes: Optional[int] = None,
+                 quantum: int = 1 << 16, max_depth: int = 64,
+                 max_inflight: int = 2, ns_span: int = 256):
+        cfg = config.load()
+        self.token = cfg.session_token if token is None else token
+        self.max_tenants = (cfg.serve_max_tenants if max_tenants is None
+                            else int(max_tenants))
+        self.pool = _ThreadPool(nranks)
+        self.fq = FairQueue(quantum=quantum, max_depth=max_depth,
+                            max_inflight=max_inflight)
+        self.ledger = Ledger(cfg.serve_quota_bytes if quota_bytes is None
+                             else int(quota_bytes))
+        self.ns_span = int(ns_span)
+        self._socket_spec = (cfg.serve_socket if socket_spec is None
+                             else socket_spec)
+        self._listener: Optional[socket.socket] = None
+        self.address: Optional[str] = None
+        self._leases: Dict[str, Lease] = {}
+        self._lease_lock = threading.Lock()
+        # cid-range ownership outlives the lease so pvar attribution in the
+        # ledger stays correct after revocation
+        self._cid_ranges: List[tuple] = []    # (base, limit, tenant)
+        self._oid = itertools.count(1)
+        self._tenant_seq = itertools.count(1)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.started = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        """Warm the pool, bind the socket, start dispatcher + acceptor."""
+        self.pool.start()
+        self._listener, self.address = protocol.listen(self._socket_spec)
+        self._listener.settimeout(0.2)
+        d = threading.Thread(target=self._dispatch_loop,
+                             name="serve-dispatch", daemon=True)
+        d.start()
+        self._threads.append(d)
+        self.started.set()
+
+    def serve_forever(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self.handle_connection, args=(conn,),
+                                 name="serve-client", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def run_in_thread(self) -> threading.Thread:
+        """start() + serve_forever() on a daemon thread (tests, examples)."""
+        self.start()
+        t = threading.Thread(target=self.serve_forever, name="serve-accept",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        return t
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._lease_lock:
+            leases = list(self._leases.values())
+        for lease in leases:
+            self.revoke_lease(lease, "broker shutting down")
+        self.fq.close()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        self.pool.shutdown()
+
+    # -- dispatcher ----------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            op = self.fq.pop(timeout=0.2)
+            if op is None:
+                continue
+            self.pool.run_op(op, self._op_done)
+
+    def _op_done(self, op: PoolOp) -> None:
+        self.fq.complete(op)
+        op.done.set()
+
+    # -- attach / leases -----------------------------------------------------
+    def _check_token(self, supplied: Optional[str]) -> None:
+        if not self.token:
+            return                            # open broker ("" accepts any)
+        if not hmac.compare_digest(str(supplied or ""), self.token):
+            raise SessionError("session token rejected "
+                               "(TPU_MPI_SESSION_TOKEN mismatch)")
+
+    def attach_tenant(self, conn, meta: dict) -> Lease:
+        self._check_token(meta.get("token"))
+        with self._lease_lock:
+            if len(self._leases) >= self.max_tenants:
+                raise SessionError(
+                    f"broker at max_tenants={self.max_tenants} "
+                    f"(TPU_MPI_SERVE_MAX_TENANTS) — detach a tenant first")
+            tenant = meta.get("tenant") or f"t{next(self._tenant_seq)}"
+            if tenant in self._leases:
+                raise SessionError(f"tenant id {tenant!r} already attached")
+            nranks = int(meta.get("nranks") or self.pool.nranks)
+            if not 1 <= nranks <= self.pool.nranks:
+                raise SessionError(
+                    f"requested nranks={nranks} outside pool size "
+                    f"{self.pool.nranks}")
+            ns = self.pool.lease_ns(tenant, self.ns_span)
+            self._cid_ranges.append((ns.base, ns.limit, tenant))
+            # nothing collective below: root cid is a broker-side alloc, so
+            # attach stays on the <1 ms budget
+            root_cid = ns.alloc()
+            group = tuple(range(nranks))
+            self.pool.register_comm(group, root_cid, tenant)
+            lease = Lease(tenant, ns, group, root_cid, conn)
+            self._leases[tenant] = lease
+        self.fq.add_tenant(tenant)
+        self.ledger.open_tenant(tenant)
+        return lease
+
+    def revoke_lease(self, lease: Lease, reason: str, *,
+                     close_conn: bool = True) -> None:
+        """Reclaim everything a dead/departing tenant held: queued ops are
+        failed, its cid range is drained + revoked on the warm context
+        (stragglers raise, never hang), its comms and plan-cache entries
+        dropped, its ledger books closed. The pool itself stays healthy."""
+        with self._lease_lock:
+            if self._leases.get(lease.tenant) is not lease:
+                return                        # already revoked
+            del self._leases[lease.tenant]
+            lease.revoked = True
+        for op in self.fq.remove_tenant(lease.tenant):
+            op.error = SessionError(
+                f"lease for tenant {lease.tenant!r} revoked ({reason}) "
+                f"before the op dispatched")
+            op.done.set()
+        self.pool.release_ns(lease.tenant)
+        from ..overlap import plans
+        for cid in list(lease.comms):
+            self.pool.drop_comm(cid)
+            plans.invalidate(cid)
+        self.ledger.close_tenant(lease.tenant,
+                                 revoked=reason != "client detached")
+        if close_conn:
+            try:
+                lease.conn.close()
+            except OSError:
+                pass
+
+    # -- per-connection protocol loop ----------------------------------------
+    def handle_connection(self, conn: socket.socket) -> None:
+        try:
+            kind, meta, _ = protocol.recv_frame(conn)
+        except (protocol.Disconnect, SessionError):
+            conn.close()
+            return
+        if kind == protocol.STATS:
+            # lease-less admin probe (tpurun --serve --stats)
+            try:
+                self._check_token(meta.get("token"))
+                protocol.send_frame(conn, protocol.STATS, self.stats())
+            except MPIError as e:
+                protocol.send_frame(conn, protocol.ERROR,
+                                    protocol.error_meta(e))
+            finally:
+                conn.close()
+            return
+        if kind != protocol.HELLO:
+            protocol.send_frame(conn, protocol.ERROR, protocol.error_meta(
+                SessionError(f"expected HELLO, got "
+                             f"{protocol.KIND_NAMES.get(kind, kind)}")))
+            conn.close()
+            return
+        t0 = time.perf_counter()
+        try:
+            lease = self.attach_tenant(conn, meta)
+        except MPIError as e:
+            protocol.send_frame(conn, protocol.ERROR, protocol.error_meta(e))
+            conn.close()
+            return
+        attach_us = (time.perf_counter() - t0) * 1e6
+        protocol.send_frame(conn, protocol.LEASE, {
+            "tenant": lease.tenant, "ranks": list(lease.group),
+            "cid": lease.root_cid,
+            "cid_base": lease.ns.base, "cid_limit": lease.ns.limit,
+            "pool": self.pool.info(), "attach_us": attach_us})
+        detached = False
+        try:
+            while True:
+                kind, meta, arrays = protocol.recv_frame(conn)
+                if kind == protocol.DETACH:
+                    detached = True
+                    # book the lease out BEFORE replying so a client that
+                    # inspects broker state right after BYE sees it settled
+                    self.revoke_lease(lease, "client detached",
+                                      close_conn=False)
+                    protocol.send_frame(conn, protocol.BYE,
+                                        {"tenant": lease.tenant})
+                    break
+                if kind == protocol.PING:
+                    with lease.send_lock:
+                        protocol.send_frame(conn, protocol.PONG, {})
+                    continue
+                if kind == protocol.STATS:
+                    with lease.send_lock:
+                        protocol.send_frame(conn, protocol.STATS, self.stats())
+                    continue
+                if kind != protocol.OP:
+                    raise SessionError(
+                        f"unexpected {protocol.KIND_NAMES.get(kind, kind)} "
+                        f"frame mid-session")
+                self._serve_op(lease, meta, arrays)
+        except (protocol.Disconnect, SessionError, OSError):
+            pass
+        finally:
+            self.revoke_lease(lease, "client detached" if detached
+                              else "connection lost")
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_op(self, lease: Lease, meta: dict, arrays: list) -> None:
+        try:
+            reply_meta, reply_arrays = self._admit_and_run(lease, meta,
+                                                           arrays)
+        except MPIError as e:
+            # typed rejection (quota, busy, session, arg): one tenant's
+            # ERROR frame, never a pool failure
+            with lease.send_lock:
+                protocol.send_frame(lease.conn, protocol.ERROR,
+                                    protocol.error_meta(e))
+            return
+        with lease.send_lock:
+            protocol.send_frame(lease.conn, protocol.RESULT, reply_meta,
+                                reply_arrays)
+
+    def _admit_and_run(self, lease: Lease, meta: dict, arrays: list):
+        opname = meta.get("op")
+        cid = int(meta.get("cid", lease.root_cid))
+        if cid not in lease.comms:
+            raise SessionError(
+                f"tenant {lease.tenant!r} used cid {cid} outside its lease "
+                f"(owns {sorted(lease.comms)}; namespace "
+                f"[{lease.ns.base}, {lease.ns.limit})) — cross-tenant "
+                f"communicator use is forbidden")
+        # management ops that never touch the rank workers
+        if opname == "pcontrol":
+            level = int(meta.get("level", 1))
+            totals = self.flush_ledger() if level >= 2 else None
+            return {"op": opname, "level": level, "totals": totals}, []
+        if opname in ("allreduce", "bcast"):
+            self._validate_arrays(lease, opname, arrays, meta)
+            if opname == "allreduce":
+                _reduce_op(str(meta.get("reduce", "sum")))
+        elif opname in ("barrier", "dup", "free"):
+            if opname == "free" and cid == lease.root_cid:
+                raise SessionError("the lease's root communicator is freed "
+                                   "by DETACH, not by an explicit free")
+            arrays = []
+        else:
+            raise MPIError(f"unknown serve op {opname!r}", code=_ec.ERR_ARG)
+        op = PoolOp(next(self._oid), lease.tenant, opname, cid,
+                    [np.asarray(a) for a in arrays],
+                    str(meta.get("reduce", "sum")),
+                    int(meta.get("root", 0)))
+        if opname in ("allreduce", "bcast"):
+            # admission book is the quota authority; breach = typed reject
+            self.ledger.charge(lease.tenant, op.nbytes)
+        try:
+            self.fq.submit(op)
+        except MPIError as e:
+            if getattr(e, "retriable", False):
+                self.ledger.note_busy(lease.tenant)
+            raise
+        if not op.done.wait(timeout=120.0):
+            raise SessionError(f"op {opname} (oid={op.oid}) timed out on "
+                               f"the pool")
+        if op.error is not None:
+            err = op.error
+            if isinstance(err, MPIError):
+                raise err
+            raise MPIError(f"pool execution failed: {err}",
+                           code=_ec.ERR_OTHER)
+        return self._reply_for(lease, op)
+
+    def _validate_arrays(self, lease: Lease, opname: str, arrays: list,
+                         meta: dict) -> None:
+        """Admission-time shape/dtype agreement: the pool's combine step
+        fate-shares on error, so anything that could throw there is
+        rejected here instead."""
+        if not arrays:
+            raise MPIError(f"{opname} needs at least one array",
+                           code=_ec.ERR_ARG)
+        if opname == "allreduce" and len(arrays) not in (1, len(lease.group)):
+            raise MPIError(
+                f"allreduce takes 1 replicated part or exactly "
+                f"{len(lease.group)} per-rank parts, got {len(arrays)}",
+                code=_ec.ERR_ARG)
+        if opname == "bcast":
+            root = int(meta.get("root", 0))
+            if not 0 <= root < len(lease.group):
+                raise MPIError(f"bcast root {root} outside comm of size "
+                               f"{len(lease.group)}", code=_ec.ERR_ARG)
+            if len(arrays) != 1:
+                raise MPIError("bcast takes exactly the root's array",
+                               code=_ec.ERR_ARG)
+        first = arrays[0]
+        for a in arrays[1:]:
+            if a.shape != first.shape or a.dtype != first.dtype:
+                raise MPIError(
+                    f"{opname} parts disagree: {a.dtype}{a.shape} vs "
+                    f"{first.dtype}{first.shape}", code=_ec.ERR_ARG)
+
+    def _reply_for(self, lease: Lease, op: PoolOp):
+        if op.kind == "allreduce":
+            # deterministic rank-ordered reduction: every rank's result is
+            # bitwise identical; return rank 0's
+            return {"op": op.kind, "oid": op.oid}, [np.asarray(op.results[0])]
+        if op.kind == "bcast":
+            return {"op": op.kind, "oid": op.oid}, [np.asarray(op.results[0])]
+        if op.kind == "barrier":
+            return {"op": op.kind, "oid": op.oid}, []
+        if op.kind == "dup":
+            new_comm = op.results[0]
+            lease.comms.add(new_comm.cid)
+            with self.pool._comms_lock:
+                self.pool._comms[new_comm.cid] = new_comm
+            return {"op": op.kind, "oid": op.oid, "cid": new_comm.cid}, []
+        if op.kind == "free":
+            lease.comms.discard(op.cid)
+            self.pool.drop_comm(op.cid)
+            return {"op": op.kind, "oid": op.oid}, []
+        raise MPIError(f"unknown kind {op.kind!r}", code=_ec.ERR_ARG)
+
+    # -- accounting ----------------------------------------------------------
+    def _owner_of_cid(self, cid) -> Optional[str]:
+        if isinstance(cid, tuple):
+            cid = next((c for c in cid if isinstance(c, int)), None)
+        if not isinstance(cid, int):
+            return None
+        for base, limit, tenant in self._cid_ranges:
+            if base <= cid < limit:
+                return tenant
+        return None
+
+    def flush_ledger(self) -> dict:
+        """Rebuild the measured books from a fresh pvar snapshot; the
+        returned pool totals equal the sum over tenants by construction."""
+        return self.ledger.flush_from_pvars(self.pool.snapshot_pvars(),
+                                            self._owner_of_cid)
+
+    def stats(self) -> dict:
+        totals = self.flush_ledger()
+        with self._lease_lock:
+            live = sorted(self._leases)
+        return {"address": self.address, "pool": self.pool.info(),
+                "tenants_attached": live, "totals": totals,
+                "ledger": self.ledger.report(), "queue": self.fq.stats()}
+
+
+# -- tpurun --serve CLI -------------------------------------------------------
+
+def _stats_client(address: str, token: str) -> dict:
+    sock = protocol.connect(address)
+    try:
+        protocol.send_frame(sock, protocol.STATS, {"token": token})
+        kind, meta, _ = protocol.recv_frame(sock)
+        if kind == protocol.ERROR:
+            protocol.raise_for_error(meta)
+        return meta
+    finally:
+        sock.close()
+
+
+def main(argv: Optional[list] = None) -> int:
+    """``tpurun --serve [--socket SPEC] [--nranks N] [--stats]``."""
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="tpurun --serve",
+        description="run the multi-tenant broker daemon (docs/serving.md), "
+                    "or query a running one with --stats")
+    p.add_argument("--socket", default=None,
+                   help="serve socket: unix path (contains '/') or host:port "
+                        "(default: TPU_MPI_SERVE_SOCKET, else a loopback "
+                        "port printed at startup)")
+    p.add_argument("--nranks", type=int, default=4,
+                   help="warm pool size (default 4)")
+    p.add_argument("--token", default=None,
+                   help="session token (default: TPU_MPI_SESSION_TOKEN)")
+    p.add_argument("--max-tenants", type=int, default=None)
+    p.add_argument("--quota-bytes", type=int, default=None)
+    p.add_argument("--stats", action="store_true",
+                   help="report per-tenant usage of a running broker and "
+                        "exit")
+    args = p.parse_args(argv)
+
+    cfg = config.load()
+    if args.stats:
+        address = args.socket or cfg.serve_socket
+        if not address:
+            p.error("--stats needs --socket or TPU_MPI_SERVE_SOCKET")
+        token = cfg.session_token if args.token is None else args.token
+        print(json.dumps(_stats_client(address, token), indent=2,
+                         default=str))
+        return 0
+
+    broker = Broker(nranks=args.nranks, socket_spec=args.socket,
+                    token=args.token, max_tenants=args.max_tenants,
+                    quota_bytes=args.quota_bytes)
+    broker.start()
+    print(f"tpu_mpi serve: broker up — pool={args.nranks} ranks, "
+          f"socket={broker.address} (pid {os.getpid()})", flush=True)
+    try:
+        broker.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        broker.close()
+    return 0

@@ -1,0 +1,125 @@
+"""Per-tenant accounting ledger + byte/op quota enforcement.
+
+Two books per tenant (docs/serving.md "Accounting and quotas"):
+
+- **admitted**: bytes/ops charged at admission time, BEFORE the collective
+  runs. This is the authoritative book for quota enforcement — a quota
+  breach rejects with the typed :class:`~tpu_mpi.error.QuotaExceededError`
+  and the op never touches the pool (reject, don't hang).
+- **measured**: bytes/ops attributed from pvar snapshots
+  (``tpu_mpi.perfvars``) by cid-range ownership — every ``(rank, cid)``
+  counter whose cid falls inside a tenant's leased namespace is that
+  tenant's; counters on shared/pool cids land under the ``_pool``
+  pseudo-tenant. By construction the per-tenant measured books sum to the
+  pool totals, which tests/test_serve.py asserts.
+
+``Pcontrol(level >= 2)`` from a session client — or a STATS request —
+drives a flush of the measured book (the broker calls
+:meth:`Ledger.flush_from_pvars` with a fresh snapshot).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ..error import QuotaExceededError
+
+POOL_TENANT = "_pool"     # pseudo-tenant for pre-lease / shared-cid traffic
+
+
+class Ledger:
+    def __init__(self, quota_bytes: int = 0):
+        self.quota_bytes = int(quota_bytes)
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, dict] = {}
+        self._flushes = 0
+        self._last_flush: Optional[float] = None
+
+    def _entry(self, tenant: str) -> dict:
+        e = self._tenants.get(tenant)
+        if e is None:
+            e = self._tenants[tenant] = {
+                "admitted_bytes": 0, "admitted_ops": 0,
+                "rejected_quota": 0, "rejected_busy": 0,
+                "measured": {}, "attached_at": time.time(),
+                "revoked": False, "detached": False,
+            }
+        return e
+
+    # -- lease lifecycle -----------------------------------------------------
+    def open_tenant(self, tenant: str) -> None:
+        with self._lock:
+            self._entry(tenant)
+
+    def close_tenant(self, tenant: str, revoked: bool = False) -> None:
+        """Keep the books (usage survives the lease for --stats); just mark
+        how the lease ended."""
+        with self._lock:
+            e = self._tenants.get(tenant)
+            if e is not None:
+                e["revoked"] = revoked
+                e["detached"] = True
+
+    # -- admission book (quota authority) -------------------------------------
+    def charge(self, tenant: str, nbytes: int, ops: int = 1) -> None:
+        """Charge an op at admission; quota breach is a typed rejection and
+        nothing is charged (the op will not run)."""
+        with self._lock:
+            e = self._entry(tenant)
+            if self.quota_bytes and e["admitted_bytes"] + nbytes > self.quota_bytes:
+                e["rejected_quota"] += 1
+                raise QuotaExceededError(
+                    f"tenant {tenant!r} quota exhausted: "
+                    f"{e['admitted_bytes']} + {nbytes} > "
+                    f"{self.quota_bytes} quota bytes "
+                    f"(TPU_MPI_SERVE_QUOTA_BYTES)", tenant=tenant,
+                    used=e["admitted_bytes"], quota=self.quota_bytes)
+            e["admitted_bytes"] += int(nbytes)
+            e["admitted_ops"] += int(ops)
+
+    def note_busy(self, tenant: str) -> None:
+        with self._lock:
+            self._entry(tenant)["rejected_busy"] += 1
+
+    # -- measured book (pvar attribution) -------------------------------------
+    def flush_from_pvars(self, snapshot: dict,
+                         owner_of_cid: Callable[[Any], Optional[str]]) -> dict:
+        """Rebuild the measured book from a pvar snapshot (the stable
+        schema of ``perfvars.snapshot()``). ``owner_of_cid`` maps a cid to
+        the owning tenant (None -> pool). Returns the pool-total row; the
+        invariant ``sum(tenant rows) == pool totals`` holds by
+        construction because every comm record lands in exactly one row."""
+        fields = ("bytes_sent", "bytes_recv", "sends", "recvs")
+        totals = {f: 0 for f in fields}
+        totals["coll_ops"] = 0
+        books: Dict[str, dict] = {}
+        for rec in snapshot.get("comms", ()):
+            tenant = owner_of_cid(rec.get("cid")) or POOL_TENANT
+            row = books.setdefault(tenant, {f: 0 for f in fields}
+                                   | {"coll_ops": 0})
+            for f in fields:
+                v = int(rec.get(f, 0) or 0)
+                row[f] += v
+                totals[f] += v
+            nops = sum(int(v) for v in (rec.get("ops") or {}).values())
+            row["coll_ops"] += nops
+            totals["coll_ops"] += nops
+        with self._lock:
+            for t in self._tenants:
+                self._tenants[t]["measured"] = books.pop(t, {})
+            for t, row in books.items():
+                self._entry(t)["measured"] = row
+            self._flushes += 1
+            self._last_flush = time.time()
+        return totals
+
+    # -- reporting -----------------------------------------------------------
+    def report(self) -> dict:
+        with self._lock:
+            tenants = {}
+            for t, e in self._tenants.items():
+                tenants[t] = {k: v for k, v in e.items()}
+            return {"quota_bytes": self.quota_bytes, "tenants": tenants,
+                    "flushes": self._flushes, "last_flush": self._last_flush}

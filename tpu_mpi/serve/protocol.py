@@ -1,0 +1,235 @@
+"""Session protocol: the framed wire format of the serve tier.
+
+One frame = fixed header + JSON metadata + zero or more raw array blobs:
+
+    u8  kind        (frame type, table below)
+    u32 json_len    (big-endian)
+    u16 nblobs
+    json_len bytes  UTF-8 JSON metadata
+    nblobs x { u32 blob_len, blob_len raw bytes }
+
+Arrays travel as raw little-endian bytes with dtype/shape carried in the
+metadata (``meta["blobs"]``), reconstructed with ``np.frombuffer`` — the
+round trip is bitwise exact, which the two-tenant correctness test in
+tests/test_serve.py asserts end-to-end.
+
+Frame types (docs/serving.md has the full table):
+
+    HELLO   client/worker -> broker   token, tenant, nranks (or role=worker)
+    LEASE   broker -> client          tenant id, rank map, cid range
+    OP      either direction          a collective / comm-management op
+    RESULT  broker/worker -> peer     op completion + result arrays
+    ERROR   broker -> client          typed failure (code + message)
+    STATS   both                      per-tenant usage report request/reply
+    DETACH  client -> broker          clean lease release
+    BYE     broker -> client          lease revoked / broker shutting down
+    PING/PONG both                    liveness probe
+
+The transport is any SOCK_STREAM socket — TCP or Unix-domain; framing and
+byte order match the native transport's length-prefixed style
+(tpu_mpi/_native/transport.cc) so a future C++ fast path can speak it.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from .. import error as _ec
+from ..error import (MPIError, QuotaExceededError, ServeBusyError,
+                     SessionError)
+
+# frame kinds
+HELLO = 1
+LEASE = 2
+OP = 3
+RESULT = 4
+ERROR = 5
+STATS = 6
+DETACH = 7
+BYE = 8
+PING = 9
+PONG = 10
+
+KIND_NAMES = {HELLO: "HELLO", LEASE: "LEASE", OP: "OP", RESULT: "RESULT",
+              ERROR: "ERROR", STATS: "STATS", DETACH: "DETACH", BYE: "BYE",
+              PING: "PING", PONG: "PONG"}
+
+_HDR = struct.Struct("!BIH")
+_BLOB = struct.Struct("!I")
+
+# Sanity bound for a single frame's JSON section; array blobs are bounded
+# by the config max_frame_bytes knob at recv time.
+_MAX_JSON = 1 << 24
+
+
+class Disconnect(Exception):
+    """Peer closed the connection at a frame boundary (clean EOF)."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        try:
+            chunk = sock.recv(min(n - got, 1 << 20))
+        except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            raise Disconnect(f"connection lost mid-frame: {e}") from None
+        if not chunk:
+            if got == 0 and not chunks:
+                raise Disconnect("peer closed")
+            raise Disconnect("peer closed mid-frame")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def send_frame(sock: socket.socket, kind: int,
+               meta: Optional[dict] = None,
+               arrays: Sequence[Any] = ()) -> None:
+    """Serialize and send one frame (thread-safety is the caller's: wrap in
+    a per-connection send lock when several threads share the socket)."""
+    meta = dict(meta or {})
+    blobs = []
+    if arrays:
+        meta["blobs"] = []
+        for a in arrays:
+            a = np.ascontiguousarray(np.asarray(a))
+            meta["blobs"].append({"dtype": a.dtype.str, "shape": list(a.shape)})
+            blobs.append(a.tobytes())
+    payload = json.dumps(meta, separators=(",", ":")).encode()
+    parts = [_HDR.pack(kind, len(payload), len(blobs)), payload]
+    for b in blobs:
+        parts.append(_BLOB.pack(len(b)))
+        parts.append(b)
+    try:
+        sock.sendall(b"".join(parts))
+    except (ConnectionResetError, BrokenPipeError, OSError) as e:
+        raise Disconnect(f"send failed: {e}") from None
+
+
+def recv_frame(sock: socket.socket) -> tuple[int, dict, list]:
+    """Receive one frame: (kind, meta, arrays). Raises Disconnect on EOF,
+    SessionError on a corrupt stream."""
+    from .. import config
+    kind, json_len, nblobs = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if kind not in KIND_NAMES or json_len > _MAX_JSON:
+        raise SessionError(f"corrupt session frame (kind={kind}, "
+                           f"json_len={json_len})")
+    meta = json.loads(_recv_exact(sock, json_len).decode()) if json_len else {}
+    max_blob = config.load().max_frame_bytes
+    arrays = []
+    descs = meta.get("blobs") or []
+    for i in range(nblobs):
+        (blen,) = _BLOB.unpack(_recv_exact(sock, _BLOB.size))
+        if blen > max_blob:
+            raise SessionError(f"session frame blob of {blen} bytes exceeds "
+                               f"max_frame_bytes={max_blob}")
+        raw = _recv_exact(sock, blen)
+        if i < len(descs):
+            d = descs[i]
+            arrays.append(np.frombuffer(raw, dtype=np.dtype(d["dtype"]))
+                          .reshape(d["shape"]))
+        else:
+            arrays.append(np.frombuffer(raw, dtype=np.uint8))
+    return kind, meta, arrays
+
+
+def error_meta(exc: BaseException) -> dict:
+    """ERROR-frame metadata for an exception (typed errors keep their code,
+    retriability, and structured attributes across the wire)."""
+    meta = {"code": int(getattr(exc, "code", _ec.ERR_OTHER)),
+            "type": type(exc).__name__,
+            "message": str(getattr(exc, "args", [exc])[0]) if exc.args
+                       else str(exc),
+            "retriable": bool(getattr(exc, "retriable", False))}
+    for attr in ("tenant", "used", "quota", "depth"):
+        v = getattr(exc, attr, None)
+        if v is not None:
+            meta[attr] = v
+    return meta
+
+
+def raise_for_error(meta: dict) -> None:
+    """Reconstruct the typed exception an ERROR frame carries and raise it."""
+    code = int(meta.get("code", _ec.ERR_OTHER))
+    msg = meta.get("message", "broker error")
+    if code == _ec.ERR_QUOTA:
+        raise QuotaExceededError(msg, tenant=meta.get("tenant"),
+                                 used=int(meta.get("used", 0)),
+                                 quota=int(meta.get("quota", 0)))
+    if code == _ec.ERR_SERVE_BUSY:
+        raise ServeBusyError(msg, tenant=meta.get("tenant"),
+                             depth=int(meta.get("depth", 0)))
+    if code == _ec.ERR_SESSION:
+        raise SessionError(msg)
+    raise MPIError(msg, code=code)
+
+
+def parse_socket_addr(spec: str) -> tuple[str, Any]:
+    """Classify a serve-socket spec: a value containing "/" is a Unix-domain
+    socket path, otherwise "host:port" TCP. Returns ("unix", path) or
+    ("tcp", (host, port)). Malformed values fail loudly (config contract)."""
+    if not spec:
+        raise MPIError("empty serve socket spec", code=_ec.ERR_ARG)
+    if "/" in spec:
+        return "unix", spec
+    host, sep, port = spec.rpartition(":")
+    if not sep or not host:
+        raise MPIError(f"serve socket {spec!r} is neither a Unix path "
+                       f"(contains '/') nor host:port", code=_ec.ERR_ARG)
+    try:
+        return "tcp", (host, int(port))
+    except ValueError:
+        raise MPIError(f"serve socket {spec!r} has a non-integer port",
+                       code=_ec.ERR_ARG) from None
+
+
+def connect(spec: str, timeout: float = 10.0) -> socket.socket:
+    """Dial a serve socket spec (client side)."""
+    kind, addr = parse_socket_addr(spec)
+    if kind == "unix":
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(timeout)
+        s.connect(addr)
+    else:
+        s = socket.create_connection(addr, timeout=timeout)
+    s.settimeout(None)
+    # latency: a LEASE/RESULT reply is one small write; don't let Nagle
+    # hold it hostage to the next frame
+    try:
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass                                    # AF_UNIX has no TCP options
+    return s
+
+
+def listen(spec: Optional[str]) -> tuple[socket.socket, str]:
+    """Bind + listen on a serve socket spec (broker side). ``None``/"" picks
+    a loopback TCP port. Returns (socket, canonical spec clients dial)."""
+    if not spec:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        s.listen(64)
+        return s, f"127.0.0.1:{s.getsockname()[1]}"
+    kind, addr = parse_socket_addr(spec)
+    if kind == "unix":
+        import os
+        try:
+            os.unlink(addr)
+        except FileNotFoundError:
+            pass
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.bind(addr)
+        s.listen(64)
+        return s, addr
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(addr)
+    s.listen(64)
+    return s, f"{addr[0]}:{s.getsockname()[1]}"

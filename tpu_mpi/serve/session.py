@@ -1,0 +1,199 @@
+"""Client side of the serve tier: attach to a broker, run collectives.
+
+A :class:`ClientSession` is what ``MPI.Init(session=...)`` hands back (via
+:func:`tpu_mpi.serve.current_session`): one socket to the broker, one
+lease (tenant id + rank map + cid-namespace range), and synchronous RPC
+collectives on it. Attach is a single HELLO/LEASE round trip — no Init
+cold start, which the attach-latency benchmark
+(benchmarks/serve_attach.py) quantifies.
+
+Typed broker errors cross the wire: quota breach raises
+:class:`~tpu_mpi.error.QuotaExceededError`, backpressure raises the
+retriable :class:`~tpu_mpi.error.ServeBusyError`, lease violations raise
+:class:`~tpu_mpi.error.SessionError` — the session stays usable after any
+of them (reject, don't hang; see docs/serving.md's failure matrix).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+import numpy as np
+
+from .. import config
+from ..error import SessionError
+from . import protocol
+
+
+class SessionComm:
+    """A communicator handle inside a session lease: just a cid the broker
+    agreed to — all state lives broker-side."""
+
+    __slots__ = ("session", "cid", "nranks")
+
+    def __init__(self, session: "ClientSession", cid: int, nranks: int):
+        self.session = session
+        self.cid = cid
+        self.nranks = nranks
+
+    def __repr__(self) -> str:
+        return f"<SessionComm cid={self.cid} nranks={self.nranks}>"
+
+
+class ClientSession:
+    """One tenant's attachment to a broker (use :func:`attach`)."""
+
+    def __init__(self, sock, lease_meta: dict, address: str):
+        self._sock = sock
+        self._lock = threading.Lock()   # one RPC in flight per session
+        self.address = address
+        self.tenant: str = lease_meta["tenant"]
+        self.ranks: List[int] = list(lease_meta["ranks"])
+        self.cid_base: int = int(lease_meta["cid_base"])
+        self.cid_limit: int = int(lease_meta["cid_limit"])
+        self.attach_us: float = float(lease_meta.get("attach_us", 0.0))
+        self.pool: dict = dict(lease_meta.get("pool", {}))
+        self.comm = SessionComm(self, int(lease_meta["cid"]),
+                                len(self.ranks))
+        self._closed = False
+
+    # -- plumbing ------------------------------------------------------------
+    def _rpc(self, kind: int, meta: dict, arrays=()) -> tuple:
+        with self._lock:
+            if self._closed:
+                raise SessionError("session is detached")
+            protocol.send_frame(self._sock, kind, meta, arrays)
+            rkind, rmeta, rarrays = protocol.recv_frame(self._sock)
+        if rkind == protocol.ERROR:
+            protocol.raise_for_error(rmeta)
+        return rkind, rmeta, rarrays
+
+    def _op(self, meta: dict, arrays=()) -> tuple:
+        _, rmeta, rarrays = self._rpc(protocol.OP, meta, arrays)
+        return rmeta, rarrays
+
+    def _cid(self, comm: Optional[SessionComm]) -> int:
+        return (self.comm if comm is None else comm).cid
+
+    # -- collectives ---------------------------------------------------------
+    def allreduce(self, parts: Any, op: str = "sum",
+                  comm: Optional[SessionComm] = None) -> np.ndarray:
+        """Allreduce over the lease's ranks. ``parts`` is either one array
+        (every rank contributes it) or a list of one array per rank; the
+        reduced array comes back bitwise identical to an in-process
+        deterministic rank-ordered reduction."""
+        if isinstance(parts, (list, tuple)):
+            arrays = [np.asarray(p) for p in parts]
+        else:
+            arrays = [np.asarray(parts)]
+        _, out = self._op({"op": "allreduce", "cid": self._cid(comm),
+                           "reduce": op}, arrays)
+        return out[0]
+
+    def bcast(self, buf: Any, root: int = 0,
+              comm: Optional[SessionComm] = None) -> np.ndarray:
+        _, out = self._op({"op": "bcast", "cid": self._cid(comm),
+                           "root": int(root)}, [np.asarray(buf)])
+        return out[0]
+
+    def barrier(self, comm: Optional[SessionComm] = None) -> None:
+        self._op({"op": "barrier", "cid": self._cid(comm)})
+
+    # -- communicator management ---------------------------------------------
+    def comm_dup(self, comm: Optional[SessionComm] = None) -> SessionComm:
+        """Duplicate a communicator; the new cid is allocated inside this
+        tenant's leased namespace on the broker."""
+        meta, _ = self._op({"op": "dup", "cid": self._cid(comm)})
+        return SessionComm(self, int(meta["cid"]), self.comm.nranks)
+
+    def comm_free(self, comm: SessionComm) -> None:
+        self._op({"op": "free", "cid": comm.cid})
+
+    # -- accounting / liveness ------------------------------------------------
+    def pcontrol(self, level: int = 2) -> dict:
+        """MPI_Pcontrol over the wire: level >= 2 flushes the broker's
+        per-tenant ledger from a fresh pvar snapshot."""
+        meta, _ = self._op({"op": "pcontrol", "cid": self.comm.cid,
+                            "level": int(level)})
+        return meta
+
+    def stats(self) -> dict:
+        _, meta, _ = self._rpc(protocol.STATS, {})
+        return meta
+
+    def ping(self) -> None:
+        self._rpc(protocol.PING, {})
+
+    # -- lifecycle -----------------------------------------------------------
+    def detach(self) -> None:
+        """Clean lease release (the broker reclaims cids and closes the
+        tenant's books as detached, not revoked)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                protocol.send_frame(self._sock, protocol.DETACH, {})
+                protocol.recv_frame(self._sock)       # BYE
+            except (protocol.Disconnect, OSError):
+                pass
+            finally:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+
+    close = detach
+
+    def __enter__(self) -> "ClientSession":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.detach()
+        return False
+
+    def __repr__(self) -> str:
+        state = "detached" if self._closed else "attached"
+        return (f"<ClientSession {self.tenant!r} {state} "
+                f"ranks={self.ranks} cids=[{self.cid_base},"
+                f"{self.cid_limit})>")
+
+
+def attach(address: Optional[str] = None, *, token: Optional[str] = None,
+           tenant: Optional[str] = None, nranks: Optional[int] = None,
+           timeout: float = 10.0) -> ClientSession:
+    """Attach to a running broker and return a live :class:`ClientSession`.
+
+    ``address`` defaults to the ``serve_socket`` knob (TPU_MPI_SERVE_SOCKET)
+    and ``token`` to ``session_token`` (TPU_MPI_SESSION_TOKEN). The broker
+    answers HELLO with either a LEASE (success) or a typed ERROR frame
+    (bad token / max_tenants reached / duplicate tenant id), which is
+    re-raised here as the matching exception."""
+    cfg = config.load()
+    address = address or cfg.serve_socket
+    if not address:
+        raise SessionError("no broker address: pass attach(address=...) or "
+                           "set TPU_MPI_SERVE_SOCKET")
+    token = cfg.session_token if token is None else token
+    sock = protocol.connect(address, timeout=timeout)
+    hello: dict = {"token": token}
+    if tenant is not None:
+        hello["tenant"] = tenant
+    if nranks is not None:
+        hello["nranks"] = int(nranks)
+    try:
+        protocol.send_frame(sock, protocol.HELLO, hello)
+        kind, meta, _ = protocol.recv_frame(sock)
+    except protocol.Disconnect as e:
+        sock.close()
+        raise SessionError(f"broker at {address} hung up during attach: "
+                           f"{e}") from None
+    if kind == protocol.ERROR:
+        sock.close()
+        protocol.raise_for_error(meta)
+    if kind != protocol.LEASE:
+        sock.close()
+        raise SessionError(f"expected LEASE, got "
+                           f"{protocol.KIND_NAMES.get(kind, kind)}")
+    return ClientSession(sock, meta, address)
