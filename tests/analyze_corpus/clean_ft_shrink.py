@@ -1,0 +1,18 @@
+# nprocs: 2
+#
+# Clean fixture: the ULFM-shaped recovery idiom — shrink and REBIND the
+# communicator variable, so every later operation runs on the surviving
+# group. Rebinding is what keeps L110 quiet: the stale parent is
+# unreachable after the assignment.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+work = MPI.Comm_dup(comm)
+x = np.ones(4)
+y = np.zeros(4)
+MPI.Allreduce(x, y, MPI.SUM, work)
+work = MPI.Comm_shrink(work)      # reuse the name: traffic moves over
+MPI.Allreduce(x, y, MPI.SUM, work)
+MPI.Barrier(work)

@@ -1,0 +1,21 @@
+# nprocs: 2
+#
+# Clean fixture: the persistent-collective round loop done right — one
+# Start/Wait per round and each round's result consumed before the
+# Start that would re-donate its slot. Zero lint, zero trace, and the
+# explorer finds nothing to reorder.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+x = np.ones(4)
+out = np.zeros(4)
+req = MPI.Allreduce_init(x, out, MPI.SUM, comm)
+
+total = np.zeros(4)
+for _ in range(3):
+    MPI.Start(req)
+    MPI.Wait(req)
+    total = total + req.result    # consumed before the next Start
+MPI.Barrier(comm)

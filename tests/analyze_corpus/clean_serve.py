@@ -1,0 +1,21 @@
+# nprocs: 2
+#
+# Clean fixture: the serve-tier client idiom — attach, RPCs on the live
+# session, comms stay with the session that dup'ed them, detach last.
+# The client lives in a function the SPMD body does not call (a live
+# broker is exercised by tests/test_serve.py); the lint unit is what
+# this fixture pins down.
+import tpu_mpi as MPI
+from tpu_mpi import serve
+
+
+def client(address, token):
+    ses = serve.attach(address, tenant="alice", token=token)
+    ses.allreduce([1.0])
+    sub = ses.comm_dup()
+    ses.bcast([2.0], root=0, comm=sub)
+    ses.detach()
+
+
+comm = MPI.COMM_WORLD
+MPI.Barrier(comm)

@@ -1,0 +1,21 @@
+# nprocs: 3
+#
+# Clean fixture: schedule-insensitive wildcard receives — both senders
+# post identically-shaped tag-5 messages and the consumer drains
+# exactly two, so every alternate matching the explorer enumerates
+# converges: more than one schedule, zero findings.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+
+if rank == 0:
+    first = np.zeros(4)
+    second = np.zeros(4)
+    MPI.Recv(first, MPI.ANY_SOURCE, 5, comm)
+    MPI.Recv(second, MPI.ANY_SOURCE, 5, comm)
+else:
+    MPI.Send(np.full(4, float(rank)), 0, 5, comm)
+MPI.Barrier(comm)

@@ -1,0 +1,24 @@
+# nprocs: 2
+#
+# Defect class: serve-tier session misuse — a communicator duplicated
+# under one tenant's session is passed to another tenant's RPC. Session
+# comms are tenant-scoped capability handles (the broker accounts and
+# authorizes per tenant), so sharing one across sessions is a quota
+# leak at best and a broker rejection at worst (L111). The defective
+# client lives in a function the SPMD body never calls: the defect is
+# the static shape, not this run.
+import tpu_mpi as MPI
+from tpu_mpi import serve
+
+
+def two_tenant_client(address, token):
+    ses_a = serve.attach(address, tenant="alice", token=token)
+    ses_b = serve.attach(address, tenant="bob", token=token)
+    comm_a = ses_a.comm_dup()
+    ses_b.allreduce([1.0], comm=comm_a)   # lint: L111
+    ses_a.detach()
+    ses_b.detach()
+
+
+comm = MPI.COMM_WORLD
+MPI.Barrier(comm)

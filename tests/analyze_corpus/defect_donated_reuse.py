@@ -1,0 +1,29 @@
+# nprocs: 2
+#
+# Defect class: use of a donated persistent-fold result after the Start
+# that re-donates its registered slot. Tracing disables the fast path
+# (every round hands back a fresh array), so this run computes correct
+# values — but in production mode round 0's result aliases the
+# registered slot that the round-2 Start re-donates, so the late
+# Allreduce reads data the in-flight round is overwriting (R302).
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+x = np.ones(4)
+out = np.zeros(4)
+req = MPI.Allreduce_init(x, out, MPI.SUM, comm)
+
+MPI.Start(req)
+MPI.Wait(req)
+res0 = req.result                 # round-0 result: lives in a donated slot
+
+MPI.Start(req)
+MPI.Wait(req)
+
+MPI.Start(req)                    # round 2 re-donates round 0's slot
+y = np.zeros(4)
+MPI.Allreduce(res0, y, MPI.SUM, comm)     # trace: R302
+MPI.Wait(req)
+MPI.Barrier(comm)

@@ -1,0 +1,19 @@
+# nprocs: 2
+# raises: MPIError
+#
+# Defect class: persistent-request misuse — Start on a plan that is
+# already active. MPI-4 requires a completing Wait between rounds; the
+# runtime raises ERR_REQUEST at the second Start and the static pass
+# flags the restart site without running anything (L109).
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+x = np.ones(4)
+out = np.zeros(4)
+req = MPI.Allreduce_init(x, out, MPI.SUM, comm)
+MPI.Start(req)
+MPI.Start(req)                    # lint: L109
+MPI.Wait(req)
+MPI.Barrier(comm)

@@ -1,0 +1,19 @@
+# nprocs: 2
+#
+# Defect class: collective on the parent of a Comm_shrink. Once the
+# group has shrunk away failed members, the parent's membership is
+# stale — a collective over it hangs the moment a dead rank is in the
+# group. This run has no failures so it completes, but the static pass
+# flags the reuse (L110): post-recovery traffic belongs on the shrunk
+# communicator.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+work = MPI.Comm_dup(comm)
+sub = MPI.Comm_shrink(work)
+x = np.ones(4)
+y = np.zeros(4)
+MPI.Allreduce(x, y, MPI.SUM, work)        # lint: L110
+MPI.Barrier(sub)

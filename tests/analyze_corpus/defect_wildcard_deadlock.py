@@ -1,0 +1,30 @@
+# nprocs: 4
+#
+# Defect class: schedule-sensitive wildcard deadlock. In the recorded
+# run rank 2's tag-7 message reaches rank 0's ANY_SOURCE receive first
+# and everything completes — but nothing orders it against rank 1's
+# tag-7 message (rank 1 only needs the tag-9 "go" from rank 2 before
+# sending). The explorer's alternate matching gives the wildcard rank
+# 1's message, leaving the exact-source Recv(src=1) with no sender:
+# that schedule deadlocks (T210). Lint and the trace verifier stay
+# silent — the observed interleaving really was clean.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+
+if rank == 2:
+    MPI.Send(np.full(4, 2.0), 0, 7, comm)
+    MPI.Send(np.ones(1), 1, 9, comm)          # the "go" signal
+elif rank == 1:
+    go = np.zeros(1)
+    MPI.Recv(go, 2, 9, comm)
+    MPI.Send(np.full(4, 1.0), 0, 7, comm)
+elif rank == 0:
+    first = np.zeros(4)
+    second = np.zeros(4)
+    MPI.Recv(first, MPI.ANY_SOURCE, 7, comm)
+    MPI.Recv(second, 1, 7, comm)              # explore: T210
+MPI.Barrier(comm)

@@ -1,0 +1,245 @@
+"""Schedule-space explorer (tpu_mpi.analyze.explore): run corpus files
+on simulated ranks with tracing on, then enumerate the alternate
+schedules of the recorded run. Every ``# explore: Txxx`` marker must be
+reported at its marked file:line (anchor or related); the clean fixtures
+must explore with zero findings — and the wildcard ones with MORE than
+one schedule, or the explorer is not actually branching. Also covers
+the dump/load/CLI round trip and the two standing CI gates: the FT
+shrink recovery body and a two-tenant serve pool must both be
+schedule-deadlock-free."""
+
+import glob
+import os
+import re
+import runpy
+
+import pytest
+
+from tpu_mpi import analyze, config, serve
+from tpu_mpi.analyze import events as aevents
+from tpu_mpi.analyze import explore as aexplore
+from tpu_mpi.testing import run_spmd
+
+CORPUS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "analyze_corpus")
+DEFECTS = sorted(glob.glob(os.path.join(CORPUS, "defect_*.py")))
+CLEAN = sorted(glob.glob(os.path.join(CORPUS, "clean_*.py")))
+
+
+@pytest.fixture
+def traced(monkeypatch):
+    monkeypatch.setenv("TPU_MPI_TRACE", "1")
+    monkeypatch.setenv("TPU_MPI_DEADLOCK_TIMEOUT", "2.0")
+    config.load(refresh=True)
+    yield
+    config.load(refresh=True)
+
+
+def corpus_header(path):
+    nprocs = 2
+    with open(path) as f:
+        for line in f:
+            m = re.match(r"#\s*nprocs:\s*(\d+)", line)
+            if m:
+                nprocs = int(m.group(1))
+    return nprocs
+
+
+def explore_marks(path):
+    out = []
+    with open(path) as f:
+        for lineno, text in enumerate(f, 1):
+            for m in re.finditer(r"explore:\s*([A-Z]\d+)", text):
+                out.append((m.group(1), lineno))
+    return out
+
+
+EXPLORE_DEFECTS = [p for p in DEFECTS if explore_marks(p)]
+
+
+def run_and_explore(path, **kw):
+    run_spmd(lambda: runpy.run_path(path, run_name="__main__"),
+             nprocs=corpus_header(path))
+    return aexplore.explore(analyze.last_trace(), **kw)
+
+
+def _hits(diags, path, code, line):
+    for d in diags:
+        if d.code != code:
+            continue
+        if d.file and os.path.abspath(d.file) == path and d.line == line:
+            return True
+        if any(f and os.path.abspath(f) == path and ln == line
+               for f, ln, _ in d.related):
+            return True
+    return False
+
+
+@pytest.mark.parametrize("path", EXPLORE_DEFECTS, ids=os.path.basename)
+def test_explore_markers(traced, path):
+    res = run_and_explore(path)
+    missing = [(c, ln) for c, ln in explore_marks(path)
+               if not _hits(res.diagnostics, path, c, ln)]
+    assert not missing, (f"expected {missing} in\n"
+                         + "\n".join(str(d) for d in res.diagnostics))
+
+
+def test_wildcard_deadlock_alternate_matching(traced):
+    """The acceptance reproducer: the observed 4-rank run is clean, but
+    giving the ANY_SOURCE receive the OTHER sender's message starves the
+    exact-source receive — the explorer must find that schedule and
+    report it as a per-rank event listing."""
+    path = os.path.join(CORPUS, "defect_wildcard_deadlock.py")
+    res = run_and_explore(path)
+    assert res.schedules >= 2          # observed + the alternate matching
+    assert res.deadlocks >= 1
+    assert not res.truncated
+    (d,) = [d for d in res.diagnostics if d.code == "T210"]
+    # the schedule is rendered rank by rank, with the wildcard's choice
+    # and each blocked operation named at its source line
+    for rank in range(4):
+        assert f"rank {rank}:" in d.message
+    assert "matched rank 1" in d.message
+    assert "BLOCKED at" in d.message
+    assert "defect_wildcard_deadlock.py" in d.message
+    # the observed run itself verifies clean — only exploration sees it
+    assert analyze.verify_trace(analyze.last_trace()) == []
+
+
+@pytest.mark.parametrize("path", CLEAN, ids=os.path.basename)
+def test_clean_fixture_explores_clean(traced, path):
+    res = run_and_explore(path)
+    assert res.diagnostics == [], "\n".join(str(d) for d in res.diagnostics)
+    assert res.schedules >= 1 and not res.truncated
+
+
+def test_clean_wildcard_explores_multiple_schedules(traced):
+    """Schedule-insensitive wildcards still have >1 schedule — zero
+    findings must come from exploring them, not from failing to branch."""
+    path = os.path.join(CORPUS, "clean_wildcard.py")
+    res = run_and_explore(path)
+    assert res.schedules > 1
+    assert res.diagnostics == []
+
+
+def test_budget_truncation_is_loud(traced):
+    path = os.path.join(CORPUS, "clean_wildcard.py")
+    res = run_and_explore(path, max_schedules=1)
+    assert res.truncated
+
+
+def _mk(nprocs, recs):
+    tr = aevents.Tracer(nprocs, 64)
+    for kind, rank, kw in recs:
+        tr.record(aevents.Event(kind, rank, **kw))
+    return tr
+
+
+def test_orphaned_message_t211():
+    # two senders race for ONE wildcard receive: whichever loses leaves
+    # its message in flight at termination, on both explored schedules
+    tr = _mk(3, [
+        ("send", 1, dict(op="Send", cid=1, peer=0, tag=4, count=4,
+                         dtype="float64")),
+        ("send", 2, dict(op="Send", cid=1, peer=0, tag=4, count=4,
+                         dtype="float64")),
+        ("recv", 0, dict(op="Recv", cid=1, want=None, wtag=4)),
+    ])
+    res = aexplore.explore(tr)
+    assert res.schedules == 2
+    codes = sorted(d.code for d in res.diagnostics)
+    assert codes == ["T211", "T211"]    # one per orphaned sender
+
+
+def test_value_divergence_t212():
+    # same race, but the competing payloads differ in count: the value
+    # the wildcard receive observes now depends on the schedule
+    tr = _mk(3, [
+        ("send", 1, dict(op="Send", cid=1, peer=0, tag=4, count=4,
+                         dtype="float64")),
+        ("send", 2, dict(op="Send", cid=1, peer=0, tag=4, count=8,
+                         dtype="float64")),
+        ("recv", 0, dict(op="Recv", cid=1, want=None, wtag=4)),
+        ("recv", 0, dict(op="Recv", cid=1, want=None, wtag=4)),
+    ])
+    res = aexplore.explore(tr)
+    t212 = [d for d in res.diagnostics if d.code == "T212"]
+    assert t212 and all("schedule-dependent" in d.message for d in t212)
+
+
+def test_dump_load_cli_round_trip(traced, tmp_path, monkeypatch, capsys):
+    prefix = str(tmp_path / "run")
+    monkeypatch.setenv("TPU_MPI_TRACE_DUMP", prefix)
+    config.load(refresh=True)
+    path = os.path.join(CORPUS, "clean_wildcard.py")
+    run_spmd(lambda: runpy.run_path(path, run_name="__main__"), nprocs=3)
+    files = sorted(glob.glob(f"{prefix}.rank*.trace.json"))
+    assert len(files) == 3              # Finalize dumped every rank
+    live = aexplore.explore(analyze.last_trace())
+    loaded = aexplore.explore(aevents.load_trace(prefix))
+    assert loaded.ranks == [0, 1, 2]
+    assert (loaded.schedules, loaded.transitions) == \
+        (live.schedules, live.transitions)
+    assert loaded.diagnostics == []
+
+    from tpu_mpi.analyze.__main__ import main as cli
+    assert cli(["explore", prefix]) == 0
+    out = capsys.readouterr().out
+    assert "explored" in out and "no schedule-dependent defects" in out
+    assert cli(["verify", prefix]) == 0
+
+    # a deadlock-capable trace exits 1 and prints the finding
+    prefix2 = str(tmp_path / "bad")
+    monkeypatch.setenv("TPU_MPI_TRACE_DUMP", prefix2)
+    config.load(refresh=True)
+    bad = os.path.join(CORPUS, "defect_wildcard_deadlock.py")
+    run_spmd(lambda: runpy.run_path(bad, run_name="__main__"), nprocs=4)
+    assert cli(["explore", prefix2]) == 1
+    assert "T210" in capsys.readouterr().out
+
+
+def test_ft_shrink_gate(traced, tmp_path):
+    """CI gate: the shrink-and-rebind recovery body must be free of
+    schedule-dependent defects, with the agree/shrink rendezvous modeled
+    (not skipped) — including after a JSON dump/load round trip, which
+    turns the recorded survivor tuples into lists."""
+    path = os.path.join(CORPUS, "clean_ft_shrink.py")
+    run_spmd(lambda: runpy.run_path(path, run_name="__main__"), nprocs=2)
+    tr = analyze.last_trace()
+    assert any(ev.kind == "ft" for ev in tr.events())
+    res = aexplore.explore(tr)
+    assert res.schedules >= 1 and res.deadlocks == 0
+    assert res.diagnostics == []
+    assert analyze.verify_trace(tr) == []
+    dump = str(tmp_path / "ft.trace.json")
+    aevents.dump_trace(tr, dump)
+    loaded = aevents.load_trace(dump)
+    assert any(ev.kind == "ft" for ev in loaded.events())
+    assert analyze.verify_trace(loaded) == []
+    assert aexplore.explore(loaded).diagnostics == []
+
+
+def test_two_tenant_serve_gate(traced):
+    """CI gate: two tenants sharing the warm pool — the dispatcher's
+    interleaving of their rounds must be schedule-deadlock-free and the
+    per-tenant books must partition pool totals (T208 stays quiet)."""
+    b = serve.Broker(nranks=4, token="tok")
+    b.run_in_thread()
+    try:
+        s1 = serve.attach(b.address, tenant="a", token="tok")
+        s2 = serve.attach(b.address, tenant="b", token="tok")
+        for _ in range(3):
+            s1.allreduce([1.0])
+            s2.allreduce([2.0])
+        s1.pcontrol(2)                  # force a measured ledger flush
+        s2.pcontrol(2)
+        s1.detach()
+        s2.detach()
+    finally:
+        b.close()
+    tr = analyze.last_trace()
+    assert any(ev.kind == "serve" for ev in tr.events())
+    res = aexplore.explore(tr)
+    assert res.schedules >= 1 and res.deadlocks == 0
+    assert not [d for d in res.diagnostics if d.code == "T210"]
+    assert analyze.verify_trace(tr) == []

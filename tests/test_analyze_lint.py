@@ -70,6 +70,13 @@ def test_parallel_package_is_clean():
     assert diags == [], "\n".join(map(str, diags))
 
 
+def test_whole_tree_is_clean():
+    # the zero-false-positive contract: every rule added to the linter
+    # must hold over the entire shipped package, not just the examples
+    diags = alint.lint_paths([os.path.join(REPO, "tpu_mpi")])
+    assert diags == [], "\n".join(map(str, diags))
+
+
 def test_syntax_error_reports_l100(tmp_path):
     bad = tmp_path / "broken.py"
     bad.write_text("def oops(:\n")

@@ -15,7 +15,12 @@ Three cooperating passes over one shared event IR:
   DeadlockError dump of per-rank pending operations + the wait-for cycle;
 - **RMA race detector** (:mod:`.races`): vector-clock happens-before over
   window epochs (Win_fence / Win_lock), flagging concurrent overlapping
-  Put/Put and Put/Get ranges inside one exposure epoch.
+  Put/Put and Put/Get ranges inside one exposure epoch;
+- **schedule explorer** (:mod:`.explore`, CLI ``python -m tpu_mpi.analyze
+  explore <trace>``): DPOR-style enumeration of the alternate schedules a
+  recorded run could have taken — wildcard matchings, persistent
+  Start/Wait reorderings, dispatcher interleavings — checking each for
+  deadlock (T210), orphaned messages (T211) and value divergence (T212).
 
 This package stays import-light (stdlib + numpy): the lint CLI must start
 without touching jax, and the runtime hooks only pay for what they call.
@@ -27,7 +32,8 @@ from .diagnostics import CODES, Diagnostic
 
 __all__ = ["CODES", "Diagnostic", "lint_paths", "lint_source", "verify_trace",
            "detect_races", "deadlock_report", "last_trace", "timeline",
-           "merge_trace", "write_chrome", "clock_offsets"]
+           "merge_trace", "write_chrome", "clock_offsets", "explore",
+           "ExploreResult", "load_trace", "dump_trace"]
 
 
 def __getattr__(name):
@@ -45,6 +51,16 @@ def __getattr__(name):
     if name == "last_trace":
         from .events import last_trace
         return last_trace
+    if name in ("load_trace", "dump_trace"):
+        from . import events as _events
+        return getattr(_events, name)
+    if name in ("explore", "ExploreResult"):
+        # "explore" resolves to the MODULE (like .timeline): the import
+        # machinery pins the submodule as the package attribute anyway, so
+        # returning the function here would only hold until first import.
+        import importlib
+        _explore = importlib.import_module(".explore", __name__)
+        return _explore if name == "explore" else getattr(_explore, name)
     if name in ("timeline", "merge_trace", "write_chrome", "clock_offsets"):
         # importlib, not `from . import timeline`: the fromlist machinery
         # resolves missing attributes through THIS __getattr__ and recurses

@@ -28,12 +28,33 @@ CODES = {
     "L106": "Isend buffer mutated before its Wait",
     "L107": "blocking send/recv cycle pattern (deadlock)",
     "L108": "overlapping RMA accesses in one exposure epoch",
+    "L109": "persistent-request misuse (double Start / buffer mutation "
+            "between Start and Wait / Start after free)",
+    "L110": "operation on a revoked or shrunk communicator",
+    "L111": "serve-session misuse (cross-tenant comm / op after detach)",
     "T201": "ranks called different collectives in the same round",
     "T202": "collective signature (root/dtype/count) disagrees across ranks",
     "T203": "sent message was never received",
     "T206": "Isend buffer was modified before its Wait completed",
+    "T207": "agree/shrink protocol divergence across ranks",
+    "T208": "per-tenant measured books fail to partition the pool totals",
+    "T210": "alternate schedule deadlocks (found by analyze.explore)",
+    "T211": "alternate schedule orphans a sent message",
+    "T212": "wildcard receive observes schedule-dependent values",
     "R301": "concurrent overlapping RMA accesses (vector-clock race)",
+    "R302": "donated persistent-fold result used after a later Start "
+            "invalidated it",
 }
+
+# Codes deliberately absent from CODES. T204/T205 were allotted to
+# receive-side pairing checks in the PR-2 design; both folded into T203's
+# send/recv accounting (one keyed table covers "never received" and
+# "received with nobody sending"), and the numbers stay reserved so old
+# suppression lists keep meaning the same thing. T209 is reserved for the
+# serve dispatcher's cross-cid initiation-order invariant, which the
+# explorer currently reports through T210 (a divergent initiation order IS
+# an alternate-schedule deadlock).
+RESERVED_CODES = ("T204", "T205", "T209")
 
 
 @dataclass

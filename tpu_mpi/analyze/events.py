@@ -99,10 +99,19 @@ class Event:
                  # bracket of the whole op plus the phase spans the channels
                  # observed inside it, as (name, t0, t1) monotonic tuples —
                  # analyze.timeline renders these as nested Perfetto slices.
-                 "t_start", "t_end", "phases")
+                 "t_start", "t_end", "phases",
+                 # schedule-exploration fields: the POSTED source/tag of a
+                 # receive (None = wildcard; `peer`/`tag` hold the delivered
+                 # values), the persistent-request handle + round a
+                 # start/wait/coll event belongs to, the identity of the
+                 # user buffer an op read (R302), and a grab-bag dict for
+                 # ft/serve records (epoch, survivors, books, ...).
+                 "want", "wtag", "handle", "round", "bufid", "extra")
 
     def __init__(self, kind: str, rank: int, **kw: Any):
-        self.kind = kind          # "coll" | "send" | "recv" | "rma" | "sync"
+        # "coll" | "send" | "recv" | "rma" | "sync" | "start" | "wait"
+        # | "ft" | "serve"
+        self.kind = kind
         self.rank = rank          # world rank of the recording rank
         for name in self.__slots__[2:]:
             setattr(self, name, kw.get(name))
@@ -120,6 +129,10 @@ class Event:
         if self.kind == "rma":
             return (f"{self.op}(target=world rank {self.peer}, "
                     f"range=[{self.lo}, {self.hi}))")
+        if self.kind in ("start", "wait"):
+            return f"{self.op} [{self.kind} round {self.round}] on comm {self.cid}"
+        if self.kind == "ft":
+            return f"{self.op} on comm {self.cid} ({self.extra})"
         return f"{self.op}"
 
     def __repr__(self) -> str:
@@ -200,6 +213,29 @@ def _env() -> Optional[tuple]:
 # Recording hooks (called from comm/collective/pointtopoint/onesided)
 # ---------------------------------------------------------------------------
 
+# persistent-request round tagging: while a traced persistent round runs its
+# legacy collective lane, the inner record_collective event is stamped with
+# the owning handle so analyze.explore models the round's timing from the
+# start/wait pair instead of double-counting the inner event.
+_tls = threading.local()
+
+
+class persistent_scope:
+    """Context manager marking collective events recorded inside it as
+    belonging to one persistent handle's round."""
+
+    def __init__(self, handle: int, rnd: int):
+        self._tag = (handle, rnd)
+
+    def __enter__(self):
+        _tls.phandle = self._tag
+        return self
+
+    def __exit__(self, *exc):
+        _tls.phandle = None
+        return False
+
+
 def record_collective(comm: Any, opname: str,
                       sig: Optional[dict] = None) -> Optional[Event]:
     """One collective entry on this rank; ``sig`` carries the cross-rank-
@@ -212,15 +248,19 @@ def record_collective(comm: Any, opname: str,
     tr = tracer_for(ctx, create=True)
     sig = sig or {}
     f, ln = call_site()
+    ptag = getattr(_tls, "phandle", None)
     ev = Event("coll", wrank, op=str(opname), cid=comm.cid,
                grp=tuple(comm.group), root=sig.get("root"),
                dtype=sig.get("dtype"), count=sig.get("count"),
-               algo=sig.get("algo"), file=f, line=ln)
+               algo=sig.get("algo"), bufid=sig.get("bufid"),
+               handle=ptag[0] if ptag else None,
+               round=ptag[1] if ptag else None,
+               file=f, line=ln)
     return tr.record(ev)
 
 
 def record_send(comm: Any, dest: int, tag: Any, count: Any, dtype: Any,
-                op: str = "Send") -> Optional[Event]:
+                op: str = "Send", buf: Any = None) -> Optional[Event]:
     env = _env()
     if env is None:
         return None
@@ -234,13 +274,22 @@ def record_send(comm: Any, dest: int, tag: Any, count: Any, dtype: Any,
     ev = Event("send", wrank, op=op, cid=comm.cid, peer=peer,
                tag=tag if isinstance(tag, tuple) else int(tag),
                count=count, dtype=str(dtype) if dtype is not None else None,
-               file=f, line=ln)
+               bufid=buf_id(buf), file=f, line=ln)
     return tr.record(ev)
 
 
-def record_recv(comm: Any, msg: Any, op: str = "Recv") -> Optional[Event]:
+_POSTED_UNKNOWN = object()
+
+
+def record_recv(comm: Any, msg: Any, op: str = "Recv",
+                want: Any = _POSTED_UNKNOWN,
+                wtag: Any = _POSTED_UNKNOWN) -> Optional[Event]:
     """One completed receive; ``msg`` is the delivered runtime Message
-    (``msg.src`` is the sender's comm rank)."""
+    (``msg.src`` is the sender's comm rank). ``want``/``wtag`` are the
+    POSTED source/tag — ``None`` meaning ANY_SOURCE/ANY_TAG — which the
+    schedule explorer re-enumerates; callers that don't know them (old
+    call sites) leave the defaults and the posted values degrade to the
+    delivered ones."""
     env = _env()
     if env is None:
         return None
@@ -249,10 +298,21 @@ def record_recv(comm: Any, msg: Any, op: str = "Recv") -> Optional[Event]:
         peer = comm.world_rank_of(int(msg.src))
     except Exception:
         peer = None
+    if want is _POSTED_UNKNOWN:
+        posted_src = peer
+    elif want is None:
+        posted_src = None
+    else:
+        try:
+            posted_src = comm.world_rank_of(int(want))
+        except Exception:
+            posted_src = peer
+    posted_tag = msg.tag if wtag is _POSTED_UNKNOWN else wtag
     tr = tracer_for(ctx, create=True)
     f, ln = call_site()
     ev = Event("recv", wrank, op=op, cid=comm.cid, peer=peer, tag=msg.tag,
-               count=msg.count, file=f, line=ln)
+               count=msg.count, want=posted_src, wtag=posted_tag,
+               file=f, line=ln)
     return tr.record(ev)
 
 
@@ -461,3 +521,205 @@ def record_sync(win: Any, op: str) -> None:
     tr = tracer_for(ctx, create=True)
     f, ln = call_site()
     tr.record(Event("sync", wrank, op=op, win=_win_key(win), file=f, line=ln))
+
+
+# ---------------------------------------------------------------------------
+# Persistent-request records (Start/Wait reordering + R302 front end)
+# ---------------------------------------------------------------------------
+
+def buf_id(buf: Any) -> Optional[int]:
+    """Stable identity of the array object backing ``buf`` (R302 keys the
+    donated-result invalidation window on it)."""
+    if buf is None:
+        return None
+    try:
+        from ..buffers import extract_array
+        return id(extract_array(buf))
+    except Exception:
+        return id(buf)
+
+
+def record_start(comm: Any, op: str, handle: int, rnd: int,
+                 invalidates: Optional[int] = None) -> Optional[Event]:
+    """A persistent request's Start on this rank. ``invalidates`` names the
+    buffer id whose donated-fast-path slot this Start re-donates (the round
+    ``rnd - 2`` result) — R302's invalidation edge."""
+    env = _env()
+    if env is None:
+        return None
+    ctx, wrank = env
+    tr = tracer_for(ctx, create=True)
+    f, ln = call_site()
+    ev = Event("start", wrank, op=op, cid=comm.cid, grp=tuple(comm.group),
+               handle=handle, round=rnd, bufid=invalidates, file=f, line=ln)
+    return tr.record(ev)
+
+
+def record_wait(comm: Any, op: str, handle: int, rnd: int,
+                result: Any = None) -> Optional[Event]:
+    """A persistent request's Wait completing round ``rnd``; ``result`` is
+    the object handed back to the user (identity tracked for R302)."""
+    env = _env()
+    if env is None:
+        return None
+    ctx, wrank = env
+    tr = tracer_for(ctx, create=True)
+    f, ln = call_site()
+    ev = Event("wait", wrank, op=op, cid=comm.cid, grp=tuple(comm.group),
+               handle=handle, round=rnd, bufid=buf_id(result),
+               file=f, line=ln)
+    return tr.record(ev)
+
+
+# ---------------------------------------------------------------------------
+# Fault-tolerance protocol records (T207 front end)
+# ---------------------------------------------------------------------------
+
+def record_ft(comm: Any, op: str, epoch: Optional[int] = None,
+              survivors: Any = None, dead: Any = None,
+              value: Any = None) -> Optional[Event]:
+    """One ULFM protocol step (revoke/agree/shrink) with the cross-rank-
+    comparable outcome: the agreement epoch, the agreed flag value, and —
+    for shrink — the survivor set every rank must derive identically."""
+    env = _env()
+    if env is None:
+        return None
+    ctx, wrank = env
+    tr = tracer_for(ctx, create=True)
+    f, ln = call_site()
+    extra = {"epoch": epoch}
+    if survivors is not None:
+        extra["survivors"] = tuple(sorted(survivors))
+    if dead is not None:
+        extra["dead"] = tuple(sorted(dead))
+    if value is not None:
+        extra["value"] = value
+    ev = Event("ft", wrank, op=op, cid=comm.cid, grp=tuple(comm.group),
+               extra=extra, file=f, line=ln)
+    return tr.record(ev)
+
+
+# ---------------------------------------------------------------------------
+# Serve-tier records (T208 front end + dispatcher-interleaving context).
+# The broker's handler/dispatcher threads run without a rank env, so these
+# take the pool ctx explicitly and record under the synthetic rank -1.
+# ---------------------------------------------------------------------------
+
+BROKER_RANK = -1
+
+
+def record_serve(ctx: Any, op: str, **extra: Any) -> Optional[Event]:
+    """One broker-side event (lease grant/revoke, op dispatch, ledger
+    flush) in the pool context's trace, under the synthetic BROKER_RANK."""
+    if not enabled() or ctx is None:
+        return None
+    tr = tracer_for(ctx, create=True)
+    f, ln = call_site()
+    ev = Event("serve", BROKER_RANK, op=op, cid=extra.pop("cid", None),
+               extra=extra or None, file=f, line=ln)
+    return tr.record(ev)
+
+
+# ---------------------------------------------------------------------------
+# Trace persistence: one JSON file per rank (the multi-process tier has one
+# Tracer per process), merged back by load_trace for offline exploration.
+# ---------------------------------------------------------------------------
+
+_DUMP_FIELDS = ("kind", "rank", "op", "cid", "seq", "peer", "root", "tag",
+                "count", "dtype", "grp", "algo", "file", "line", "t",
+                "want", "wtag", "handle", "round", "bufid", "extra")
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, tuple):
+        return list(v)
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return repr(v)
+
+
+def dump_trace(tr: "Tracer", path: str, rank: Optional[int] = None) -> str:
+    """Write ``tr``'s events (one rank, or every ring this process holds)
+    as JSON to ``path``. Returns the path written."""
+    import json
+    with tr.lock:
+        ranks = [rank] if rank is not None else sorted(tr.rings)
+        recs = []
+        for r in ranks:
+            for ev in tr.rings.get(r, ()):
+                recs.append({k: _jsonable(getattr(ev, k, None))
+                             for k in _DUMP_FIELDS})
+        payload = {
+            "version": 1,
+            "nprocs": tr.nprocs,
+            "dropped": {str(k): v for k, v in tr.dropped.items()},
+            "events": recs,
+        }
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+def finalize_dump() -> None:
+    """Called from MPI.Finalize: when ``trace_dump`` names a path prefix,
+    write this rank's trace to ``<prefix>.rank<N>.trace.json``."""
+    if not enabled():
+        return
+    cfg = config.load()
+    prefix = getattr(cfg, "trace_dump", "")
+    if not prefix:
+        return
+    env = _env()
+    if env is None:
+        return
+    ctx, wrank = env
+    tr = tracer_for(ctx)
+    if tr is None:
+        return
+    dump_trace(tr, f"{prefix}.rank{wrank}.trace.json", rank=wrank)
+
+
+def load_trace(paths: Any) -> Tracer:
+    """Merge one or more trace-dump JSON files (or a prefix produced by
+    ``finalize_dump``) back into an offline :class:`Tracer`."""
+    import glob
+    import json
+    if isinstance(paths, str):
+        paths = [paths]
+    files: list = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(p)
+        else:
+            files.extend(sorted(glob.glob(f"{p}.rank*.trace.json")))
+    if not files:
+        raise FileNotFoundError(f"no trace dumps found for {paths!r}")
+    events = []
+    nprocs = 0
+    dropped: Dict[int, int] = {}
+    for fn in files:
+        with open(fn) as f:
+            payload = json.load(f)
+        nprocs = max(nprocs, int(payload.get("nprocs", 0)))
+        for r, n in payload.get("dropped", {}).items():
+            dropped[int(r)] = dropped.get(int(r), 0) + int(n)
+        events.extend(payload.get("events", ()))
+    tr = Tracer(nprocs, max(len(events), 16))
+    tr.dropped = dropped
+    for rec in sorted(events, key=lambda e: (e.get("t") or 0.0)):
+        kw = {k: rec.get(k) for k in _DUMP_FIELDS if k not in ("kind", "rank")}
+        if isinstance(kw.get("grp"), list):
+            kw["grp"] = tuple(kw["grp"])
+        if isinstance(kw.get("tag"), list):
+            kw["tag"] = tuple(kw["tag"])
+        seq = kw.pop("seq", None)
+        ev = Event(rec["kind"], int(rec["rank"]), **kw)
+        tr.record(ev)
+        if seq is not None:
+            ev.seq = seq     # preserve the recorder's absolute ordinal
+    return tr

@@ -11,7 +11,14 @@ cheap to prove from source alone — before any rank runs:
 - **L105** a send whose (literal) tag no receive in the unit matches;
 - **L106** an Isend buffer mutated before its Wait;
 - **L107** blocking send/recv cycle patterns (every rank receives first);
-- **L108** overlapping RMA accesses to one target inside one fence epoch.
+- **L108** overlapping RMA accesses to one target inside one fence epoch;
+- **L109** persistent-request misuse: ``Start`` called twice without an
+  intervening ``Wait``, the plan's buffer mutated between ``Start`` and
+  ``Wait``, or ``Start`` on a freed plan / freed communicator;
+- **L110** an operation on a communicator after ``Comm_revoke`` (with no
+  intervening ``Comm_agree``) or on the parent after ``Comm_shrink``;
+- **L111** serve-session misuse: an RPC on a detached session, or a
+  ``SessionComm`` passed to a *different* session's operation.
 
 The linter is deliberately conservative: it only trusts what it can resolve
 (literal tags/counts/roots, ``np.zeros``-style buffer shapes, rank variables
@@ -43,16 +50,25 @@ COLLECTIVES = {
     "Win_allocate_shared", "Win_fence", "Ibarrier", "Ibcast", "Iallreduce",
     "Ireduce", "Igather", "Iallgather", "Iscatter", "Ialltoall", "Iscan",
     "Iexscan",
+    # post-PR-2 surface: ULFM recovery steps and MPI-4 persistent inits are
+    # collective too — L101's arm-sequence comparison must not skip them.
+    # (Comm_revoke is non-collective per ULFM, but a revoke reached on only
+    # SOME arms of a rank-If still leaves the others publishing to a comm
+    # the group is abandoning — flag the divergence; symmetric revoke or
+    # module-level recovery code stays silent.)
+    "Comm_shrink", "Comm_agree", "Comm_revoke",
+    "Allreduce_init", "Bcast_init", "Barrier_init",
 }
 # root rank = keyword "root", else the second-to-last positional argument
 # (every rooted signature here ends (..., root, comm)).
 ROOTED = {"Bcast", "bcast", "Ibcast", "Reduce", "Ireduce", "Gather",
-          "Igather", "Gatherv", "Scatter", "Iscatter", "Scatterv"}
+          "Igather", "Gatherv", "Scatter", "Iscatter", "Scatterv",
+          "Bcast_init"}
 # reduction-op position from the end of the positional argument list
 REDUCE_OP_POS = {"Reduce": -3, "Ireduce": -3, "Allreduce": -2,
                  "Iallreduce": -2, "Scan": -2, "Iscan": -2, "Exscan": -2,
                  "Iexscan": -2, "Reduce_scatter": -2,
-                 "Reduce_scatter_block": -2}
+                 "Reduce_scatter_block": -2, "Allreduce_init": -2}
 
 # send name -> tag argument position (buffer/object is argument 0)
 SEND_TAG_POS = {"Send": 2, "Isend": 2, "send": 2, "isend": 2, "Send_init": 2,
@@ -68,6 +84,19 @@ RMA_ACCESS = {"Put", "Get", "Accumulate"}
 
 WAIT_NAMES = {"Wait", "Waitall", "Waitany", "Waitsome", "Test", "Testall",
               "Testany", "Testsome"}
+
+# MPI-4 persistent plans whose Start/Wait lifecycle L109 tracks
+PERSISTENT_INITS = {"Allreduce_init", "Bcast_init", "Barrier_init",
+                    "Send_init", "Recv_init", "Psend_init", "Precv_init"}
+# the ULFM recovery verbs — the only calls L110 permits on a marked comm
+FT_VERBS = {"Comm_revoke", "Comm_shrink", "Comm_agree", "free", "Comm_free"}
+# communication ops whose comm argument L110 inspects (queries like
+# Comm_rank stay legal on a revoked comm, so they are not in here)
+COMM_OPS = (COLLECTIVES | set(SEND_TAG_POS) | set(RECV_TAG_POS)
+            | {"Sendrecv", "Probe", "Iprobe"}) - FT_VERBS
+# the serve-tier ClientSession RPC surface (L111)
+SESSION_OPS = {"allreduce", "bcast", "barrier", "comm_dup", "comm_free",
+               "pcontrol", "stats", "ping"}
 
 _RANK_SEEDS = {"rank", "my_rank", "myrank"}
 _BUF_MAKERS = {"zeros", "ones", "empty", "full", "arange", "array"}
@@ -119,6 +148,15 @@ class _Unit:
         # has_else, test-source)
         self.rank_ifs: List[tuple] = []
         self._armed: Dict[str, tuple] = {}      # req var -> (buf var, line)
+        # L109: plan var -> {kind, buf, comm, started, freed, init_line}
+        self._pers: Dict[str, dict] = {}
+        self._freed: set = set()                # comm vars already freed
+        # L110: comm var -> ("revoked" | "shrunk", line)
+        self._ft: Dict[str, tuple] = {}
+        # L111: session var -> detach line (None while live);
+        # SessionComm var -> owning session var
+        self._sessions: Dict[str, Optional[int]] = {}
+        self._sess_comms: Dict[str, str] = {}
         self._epoch = 0
         self._lock_depth = 0
         self._scan(stmts, arm=(), cond=False)
@@ -194,7 +232,10 @@ class _Unit:
             self.ops.append(_Op(name, call, arm, cond, self._epoch,
                                 self._lock_depth > 0))
             self._isend_effects(st, call, name)
+            self._persistent_effects(st, call, name)
+            self._ft_effects(st, call, name)
         self._mutation_effects(st)
+        self._assign_clears(st)
 
     # -- L106 bookkeeping (runs inline with the ordered scan) ---------------
 
@@ -213,14 +254,177 @@ class _Unit:
                     if isinstance(el, ast.Name):
                         self._armed.pop(el.id, None)
 
+    # -- L109 bookkeeping: persistent plan lifecycle ------------------------
+
+    @staticmethod
+    def _assign_target(st) -> Optional[str]:
+        if (isinstance(st, ast.Assign) and len(st.targets) == 1
+                and isinstance(st.targets[0], ast.Name)):
+            return st.targets[0].id
+        return None
+
+    def _persistent_effects(self, st, call, name):
+        if name in PERSISTENT_INITS:
+            target = self._assign_target(st)
+            if target is None:
+                return
+            buf = None
+            if name != "Barrier_init" and call.args \
+                    and isinstance(call.args[0], ast.Name):
+                buf = call.args[0].id
+            comm = self.L._arg(call, len(call.args) - 1, kw="comm")
+            self._pers[target] = {
+                "kind": name, "buf": buf,
+                "comm": comm.id if isinstance(comm, ast.Name) else None,
+                "started": None, "freed": None, "init_line": call.lineno,
+            }
+        elif name in ("Start", "Startall"):
+            reqs: List[str] = []
+            if call.args and isinstance(call.args[0], ast.Name):
+                reqs = [call.args[0].id]
+            elif call.args and isinstance(call.args[0], (ast.List, ast.Tuple)):
+                reqs = [el.id for el in call.args[0].elts
+                        if isinstance(el, ast.Name)]
+            for r in reqs:
+                self._start_plan(r, call.lineno)
+        elif name in WAIT_NAMES and call.args:
+            a0 = call.args[0]
+            names = [a0] if isinstance(a0, ast.Name) else (
+                list(a0.elts) if isinstance(a0, (ast.List, ast.Tuple)) else [])
+            for el in names:
+                if isinstance(el, ast.Name) and el.id in self._pers:
+                    self._pers[el.id]["started"] = None
+        elif name in ("free", "Comm_free", "Request_free") \
+                and call.args and isinstance(call.args[0], ast.Name):
+            a = call.args[0].id
+            if a in self._pers:
+                self._pers[a]["freed"] = call.lineno
+            else:
+                self._freed.add(a)
+
+    def _start_plan(self, req: str, line: int):
+        p = self._pers.get(req)
+        if p is None:
+            return
+        if p["freed"] is not None:
+            self.L.diag("L109",
+                        f"Start on persistent plan {req!r} after it was freed "
+                        f"at line {p['freed']}",
+                        line, context=f"{p['kind']} at line {p['init_line']}")
+        elif p["comm"] is not None and p["comm"] in self._freed:
+            self.L.diag("L109",
+                        f"Start on persistent plan {req!r} whose communicator "
+                        f"{p['comm']!r} was already freed",
+                        line, context=f"{p['kind']} at line {p['init_line']}")
+        elif p["started"] is not None:
+            self.L.diag("L109",
+                        f"Start on persistent plan {req!r} which is already "
+                        f"started (line {p['started']}) — call Wait before "
+                        f"restarting",
+                        line, context=f"{p['kind']} at line {p['init_line']}")
+        p["started"] = line
+
+    # -- L110 bookkeeping: revoked / shrunk communicators -------------------
+
+    def _ft_effects(self, st, call, name):
+        if name == "Comm_revoke":
+            if call.args and isinstance(call.args[0], ast.Name):
+                self._ft[call.args[0].id] = ("revoked", call.lineno)
+            return
+        if name == "Comm_shrink":
+            if call.args and isinstance(call.args[0], ast.Name):
+                self._ft[call.args[0].id] = ("shrunk", call.lineno)
+            return
+        comm = self.L._arg(call, len(call.args) - 1, kw="comm") \
+            if call.args or call.keywords else None
+        cname = comm.id if isinstance(comm, ast.Name) else None
+        if name == "Comm_agree":
+            # the group ran the decision protocol: reuse is deliberate now
+            if call.args and isinstance(call.args[0], ast.Name):
+                self._ft.pop(call.args[0].id, None)
+            return
+        if name in COMM_OPS and cname is not None and cname in self._ft:
+            state, ftline = self._ft[cname]
+            if state == "revoked":
+                why = (f"{cname!r} was revoked at line {ftline} — run "
+                       f"Comm_agree or switch to the Comm_shrink result first")
+            else:
+                why = (f"{cname!r} is the parent of a Comm_shrink at line "
+                       f"{ftline} — use the shrunk communicator")
+            self.L.diag("L110", f"{name} on communicator {why}",
+                        call.lineno, context=f"comm variable {cname!r}")
+
+    # -- L111 bookkeeping: serve-tier client sessions -----------------------
+
+    def _session_attach(self, st, call) -> bool:
+        """True if ``call`` is serve.attach(...); records the session var."""
+        f = call.func
+        is_attach = False
+        if isinstance(f, ast.Name) and f.id == "attach":
+            is_attach = True
+        elif isinstance(f, ast.Attribute) and f.attr == "attach":
+            base = f.value
+            if isinstance(base, ast.Name) and base.id == "serve":
+                is_attach = True
+            elif (isinstance(base, ast.Attribute) and base.attr == "serve"
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id in _MPI_BASES):
+                is_attach = True
+        if is_attach:
+            target = self._assign_target(st)
+            if target is not None:
+                self._sessions[target] = None
+        return is_attach
+
+    def _session_effects(self, st, call, base, meth):
+        detached = self._sessions[base]
+        if meth in ("detach", "close"):
+            self._sessions[base] = call.lineno
+            return
+        if meth not in SESSION_OPS:
+            return
+        if detached is not None:
+            self.L.diag("L111",
+                        f"{meth}() on session {base!r} after it was detached "
+                        f"at line {detached}",
+                        call.lineno, context=f"session variable {base!r}")
+        if meth == "comm_dup":
+            target = self._assign_target(st)
+            if target is not None:
+                self._sess_comms[target] = base
+        for val in list(call.args) + [k.value for k in call.keywords]:
+            if isinstance(val, ast.Name):
+                owner = self._sess_comms.get(val.id)
+                if owner is not None and owner != base:
+                    self.L.diag(
+                        "L111",
+                        f"{meth}() on session {base!r} is passed communicator "
+                        f"{val.id!r} that belongs to session {owner!r} — "
+                        f"session comms are tenant-scoped",
+                        call.lineno, context=f"comm variable {val.id!r}")
+
     def _method_effects(self, st, call):
         # req.wait() / req.test() disarm; buf.fill()-style calls mutate
+        if self._session_attach(st, call):
+            return
         f = call.func
         if not (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)):
             return
         base, meth = f.value.id, f.attr
+        if base in self._sessions:
+            self._session_effects(st, call, base, meth)
+            return
         if meth in ("wait", "test", "Wait", "Test"):
             self._armed.pop(base, None)
+            if base in self._pers:
+                self._pers[base]["started"] = None
+        elif meth in ("start", "Start") and base in self._pers:
+            self._start_plan(base, call.lineno)
+        elif meth == "free":
+            if base in self._pers:
+                self._pers[base]["freed"] = call.lineno
+            else:
+                self._freed.add(base)
         elif meth in ("fill", "sort", "put", "setfield", "resize"):
             self._flag_mutation(base, call.lineno)
 
@@ -244,6 +448,40 @@ class _Unit:
                             f"{post_line} is mutated before its Wait",
                             line, context=f"request variable {req!r}")
                 del self._armed[req]
+        for req, p in self._pers.items():
+            # partitioned plans are EXPECTED to fill partitions between
+            # Start and Wait — Pready/Parrived carry the per-slice contract
+            if p["kind"] in ("Psend_init", "Precv_init"):
+                continue
+            if p["started"] is not None and p["buf"] == varname:
+                self.L.diag("L109",
+                            f"buffer {varname!r} of persistent plan {req!r} "
+                            f"is mutated between Start (line {p['started']}) "
+                            f"and its Wait",
+                            line, context=f"{p['kind']} at line "
+                                          f"{p['init_line']}")
+                p["buf"] = None         # one diagnostic per plan
+
+    def _assign_clears(self, st):
+        """Rebinding a tracked name retires whatever it pointed at."""
+        target = self._assign_target(st)
+        if target is None:
+            return
+        self._ft.pop(target, None)
+        self._freed.discard(target)
+        if not (isinstance(st.value, ast.Call)
+                and _call_name(st.value) in PERSISTENT_INITS):
+            self._pers.pop(target, None)
+        if not (isinstance(st.value, ast.Call)
+                and self._session_is_attach_value(st.value)):
+            self._sessions.pop(target, None)
+            self._sess_comms.pop(target, None)
+
+    @staticmethod
+    def _session_is_attach_value(call: ast.Call) -> bool:
+        f = call.func
+        return (isinstance(f, ast.Name) and f.id == "attach") or \
+            (isinstance(f, ast.Attribute) and f.attr in ("attach", "comm_dup"))
 
 
 class _Linter:

@@ -12,8 +12,15 @@ checks what no single rank can check alone:
   compared);
 - **T203** — a sent message that was never received (suppressed when the
   receiver's ring overflowed: absence of evidence is not evidence);
-- plus any online findings the hooks queued (T206 Isend buffer mutation) and
-  the RMA race pass (:func:`tpu_mpi.analyze.races.detect_races`).
+- **T207** — ULFM protocol divergence: ranks of one communicator disagree on
+  the agreement epoch, the agreed flag value, or the shrink survivor set in
+  the same protocol round;
+- **T208** — serve-tier accounting: a broker ``book`` event whose per-tenant
+  measured rows fail to partition the pool totals;
+- plus any online findings the hooks queued (T206 Isend buffer mutation),
+  the RMA race pass (:func:`tpu_mpi.analyze.races.detect_races`), and the
+  donated-buffer invalidation pass
+  (:func:`tpu_mpi.analyze.races.detect_donation_races`, R302).
 
 :func:`deadlock_report` renders the per-rank pending operations and the
 wait-for cycle appended to DeadlockError messages by the runtime watchdog
@@ -48,8 +55,11 @@ def verify_trace(obj: Any = None) -> List[Diagnostic]:
         out = list(tr.diagnostics)
     out += _check_collectives(tr)
     out += _check_p2p(tr)
-    from .races import detect_races
+    out += _check_ft(tr)
+    out += _check_serve(tr)
+    from .races import detect_donation_races, detect_races
     out += detect_races(tr)
+    out += detect_donation_races(tr)
     out.sort(key=lambda d: (d.file, d.line, d.code))
     return out
 
@@ -154,6 +164,83 @@ def _check_p2p(tr) -> List[Diagnostic]:
                 file=ev.file, line=ev.line, rank=src,
                 context=f"{len(evs)} send(s), {recvs.get(key, 0)} receive(s) "
                         f"for this (source, destination, tag)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ULFM protocol agreement (T207)
+# ---------------------------------------------------------------------------
+
+def _canon(v):
+    """Hashable form of an ``extra`` field — JSON round-trips the recorded
+    survivor tuples back as lists."""
+    return tuple(v) if isinstance(v, list) else v
+
+
+def _check_ft(tr) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    # Alignment can NOT use ev.seq: the ft ordinal mixes Comm_revoke (which
+    # only the revoking rank records) with the collective agree/shrink
+    # steps. Re-derive a per-(rank, cid, op) ordinal from ring order instead.
+    rounds: Dict[tuple, list] = defaultdict(list)
+    ordinal: Dict[tuple, int] = defaultdict(int)
+    for ev in tr.events():
+        if ev.kind != "ft" or ev.op == "Comm_revoke":
+            continue
+        k = (ev.rank, ev.cid, ev.op)
+        rounds[(ev.cid, ev.op, ordinal[k])].append(ev)
+        ordinal[k] += 1
+    for (cid, op, rnd), evs in sorted(rounds.items(),
+                                      key=lambda kv: (kv[0][0], str(kv[0][1]),
+                                                      kv[0][2])):
+        if len(evs) < 2:
+            continue        # dead or evicted peers: nothing to compare
+        for field, label in (("epoch", "agreement epoch"),
+                             ("value", "agreed value"),
+                             ("survivors", "survivor set")):
+            vals = {ev.rank: _canon((ev.extra or {}).get(field))
+                    for ev in evs}
+            distinct = {v for v in vals.values() if v is not None}
+            if len(distinct) > 1:
+                anchor = min(evs, key=lambda ev: ev.rank)
+                out.append(Diagnostic(
+                    "T207",
+                    f"{label} of {op} round {rnd} on comm {cid} diverges "
+                    f"across ranks: "
+                    + ", ".join(f"rank {r} -> {v}"
+                                for r, v in sorted(vals.items())
+                                if v is not None),
+                    file=anchor.file, line=anchor.line, rank=anchor.rank,
+                    context=f"ranks {sorted(vals)}"))
+                break       # one diagnostic per divergent round
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Serve-tier book partition (T208)
+# ---------------------------------------------------------------------------
+
+def _check_serve(tr) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    flush_no = 0
+    for ev in tr.events():
+        if ev.kind != "serve" or ev.op != "book" or not ev.extra:
+            continue
+        flush_no += 1
+        totals = ev.extra.get("totals") or {}
+        measured = ev.extra.get("measured") or {}
+        for field, total in sorted(totals.items()):
+            attributed = sum(int((row or {}).get(field, 0) or 0)
+                             for row in measured.values())
+            if attributed != int(total or 0):
+                out.append(Diagnostic(
+                    "T208",
+                    f"ledger flush {flush_no}: per-tenant measured "
+                    f"{field!r} rows sum to {attributed} but the pool "
+                    f"total is {total} — cid-ownership attribution lost "
+                    f"{int(total or 0) - attributed} unit(s)",
+                    file=ev.file, line=ev.line, rank=ev.rank,
+                    context=f"tenants {sorted(measured)}"))
     return out
 
 

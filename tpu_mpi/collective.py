@@ -1090,13 +1090,18 @@ def _reduce_family(args, has_root: bool, mode: str, name: str) -> Any:
     # lands in the same phase breakdown as the channel's rendezvous/fold
     # spans (the inner _run sees the open scope and defers finalization).
     sc = _pv.op_begin() if (_pv.enabled() or _ev.enabled()) else None
+    # while tracing, stamp the contribution buffer's identity into the
+    # signature (copy — cplan.sig may be plan-cache shared) so the R302
+    # pass can see a stale donated result fed back into a reduction
+    sig = dict(cplan.sig, bufid=_ev.buf_id(sendbuf)) if _ev.enabled() \
+        else cplan.sig
     try:
         if has_root:
             result = _run_rooted(comm, root, payload, cplan.combine,
-                                 cplan.opname, plan=cplan.hint, _sig=cplan.sig)
+                                 cplan.opname, plan=cplan.hint, _sig=sig)
         else:
             result = _run(comm, payload, cplan.combine, cplan.opname,
-                          plan=cplan.hint, _sig=cplan.sig)
+                          plan=cplan.hint, _sig=sig)
         i_get_result = (not has_root) or rank == root
         if mode == "exscan" and result is None:
             # rank 0's Exscan output is undefined (src/collective.jl:834-855);
@@ -1794,27 +1799,45 @@ def _register_allreduce(comm: Comm, args) -> Optional[PlanRegistration]:
         shm_release=shm_release, knob_on=True, nb_probe=nb_probe,
         inplace_optin=bool(inplace or alloc)))
 
+def _persistent_round(req: PersistentCollRequest, fn):
+    """Run one legacy-lane persistent round on the worker thread, tagging
+    the collective event it records with the owning handle + round so
+    ``analyze.explore`` models the round's timing from the Start/Wait pair
+    instead of double-counting the inner event."""
+    from .analyze import events as _ev
+    if not _ev.enabled():
+        return fn()
+    with _ev.persistent_scope(id(req), req._round - 1):
+        return fn()
+
+
 def Allreduce_init(*args) -> PersistentCollRequest:
     """Persistent Allreduce (same flavors as :func:`Allreduce`). Arm with
     ``Start``/``Startall``; complete with the Wait/Test family; reuse. The
     allocating variant's value lands in ``req.result`` each round."""
     comm = _comm_of(args)
-    return PersistentCollRequest(
-        lambda: _nb_submit(comm, lambda: Allreduce(*args)),
-        "pallreduce", args[0] if args else None).bind_registration(
-            lambda: _register_allreduce(comm, args))
+    req = PersistentCollRequest(
+        lambda: _nb_submit(comm, lambda: _persistent_round(
+            req, lambda: Allreduce(*args))),
+        "pallreduce", args[0] if args else None, comm=comm)
+    return req.bind_registration(lambda: _register_allreduce(comm, args))
 
 
 def Bcast_init(buf: Any, root: int, comm: Comm) -> PersistentCollRequest:
     """Persistent Bcast of ``buf`` from ``root``; mutates buf every round."""
-    return PersistentCollRequest(
-        lambda: _nb_submit(comm, lambda: Bcast(buf, root, comm)),
-        "pbcast", buf)
+    req = PersistentCollRequest(
+        lambda: _nb_submit(comm, lambda: _persistent_round(
+            req, lambda: Bcast(buf, root, comm))),
+        "pbcast", buf, comm=comm)
+    return req
 
 
 def Barrier_init(comm: Comm) -> PersistentCollRequest:
     """Persistent barrier."""
-    return PersistentCollRequest(
-        lambda: _nb_submit(comm, lambda: Barrier(comm)), "pbarrier", None)
+    req = PersistentCollRequest(
+        lambda: _nb_submit(comm, lambda: _persistent_round(
+            req, lambda: Barrier(comm))),
+        "pbarrier", None, comm=comm)
+    return req
 
 

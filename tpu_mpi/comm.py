@@ -389,6 +389,9 @@ def Comm_revoke(comm: Comm) -> None:
     comm._check()
     ctx = comm.ctx
     _record_coll(comm, f"Comm_revoke@{comm.cid}")
+    from .analyze import events as _ev
+    if _ev.enabled():
+        _ev.record_ft(comm, "Comm_revoke")
     ctx.revoke_comm(comm.cid)
     flood = getattr(ctx, "flood", None)
     if flood is not None:
@@ -404,8 +407,13 @@ def Comm_agree(comm: Comm, flag: int = 1) -> int:
     ctx, world_rank = require_env()
     _record_coll(comm, f"Comm_agree@{comm.cid}")
     epoch = _next_epoch(ctx, comm.cid, world_rank)
-    value, _dead = ctx.ft_agree(world_rank, comm.group, comm.cid, epoch,
-                                int(flag))
+    value, dead = ctx.ft_agree(world_rank, comm.group, comm.cid, epoch,
+                               int(flag))
+    from .analyze import events as _ev
+    if _ev.enabled():
+        # T207 front end: every member must report the same epoch/value/dead
+        # view for this agreement, or the recovery protocol has diverged
+        _ev.record_ft(comm, "Comm_agree", epoch=epoch, dead=dead, value=value)
     return value
 
 
@@ -425,6 +433,10 @@ def Comm_shrink(comm: Comm) -> Comm:
     epoch = _next_epoch(ctx, comm.cid, world_rank)
     _value, dead = ctx.ft_agree(world_rank, comm.group, comm.cid, epoch, 1)
     survivors = tuple(r for r in comm.group if r not in dead)
+    from .analyze import events as _ev
+    if _ev.enabled():
+        _ev.record_ft(comm, "Comm_shrink", epoch=epoch, survivors=survivors,
+                      dead=dead)
     drain = getattr(ctx, "drain_failed_state", None)
     if drain is not None:
         drain(comm.cid)

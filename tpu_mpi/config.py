@@ -92,6 +92,10 @@ class Config:
     trace: bool = False
     # per-rank event ring-buffer capacity while tracing is on.
     trace_buffer: int = 4096
+    # path PREFIX for per-rank trace dumps written at Finalize (one
+    # ``<prefix>.rank<N>.trace.json`` per rank); consumed offline by
+    # ``python -m tpu_mpi.analyze explore``. "" = no dump.
+    trace_dump: str = ""
     # collective algorithm layer (tpu_mpi.tune, docs/performance.md
     # "Algorithm selection"): path of a measured tuning table written by
     # ``tpurun --tune``; "" = use the built-in heuristic crossovers.
@@ -200,6 +204,7 @@ _ENV_MAP = {
     "fused_fold": "TPU_MPI_FUSED_FOLD",
     "trace": "TPU_MPI_TRACE",
     "trace_buffer": "TPU_MPI_TRACE_BUFFER",
+    "trace_dump": "TPU_MPI_TRACE_DUMP",
     "tune_table": "TPU_MPI_TUNE_TABLE",
     "coll_algo": "TPU_MPI_COLL_ALGO",
     "tune_explore": "TPU_MPI_TUNE_EXPLORE",

@@ -143,6 +143,10 @@ def Finalize() -> None:
     # (TPU_MPI_PVARS_DUMP) — one branch when pvars are off
     from . import perfvars
     perfvars.finalize_dump()
+    # likewise flush this rank's event trace (TPU_MPI_TRACE_DUMP) for
+    # offline schedule exploration — a no-op unless tracing is on
+    from .analyze import events as _trace_events
+    _trace_events.finalize_dump()
     # detach the serve-tier session Init(session=...) opened, releasing the
     # lease cleanly (broker reclaims the cid namespace as detached)
     import sys

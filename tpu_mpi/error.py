@@ -139,11 +139,20 @@ DIAGNOSTIC_CODES = {
     "L106": ERR_BUFFER,                 # send-buffer reuse before Wait
     "L107": ERR_DEADLOCK,               # blocking send/recv cycle pattern
     "L108": ERR_RMA_RACE,               # static RMA epoch race
+    "L109": ERR_REQUEST,                # persistent-request misuse
+    "L110": ERR_REVOKED,                # op on revoked/shrunk communicator
+    "L111": ERR_SESSION,                # serve-session misuse
     "T201": ERR_COLLECTIVE_MISMATCH,    # collective order mismatch (traced)
     "T202": ERR_COLLECTIVE_MISMATCH,    # collective signature mismatch
     "T203": ERR_PENDING,                # sent message never received
     "T206": ERR_BUFFER,                 # Isend buffer modified before Wait
+    "T207": ERR_REVOKED,                # agree/shrink protocol divergence
+    "T208": ERR_SESSION,                # measured books don't partition pool
+    "T210": ERR_DEADLOCK,               # alternate-schedule deadlock
+    "T211": ERR_PENDING,                # alternate-schedule orphaned message
+    "T212": ERR_ARG,                    # schedule-dependent wildcard values
     "R301": ERR_RMA_RACE,               # vector-clock RMA race
+    "R302": ERR_BUFFER,                 # donated fold result read after inval
 }
 
 

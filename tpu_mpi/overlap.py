@@ -28,7 +28,7 @@ persistent P2P machinery (:class:`tpu_mpi.pointtopoint.Prequest`).
 from __future__ import annotations
 
 import threading
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Callable, Optional
 
 from . import error as _ec
@@ -364,7 +364,8 @@ class PersistentCollRequest:
     ops; Test on a fast-armed round demotes to this lane (Test must not
     block)."""
 
-    def __init__(self, make: Callable[[], Any], kind: str, buffer: Any):
+    def __init__(self, make: Callable[[], Any], kind: str, buffer: Any,
+                 comm: Any = None):
         self._make = make           # () -> a live CollRequest
         self._inner = None
         self.kind = kind            # e.g. "pallreduce"
@@ -374,6 +375,12 @@ class PersistentCollRequest:
         self._reg: Optional[PlanRegistration] = None
         self._reg_factory: Optional[Callable[[], Any]] = None
         self._fast_armed = False
+        # tracing state (tpu_mpi.analyze): the comm the Start/Wait events
+        # record against, rounds started so far, and strong refs to recent
+        # round results so R302's invalidation ids stay unrecycled.
+        self._comm = comm
+        self._round = 0
+        self._results: deque = deque(maxlen=4)
 
     def bind_registration(self, factory: Callable[[], Any]
                           ) -> "PersistentCollRequest":
@@ -395,6 +402,18 @@ class PersistentCollRequest:
         if self.active:
             raise MPIError("Start on an already-active persistent request",
                            code=_ec.ERR_REQUEST)
+        from .analyze import events as _ev
+        if _ev.enabled() and self._comm is not None:
+            # R302 front end: on the donated fast path, this Start re-donates
+            # the 2-slot fold ring entry holding round (k-2)'s result — name
+            # that buffer so the race pass can flag reads-after-invalidation.
+            inval = None
+            for rnd, res in self._results:
+                if rnd == self._round - 2:
+                    inval = _ev.buf_id(res)
+            _ev.record_start(self._comm, self.kind, id(self), self._round,
+                             invalidates=inval)
+        self._round += 1
         reg = self._reg
         if reg is not None:
             from . import config
@@ -457,6 +476,7 @@ class PersistentCollRequest:
                 lst.remove(self)
             self.result = self._reg.run_round()
             self.status = STATUS_EMPTY
+            self._trace_complete()
             return self.status
         if self._inner is None:
             return self.status or STATUS_EMPTY
@@ -473,6 +493,7 @@ class PersistentCollRequest:
                 _pv.disown_wait()
         self.result = self._inner.result
         self._inner = None          # inactive, ready for the next Start
+        self._trace_complete()
         return self.status
 
     def _consume(self):
@@ -491,7 +512,19 @@ class PersistentCollRequest:
                 _pv.disown_wait()
         self.result = self._inner.result
         self._inner = None
+        self._trace_complete()
         return self.status
+
+    def _trace_complete(self) -> None:
+        """Record the Wait that completed round ``self._round - 1`` and pin
+        its result object (identity anchor for R302's invalidation window)."""
+        from .analyze import events as _ev
+        if not _ev.enabled() or self._comm is None:
+            return
+        rnd = self._round - 1
+        self._results.append((rnd, self.result))
+        _ev.record_wait(self._comm, self.kind, id(self), rnd,
+                        result=self.result)
 
     def cancel(self) -> None:
         raise MPIError("nonblocking collectives cannot be cancelled")

@@ -100,7 +100,8 @@ class Request:
     """
 
     __slots__ = ("kind", "buffer", "status", "_pending", "_mailbox", "_count",
-                 "_done", "_inactive", "_trace_isend", "_trace_comm")
+                 "_done", "_inactive", "_trace_isend", "_trace_comm",
+                 "_trace_want")
 
     def __init__(self, kind: str = "null", buffer: Any = None,
                  pending: Optional[PendingRecv] = None, mailbox=None,
@@ -115,6 +116,7 @@ class Request:
         # buffer checksum (T206) and the comm a traced Irecv records against.
         self._trace_isend = None
         self._trace_comm = None
+        self._trace_want = None       # posted (src, tag) of a traced Irecv
         self._done = kind in ("send", "null")
         # True once the completion has been surfaced to the caller: the
         # request then behaves like MPI_REQUEST_NULL (libmpi writes the null
@@ -137,7 +139,10 @@ class Request:
         self._done = True
         if self._trace_comm is not None:
             if _ev.enabled():
-                _ev.record_recv(self._trace_comm, msg, op="Irecv")
+                want, wtag = self._trace_want or (msg.src, msg.tag)
+                _ev.record_recv(self._trace_comm, msg, op="Irecv",
+                                want=None if want == ANY_SOURCE else want,
+                                wtag=None if wtag == ANY_TAG else wtag)
             if _pv.enabled():
                 _pv.add_recv(self._trace_comm,
                              getattr(msg.payload, "nbytes", 0) or 0)
@@ -232,7 +237,7 @@ def _my_mailbox(comm: Comm):
 
 def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
           dtype: Optional[Datatype], kind: str, block: bool = False,
-          mb: Any = None, ctx: Any = None) -> None:
+          mb: Any = None, ctx: Any = None, ubuf: Any = None) -> None:
     if ctx is None:                      # _send_typed already resolved it
         ctx, _ = require_env()
     ctx.check_failure()
@@ -255,7 +260,8 @@ def _post(comm: Comm, dest: int, tag: int, payload: Any, count: int,
     if traced:
         opname = (("Send" if block else "Isend") if kind == "typed"
                   else ("send" if block else "isend"))
-        _ev.record_send(comm, dest, tag, count, dtype, op=opname)
+        _ev.record_send(comm, dest, tag, count, dtype, op=opname,
+                        buf=ubuf if ubuf is not None else payload)
     if block and hasattr(mb, "post_blocking"):
         # Flow control for blocking sends. Thread tier: admission-checked
         # against the destination queue under its lock. Multi-process tier:
@@ -294,7 +300,7 @@ def _send_typed(buf: Any, dest: int, tag: int, comm: Comm, block: bool) -> None:
         # already a private to_wire snapshot (Sendrecv_replace /
         # Isendrecv_replace made it): re-snapshotting would just copy again
         _post(comm, dest, tag, buf, count, to_datatype(buf.dtype), "typed",
-              block=block)
+              block=block, ubuf=arr0)
         return
     ctx, _ = require_env()
     mb = ctx.mailboxes[_resolve(comm, dest)]
@@ -309,11 +315,11 @@ def _send_typed(buf: Any, dest: int, tag: int, comm: Comm, block: bool) -> None:
         # object itself outlives the call inside the peer's mailbox.
         if isinstance(arr0, np.ndarray):
             _post(comm, dest, tag, arr0, count, to_datatype(arr0.dtype),
-                  "typed", block=block, mb=mb, ctx=ctx)
+                  "typed", block=block, mb=mb, ctx=ctx, ubuf=arr0)
             return
     arr = to_wire(buf, count)
     _post(comm, dest, tag, arr, count, to_datatype(arr.dtype), "typed",
-          block=block, mb=mb, ctx=ctx)
+          block=block, mb=mb, ctx=ctx, ubuf=arr0)
 
 
 def Send(buf: Any, dest: int, tag: int, comm: Comm) -> None:
@@ -407,7 +413,9 @@ def Recv(buf_or_type: Any, src: int, tag: int, comm: Comm,
             msg = mb.recv_blocking(int(src), int(tag), comm.cid)
         finally:
             _ev.clear_blocked(ctx, bev)
-        _ev.record_recv(comm, msg, op="Recv")
+        _ev.record_recv(comm, msg, op="Recv",
+                        want=None if src == ANY_SOURCE else src,
+                        wtag=None if tag == ANY_TAG else int(tag))
     else:
         msg = mb.recv_blocking(int(src), int(tag), comm.cid)
     assert msg is not None            # blocking Recv exposes no cancel handle
@@ -441,6 +449,7 @@ def Irecv(buf: Any, src: int, tag: int, comm: Comm) -> Request:
     # _trace_comm re-gates on its own enabled() before acting on it)
     if _ev.enabled() or _pv.enabled():
         req._trace_comm = comm
+        req._trace_want = (int(src), int(tag))
     return req
 
 
@@ -460,7 +469,9 @@ def recv(src: int, tag: int, comm: Comm):
             msg = mb.recv_blocking(int(src), int(tag), comm.cid)
         finally:
             _ev.clear_blocked(ctx, bev)
-        _ev.record_recv(comm, msg, op="recv")
+        _ev.record_recv(comm, msg, op="recv",
+                        want=None if src == ANY_SOURCE else src,
+                        wtag=None if tag == ANY_TAG else int(tag))
     else:
         msg = mb.recv_blocking(int(src), int(tag), comm.cid)
     assert msg is not None
@@ -480,7 +491,9 @@ def irecv(src: int, tag: int, comm: Comm):
     got = mb.wait_recv(pr)
     assert got is not None
     if _ev.enabled():
-        _ev.record_recv(comm, got, op="irecv")
+        _ev.record_recv(comm, got, op="irecv",
+                        want=None if src == ANY_SOURCE else src,
+                        wtag=None if tag == ANY_TAG else int(tag))
     return (True, _object_of(got), _status_of(got))
 
 

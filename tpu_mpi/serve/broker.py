@@ -52,6 +52,7 @@ import numpy as np
 
 from .. import config
 from .. import error as _ec
+from ..analyze import events as _ev
 from ..error import MPIError, SessionError
 from .._runtime import SpmdContext, set_current_tenant, set_env
 from . import protocol
@@ -388,6 +389,12 @@ class Broker:
             op = self.fq.pop(timeout=0.2)
             if op is None:
                 continue
+            # trace the dispatcher's global initiation order: explore uses
+            # these to label schedules, and their single-threaded origin is
+            # the invariant that keeps cross-cid initiation orders aligned
+            _ev.record_serve(self.pool.ctx, "dispatch", cid=op.cid,
+                             tenant=op.tenant, kind=op.kind, oid=op.oid,
+                             nbytes=op.nbytes)
             self.pool.run_op(op, self._op_done)
 
     def _op_done(self, op: PoolOp) -> None:
@@ -428,6 +435,8 @@ class Broker:
             self._leases[tenant] = lease
         self.fq.add_tenant(tenant)
         self.ledger.open_tenant(tenant)
+        _ev.record_serve(self.pool.ctx, "lease", cid=root_cid, tenant=tenant,
+                         base=ns.base, limit=ns.limit)
         return lease
 
     def revoke_lease(self, lease: Lease, reason: str, *,
@@ -453,6 +462,9 @@ class Broker:
             plans.invalidate(cid)
         self.ledger.close_tenant(lease.tenant,
                                  revoked=reason != "client detached")
+        _ev.record_serve(self.pool.ctx, "lease_revoke", tenant=lease.tenant,
+                         reason=reason, base=lease.ns.base,
+                         limit=lease.ns.limit)
         if close_conn:
             try:
                 lease.conn.close()
@@ -659,8 +671,19 @@ class Broker:
     def flush_ledger(self) -> dict:
         """Rebuild the measured books from a fresh pvar snapshot; the
         returned pool totals equal the sum over tenants by construction."""
-        return self.ledger.flush_from_pvars(self.pool.snapshot_pvars(),
-                                            self._owner_of_cid)
+        totals = self.ledger.flush_from_pvars(self.pool.snapshot_pvars(),
+                                              self._owner_of_cid)
+        if _ev.enabled():
+            # T208 front end: the flushed per-tenant measured rows plus the
+            # pool totals and the live cid-ownership map, in one event the
+            # trace verifier can re-add and cross-check
+            rep = self.ledger.report()
+            measured = {t: dict(e.get("measured") or {})
+                        for t, e in rep["tenants"].items()}
+            _ev.record_serve(self.pool.ctx, "book", totals=dict(totals),
+                             measured=measured,
+                             ranges=[list(r) for r in self._cid_ranges])
+        return totals
 
     def stats(self) -> dict:
         totals = self.flush_ledger()
