@@ -75,12 +75,14 @@ def bench_host(nranks: int, sizes: list[int], use_device: bool,
 
 def host_phase_breakdown(nranks: int, n_elems: int,
                          rounds: int = 50) -> dict:
-    """Per-phase pvar evidence for the host lanes (ISSUE-6 satellite 1):
-    run ``rounds`` generic Allreduce calls and ``rounds`` persistent
-    Start/Wait rounds back-to-back under one SPMD session with pvars on,
-    snapshotting rank 0's rendezvous/fold/copy phase seconds after each.
-    The persistent lane's rendezvous share collapsing toward zero is the
-    registered-buffer fast path's direct signature."""
+    """Per-phase pvar evidence for the host lanes (ISSUE-6 satellite 1,
+    extended for ISSUE-11): run ``rounds`` generic Allreduce calls with
+    auto-arming disabled (the legacy "before" curve), ``rounds`` with the
+    default auto-armed path (the promoted plain-call lane), and ``rounds``
+    hand-armed persistent Start/Wait rounds, all back-to-back under one
+    SPMD session with pvars on, snapshotting rank 0's rendezvous/fold/copy
+    phase seconds after each. The default lane's rendezvous share
+    collapsing toward the hand-armed lane's is the auto-arming signature."""
     import numpy as np
     import tpu_mpi as MPI
     from tpu_mpi import spmd_run
@@ -92,11 +94,32 @@ def host_phase_breakdown(nranks: int, n_elems: int,
     def body():
         MPI.Init()
         comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
         buf = np.ones(n_elems, np.float32)
         out = np.zeros(n_elems, np.float32)
         MPI.Allreduce(buf, out, MPI.SUM, comm)      # warm plan caches
         # barriers fence each measured window so one rank's section change
         # cannot bleed into a sibling's still-open spans (GIL time-sharing)
+        # legacy window: auto-arming off — the pre-ISSUE-11 default path
+        MPI.Barrier(comm)
+        if rank == 0:
+            os.environ["TPU_MPI_AUTO_ARM"] = "0"
+        MPI.Barrier(comm)
+        _cfg.load(refresh=True)
+        MPI.Barrier(comm)
+        comm.get_pvars(reset=True)
+        for _ in range(rounds):
+            MPI.Allreduce(buf, out, MPI.SUM, comm)
+        legacy = comm.get_pvars(reset=True)
+        # default window: auto-arm back on; warm past the threshold so the
+        # measured rounds all ride the promoted registered path
+        MPI.Barrier(comm)
+        if rank == 0:
+            os.environ.pop("TPU_MPI_AUTO_ARM", None)
+        MPI.Barrier(comm)
+        _cfg.load(refresh=True)
+        for _ in range(8):
+            MPI.Allreduce(buf, out, MPI.SUM, comm)
         MPI.Barrier(comm)
         comm.get_pvars(reset=True)
         for _ in range(rounds):
@@ -120,17 +143,32 @@ def host_phase_breakdown(nranks: int, n_elems: int,
                     "wait_s": round(s["wait_s"], 6),
                     "rendezvous_share": round(
                         ph.get("rendezvous", 0.0) / tot, 4) if tot else None}
-        return {"host": lane(generic), "host_persistent": lane(pers)}
+        return {"host_legacy": lane(legacy), "host": lane(generic),
+                "host_persistent": lane(pers)}
 
     res = spmd_run(body, nranks)
     out = res[0]
     out["bytes"] = n_elems * 4
-    print(f"pvars host        rendezvous_share="
-          f"{out['host']['rendezvous_share']} "
-          f"phase_s={out['host']['phase_s']}", file=sys.stderr)
-    print(f"pvars host_persistent rendezvous_share="
-          f"{out['host_persistent']['rendezvous_share']} "
-          f"phase_s={out['host_persistent']['phase_s']}", file=sys.stderr)
+    # cross-rank aggregate lanes: exactly one rank executes each round's
+    # fold, so rank-0's share depends on WHICH rank folded (a scheduling
+    # lottery at MiB payloads). Summing every rank's phases cancels that
+    # attribution and gives a run-stable share — the number CI gates on.
+    agg: dict = {}
+    for name in ("host_legacy", "host", "host_persistent"):
+        ph: dict = {}
+        for r in res:
+            for k, v in r[name]["phase_s"].items():
+                ph[k] = round(ph.get(k, 0.0) + v, 6)
+        tot = sum(ph.values())
+        agg[name] = {"rounds": rounds, "phase_s": ph,
+                     "rendezvous_share": round(
+                         ph.get("rendezvous", 0.0) / tot, 4) if tot else None}
+    out["aggregate"] = agg
+    for name in ("host_legacy", "host", "host_persistent"):
+        print(f"pvars {name:<16} rank0_share="
+              f"{out[name]['rendezvous_share']} aggregate_share="
+              f"{agg[name]['rendezvous_share']} "
+              f"phase_s={out[name]['phase_s']}", file=sys.stderr)
     return out
 
 

@@ -1,0 +1,19 @@
+# nprocs: 2
+#
+# Clean fixture: the auto-armed default lane done right. A plain
+# allocating-Allreduce loop is transparently promoted onto the
+# registered persistent path (TPU_MPI_AUTO_ARM defaults on), and the
+# default copy-out contract hands back an independent array every
+# round — results are safe to hold across rounds. Zero lint, zero
+# trace, nothing for the explorer to reorder.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+x = np.ones(8)
+total = np.zeros(8)
+for _ in range(8):
+    res = MPI.Allreduce(x, MPI.SUM, comm)
+    total = total + res               # consumed or held — both are safe
+MPI.Barrier(comm)

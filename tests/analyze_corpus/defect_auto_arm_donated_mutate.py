@@ -1,0 +1,36 @@
+# nprocs: 2
+#
+# Defect class: auto-armed donated lane misuse. With
+# TPU_MPI_AUTO_ARM_DONATE=1 the plain allocating-Allreduce loop is
+# promoted onto the registered persistent path in donated mode after
+# TPU_MPI_AUTO_ARM_THRESHOLD identical calls, and from then on each
+# returned result may alias a donated ring slot that a later round
+# re-donates. Holding round r's result past round r+2 and mutating it
+# writes into a buffer the in-flight round owns (lint L109), and
+# feeding the stale alias back into a collective reads data the armed
+# plan is overwriting (trace R302). Tracing demotes the armed plan so
+# this run computes correct values — the verifier reports the hazard.
+import os
+
+import numpy as np
+
+import tpu_mpi as MPI
+from tpu_mpi import config
+
+os.environ["TPU_MPI_AUTO_ARM_DONATE"] = "1"
+config.load(refresh=True)
+try:
+    comm = MPI.COMM_WORLD
+    x = np.ones(8)
+    keep = None
+    for i in range(8):
+        res = MPI.Allreduce(x, MPI.SUM, comm)
+        if i == 4:
+            keep = res                # round held past its 2-round window
+    keep[0] = -1.0                    # lint: L109
+    y = np.zeros(8)
+    MPI.Allreduce(keep, y, MPI.SUM, comm)     # trace: R302
+    MPI.Barrier(comm)
+finally:
+    os.environ.pop("TPU_MPI_AUTO_ARM_DONATE", None)
+    config.load(refresh=True)

@@ -31,6 +31,9 @@ def traced(monkeypatch):
     monkeypatch.setenv("TPU_MPI_DEADLOCK_TIMEOUT", "2.0")
     config.load(refresh=True)
     yield
+    # corpus files that died before their own cleanup must not leak the
+    # auto-arm donate knob into later fixtures
+    os.environ.pop("TPU_MPI_AUTO_ARM_DONATE", None)
     config.load(refresh=True)
 
 

@@ -518,3 +518,202 @@ def test_comm_free_releases_registered_buffers(nprocs):
     finally:
         os.environ.pop("TPU_MPI_STRICT", None)
         config.load(refresh=True)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-11: auto-arming — plain collective loops promoted onto the
+# registered persistent path, and the demotion edges that must stay loud-free
+
+_AUTO_KNOBS = ("TPU_MPI_AUTO_ARM", "TPU_MPI_AUTO_ARM_THRESHOLD",
+               "TPU_MPI_AUTO_ARM_DONATE", "TPU_MPI_TRACE")
+
+
+class _autoarm:
+    """Context manager: set the auto-arm knobs, refresh config, restore."""
+
+    def __init__(self, **vals):
+        self.vals = {k: str(v) for k, v in vals.items()}
+
+    def __enter__(self):
+        self.saved = {k: os.environ.get(k) for k in _AUTO_KNOBS}
+        os.environ.update(self.vals)
+        config.load(refresh=True)
+        return self
+
+    def __exit__(self, *exc):
+        for k, v in self.saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        config.load(refresh=True)
+
+
+_AUTO_DTYPES = (np.float32, np.float64, np.int32, np.complex128)
+
+
+def test_auto_arm_bitwise_identical_and_results_independent(nprocs):
+    """The promotion must be invisible: every call of a plain Allreduce
+    loop returns the bitwise-identical reduction before, during, and after
+    arming, and each returned array is independent (the copy-out contract
+    — results never alias plan-internal slots)."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        arms0 = plans.stats()["auto"]["arms"]
+        for dt in _AUTO_DTYPES:
+            if np.issubdtype(dt, np.complexfloating):
+                x = (np.arange(32) + 1j * rank).astype(dt)
+            elif np.issubdtype(dt, np.floating):
+                x = (np.arange(32) + rank).astype(dt)
+            else:
+                x = (np.arange(32, dtype=np.int64) + rank).astype(dt)
+            outs = [np.asarray(MPI.Allreduce(x, MPI.SUM, comm))
+                    for _ in range(10)]
+            # call 0 ran generic, later calls armed: all bitwise equal
+            first = outs[0].tobytes()
+            assert all(o.tobytes() == first for o in outs), dt
+            # copy-out: scribbling on one result leaves the others alone
+            outs[-1][...] = 0
+            assert outs[-2].tobytes() == first, dt
+        assert plans.stats()["auto"]["arms"] > arms0
+        MPI.Barrier(comm)
+
+    with _autoarm(TPU_MPI_AUTO_ARM="1", TPU_MPI_AUTO_ARM_THRESHOLD="3"):
+        run_spmd(body, nprocs)
+
+
+def test_auto_arm_demotes_on_shape_churn(nprocs):
+    """Alternating signatures mid-loop demotes the armed entry without an
+    error or a wrong answer — churn falls back to the generic path."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        tot = size * (size + 1) / 2.0
+        a = np.ones(16) * (rank + 1)
+        b = np.ones(24) * (rank + 1)
+        demo0 = plans.stats()["auto"]["demotions"]
+        for _ in range(6):              # arms on the stable prefix
+            assert aeq(MPI.Allreduce(a, MPI.SUM, comm), np.full(16, tot))
+        for _ in range(6):              # churn: both shapes stay correct
+            assert aeq(MPI.Allreduce(b, MPI.SUM, comm), np.full(24, tot))
+            assert aeq(MPI.Allreduce(a, MPI.SUM, comm), np.full(16, tot))
+        assert plans.stats()["auto"]["demotions"] > demo0
+        MPI.Barrier(comm)
+
+    with _autoarm(TPU_MPI_AUTO_ARM="1", TPU_MPI_AUTO_ARM_THRESHOLD="3"):
+        run_spmd(body, nprocs)
+
+
+def test_auto_arm_comm_free_releases_armed_plan(nprocs):
+    """Comm.free with an auto-armed plan live drops the armed registration
+    (pinned scratch, shm leases) and the tracked signature — strict mode
+    asserts the lease books balance."""
+    def body():
+        from tpu_mpi.overlap import registry
+        comm = MPI.COMM_WORLD
+        sub = MPI.Comm_dup(comm)
+        cid = sub.cid
+        x = np.ones(64)
+        for _ in range(6):
+            MPI.Allreduce(x, MPI.SUM, sub)
+        assert plans.stats()["auto"]["armed"] >= 1
+        # the cache is shared across rank threads and free() invalidates
+        # the whole cid: no rank may free before every rank has looked
+        MPI.Barrier(comm)
+        sub.free()                       # strict mode: asserts leased == 0
+        assert registry.leased(cid) == 0
+        sigs = plans.stats()["auto"]["signatures"]
+        assert not any(lbl.startswith(f"{cid}/") for lbl in sigs)
+        MPI.Barrier(comm)
+
+    os.environ["TPU_MPI_STRICT"] = "1"
+    try:
+        with _autoarm(TPU_MPI_AUTO_ARM="1", TPU_MPI_AUTO_ARM_THRESHOLD="3"):
+            run_spmd(body, nprocs)
+    finally:
+        os.environ.pop("TPU_MPI_STRICT", None)
+        config.load(refresh=True)
+
+
+def test_auto_arm_trace_enable_demotes_mid_stream(nprocs):
+    """Turning tracing on mid-stream demotes the armed plan on every rank
+    (trace enablement is config-global) and stops re-arming while traced;
+    turning it off re-arms. Values stay correct throughout."""
+    def body():
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        x = np.full(32, rank + 1.0)
+        expect = np.full(32, size * (size + 1) / 2.0)
+        for _ in range(6):
+            assert aeq(MPI.Allreduce(x, MPI.SUM, comm), expect)
+        st = plans.stats()["auto"]
+        assert st["armed"] >= 1
+        demo0 = st["demotions"]
+        MPI.Barrier(comm)
+        if rank == 0:
+            os.environ["TPU_MPI_TRACE"] = "1"
+        MPI.Barrier(comm)
+        config.load(refresh=True)
+        arms_traced = plans.stats()["auto"]["arms"]
+        for _ in range(4):
+            assert aeq(MPI.Allreduce(x, MPI.SUM, comm), expect)
+        st = plans.stats()["auto"]
+        assert st["demotions"] > demo0          # armed entry was demoted
+        assert st["arms"] == arms_traced        # and never re-armed traced
+        MPI.Barrier(comm)
+        if rank == 0:
+            os.environ.pop("TPU_MPI_TRACE", None)
+        MPI.Barrier(comm)
+        config.load(refresh=True)
+        for _ in range(6):
+            assert aeq(MPI.Allreduce(x, MPI.SUM, comm), expect)
+        assert plans.stats()["auto"]["arms"] > arms_traced
+        MPI.Barrier(comm)
+
+    with _autoarm(TPU_MPI_AUTO_ARM="1", TPU_MPI_AUTO_ARM_THRESHOLD="3"):
+        run_spmd(body, nprocs)
+
+
+def test_batched_waitall_flushes_k_ops_per_rank_in_one_wakeup(nprocs):
+    """ISSUE-11 (b): K fast-armed persistent rounds started back-to-back
+    drain through ONE batched flush per rank — the pvar batch block
+    records K ops per flush (occupancy K) — and every result is right."""
+    def body():
+        from tpu_mpi import perfvars
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        K = 4
+        reqs, recvs, expects = [], [], []
+        for j in range(K):
+            send = np.full(32, float(rank + 1 + j))
+            recv = np.zeros(32)
+            reqs.append(MPI.Allreduce_init(send, recv, MPI.SUM, comm))
+            recvs.append(recv)
+            expects.append(np.full(32, sum(r + 1 + j for r in range(size))))
+        for r in reqs:                   # warm round: plans arm + register
+            MPI.Start(r)
+        MPI.Waitall(reqs)
+        MPI.Barrier(comm)
+        comm.get_pvars(reset=True)
+        MPI.Barrier(comm)
+        for r in reqs:
+            MPI.Start(r)
+        MPI.Waitall(reqs)
+        for recv, expect in zip(recvs, expects):
+            assert aeq(recv, expect)
+        MPI.Barrier(comm)
+        ba = comm.get_pvars()["batch"]
+        # this rank drained its K rounds through ONE flush: occupancy K
+        assert ba["flushes"] == 1, ba
+        assert ba["ops"] == K, ba
+        assert ba["occupancy"] == float(K), ba
+        MPI.Barrier(comm)
+
+    os.environ["TPU_MPI_PVARS"] = "1"
+    config.load(refresh=True)
+    try:
+        run_spmd(body, nprocs)
+    finally:
+        os.environ.pop("TPU_MPI_PVARS", None)
+        config.load(refresh=True)

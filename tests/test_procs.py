@@ -1545,3 +1545,81 @@ def test_rma_put_bulk_one_lepoch_frame_via_shm():
     assert res.returncode == 0, (res.stdout, res.stderr)
     for r in range(2):
         assert f"RMA-SHM-FRAMES-OK-{r}" in res.stdout, (res.stdout, res.stderr)
+
+
+def test_auto_arm_procs_tier_bitwise_identical():
+    """ISSUE-11: the auto-armed default path on the multi-process tier — a
+    plain Allreduce loop arms after the threshold and every round stays
+    bitwise-identical to the pre-arming generic result, per dtype."""
+    res = _run_procs("""
+        import os
+        os.environ["TPU_MPI_AUTO_ARM_THRESHOLD"] = "3"
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        from tpu_mpi.overlap import plans
+        for dt in (np.float32, np.float64, np.int64):
+            x = (np.arange(64) + rank).astype(dt)
+            outs = [np.asarray(MPI.Allreduce(x, MPI.SUM, comm))
+                    for _ in range(8)]
+            first = outs[0].tobytes()
+            assert all(o.tobytes() == first for o in outs), dt
+            outs[-1][...] = 0            # copy-out: results independent
+            assert outs[-2].tobytes() == first, dt
+        st = plans.stats()["auto"]
+        assert st["arms"] >= 1, st
+        assert st["hits"] >= 1, st
+        print(f"AUTOARM-PROCS-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """, nprocs=2)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    for r in range(2):
+        assert f"AUTOARM-PROCS-OK-{r}" in res.stdout, (res.stdout, res.stderr)
+
+
+def test_batched_chunk_submission_single_frame():
+    """ISSUE-11 (b): on the native transport, the K chunk contributions of
+    one pipelined collective leave a non-root rank as ONE batched frame
+    (a single writev round trip), not K separate sends."""
+    res = _run_procs("""
+        import os
+        os.environ["TPU_MPI_PIPELINE_MIN_BYTES"] = "256"
+        os.environ["TPU_MPI_PIPELINE_CHUNKS"] = "4"
+        # pin the star so the chunked lane runs (the 2-rank sim host would
+        # otherwise pick the shm fold, which sends no contribution frames)
+        os.environ["TPU_MPI_COLL_ALGO"] = "allreduce=star"
+        import numpy as np
+        import tpu_mpi as MPI
+        MPI.Init()
+        comm = MPI.COMM_WORLD
+        rank, size = MPI.Comm_rank(comm), MPI.Comm_size(comm)
+        x = np.full(4096, rank + 1.0)
+        out = np.zeros(4096)
+        MPI.Allreduce(x, out, MPI.SUM, comm)          # warm
+        from tpu_mpi import backend
+        tot = size * (size + 1) / 2.0
+        if rank != 0:
+            real = backend.ProcChannel._send
+            kinds = []
+            def spy(self, dst, item, opname):
+                kinds.append(item[0])
+                return real(self, dst, item, opname)
+            backend.ProcChannel._send = spy
+            try:
+                MPI.Allreduce(x, out, MPI.SUM, comm)
+            finally:
+                backend.ProcChannel._send = real
+            assert kinds.count("batchv") == 1, kinds  # K chunks -> 1 frame
+            assert "collc" not in kinds, kinds
+        else:
+            MPI.Allreduce(x, out, MPI.SUM, comm)
+        assert np.all(out == tot), out[:4]
+        MPI.Barrier(comm)
+        print(f"BATCH-FRAMES-OK-{rank}", flush=True)
+        MPI.Finalize()
+    """, nprocs=2)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    for r in range(2):
+        assert f"BATCH-FRAMES-OK-{r}" in res.stdout, (res.stdout, res.stderr)

@@ -36,7 +36,8 @@ def _clean_state():
     yield
     for k in ("TPU_MPI_TUNE_EXPLORE", "TPU_MPI_TUNE_SWAP_PERIOD",
               "TPU_MPI_TUNE_MIN_SAMPLES", "TPU_MPI_TUNE_SEED",
-              "TPU_MPI_TUNE_SHIM", "TPU_MPI_PVARS", "TPU_MPI_COLL_ALGO"):
+              "TPU_MPI_TUNE_SHIM", "TPU_MPI_PVARS", "TPU_MPI_COLL_ALGO",
+              "TPU_MPI_AUTO_ARM"):
         os.environ.pop(k, None)
     config.load(refresh=True)
     perfvars.reset()
@@ -92,8 +93,13 @@ def _spmd_explore_run(nprocs=4, rounds=40):
 
 
 def test_thread_tier_lockstep_counters_and_swap(monkeypatch):
+    # auto-arm off: this test pins down the raw decision-point counters,
+    # and an auto-armed loop (the ISSUE-11 default) stops reaching the
+    # bandit after the arming threshold — see test_auto_arm_* below for
+    # the combined contract
     _reload(monkeypatch, TPU_MPI_PVARS="1", TPU_MPI_TUNE_EXPLORE="0.25",
-            TPU_MPI_TUNE_SWAP_PERIOD="16", TPU_MPI_TUNE_MIN_SAMPLES="2")
+            TPU_MPI_TUNE_SWAP_PERIOD="16", TPU_MPI_TUNE_MIN_SAMPLES="2",
+            TPU_MPI_AUTO_ARM="0")
     res = sorted(_spmd_explore_run())
     # every rank went through the decision point the same number of times
     # and explored exactly the deterministic-fraction share of them
@@ -338,3 +344,70 @@ def test_merge_cli_and_online_report(tmp_path):
     rep = json.load(open(tmp_path / "online.json"))
     assert rep["bench"] == "tune_online_report"
     assert rep["arms"] and rep["arms"][0]["coll"] == "allreduce"
+
+
+# ---------------------------------------------------------------------------
+# Auto-arm x exploration (ISSUE 11): armed plans never reach the bandit,
+# and the combination keeps Event.algo sequences rank-identical
+# ---------------------------------------------------------------------------
+
+def test_auto_arm_skips_exploration_in_lockstep(monkeypatch):
+    # auto-arm ON (the default) with the bandit live: the plain Allreduce
+    # loop stops reaching the decision point once armed, on every rank at
+    # the same call — counters stay rank-identical and strictly below the
+    # unarmed figure (80 calls for 40 allreduce+barrier rounds)
+    _reload(monkeypatch, TPU_MPI_PVARS="1", TPU_MPI_TUNE_EXPLORE="0.25",
+            TPU_MPI_TUNE_SWAP_PERIOD="16", TPU_MPI_TUNE_MIN_SAMPLES="2",
+            TPU_MPI_AUTO_ARM="1", TPU_MPI_AUTO_ARM_THRESHOLD="4")
+    from tpu_mpi.overlap import plans
+    res = sorted(_spmd_explore_run())
+    first = res[0][1]
+    for _, ex, _table in res[1:]:
+        assert ex == first          # rank-identical counters
+    # barriers keep exploring every round; allreduce stopped at the arm
+    assert first["calls"] < 80, first
+    assert plans.stats()["auto"]["arms"] >= 1
+
+
+def test_auto_arm_traced_algo_sequences_rank_identical():
+    # tracing + exploration + auto-arm all on: tracing demotes auto-armed
+    # rounds to the fully-evented generic lane on EVERY rank (trace
+    # enablement is config-global), so the bandit runs in lockstep and
+    # per-call Event.algo sequences stay bitwise rank-identical
+    body = """
+    import numpy as np
+    import tpu_mpi as MPI
+    from tpu_mpi._runtime import current_env
+    from tpu_mpi.analyze import events as _ev
+
+    MPI.Init()
+    comm = MPI.COMM_WORLD
+    rank = MPI.Comm_rank(comm)
+    x = (np.arange(64, dtype=np.float64) % 5) + rank
+    for i in range(60):
+        out = MPI.Allreduce(x, MPI.SUM, comm)
+    ctx, wrank = current_env()
+    tr = _ev.tracer_for(ctx)
+    algos = [(e.op, e.algo) for e in tr.events(wrank)
+             if e.kind == "coll" and e.op.startswith("Allreduce")]
+    import json
+    with open(f"/tmp/tpu_mpi_autoarm_rank{rank}.json", "w") as f:
+        json.dump(algos, f)
+    print(f"AA-OK-{rank}")
+    MPI.Finalize()
+    """
+    for r in range(2):
+        p = f"/tmp/tpu_mpi_autoarm_rank{r}.json"
+        if os.path.exists(p):
+            os.unlink(p)
+    res = _run_procs(body, nprocs=2, env={
+        "TPU_MPI_TRACE": "1", "TPU_MPI_TUNE_EXPLORE": "0.5",
+        "TPU_MPI_TUNE_SEED": "11", "TPU_MPI_AUTO_ARM": "1",
+        "TPU_MPI_AUTO_ARM_THRESHOLD": "4"})
+    assert res.returncode == 0, res.stderr[-4000:]
+    dumps = []
+    for r in range(2):
+        with open(f"/tmp/tpu_mpi_autoarm_rank{r}.json") as f:
+            dumps.append(json.load(f))
+    assert dumps[0] == dumps[1]
+    assert len(dumps[0]) == 60

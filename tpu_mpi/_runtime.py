@@ -702,6 +702,98 @@ class CollectiveChannel(_Waitable):
         finally:
             self.cond.release()
 
+    def run_batch(self, rank: int, ops: Sequence[tuple]) -> list:
+        """Deposit K queued collective rounds through ONE lock acquisition
+        and ONE wakeup (ISSUE-11 batched submission), then collect each
+        round's result in Start order. ``ops`` is a sequence of
+        ``(contrib, combine, opname, unlocked_fold)`` tuples.
+
+        Correctness rides on the same round-keyed slots as :meth:`run`:
+        each round's slot is independent, a round folds only once ALL
+        ranks arrived in it, and folds serialize through the slowest
+        depositor — a rank cannot complete round r+1 before every rank
+        (including any rank still folding round r) deposited it. A
+        batching rank pairs correctly with peers running the same rounds
+        one ``run`` at a time: rounds are numbered per rank, not per call
+        style. The ``run`` docstring's "at most two rounds live" bound
+        relaxes to "at most two plus the largest in-flight batch"."""
+        n = len(ops)
+        if n == 0:
+            return []
+        if n == 1:
+            contrib, combine, opname, ufold = ops[0]
+            return [self.run(rank, contrib, combine, opname,
+                             unlocked_fold=ufold)]
+        sc = _pv.scope()
+        deposited = []          # (rnd, st, opname) in Start order
+        self.cond.acquire()
+        try:
+            fold_pending = False
+            for contrib, combine, opname, ufold in ops:
+                rnd = self.rank_round[rank]
+                self.rank_round[rank] += 1
+                st = self._round_state(rnd)
+                if st["opname"] is None:
+                    st["opname"] = opname
+                elif st["opname"] != opname:
+                    err = CollectiveMismatchError(
+                        f"rank {rank} called {opname!r} while other ranks "
+                        f"are in {st['opname']!r} on the same communicator")
+                    self.ctx.fail(err)
+                    raise err
+                st["contribs"][rank] = contrib
+                st["arrived"] += 1
+                if st["arrived"] == self.size:
+                    contribs = list(st["contribs"])
+                    t0 = _pv.monotonic() if sc is not None else 0.0
+                    try:
+                        if ufold:
+                            # safe for the same reason as in run(): this
+                            # round's slot can take no more deposits
+                            # (arrived == size) and waiters re-check
+                            # results only under the lock
+                            self.cond.release()
+                            try:
+                                results = list(combine(contribs))
+                            finally:
+                                self.cond.acquire()
+                        else:
+                            results = list(combine(contribs))
+                    except BaseException as e:
+                        self.ctx.fail(e)
+                        raise
+                    if sc is not None:
+                        sc.spans.append(("fold", t0, _pv.monotonic()))
+                    if len(results) != self.size:
+                        err = MPIError(
+                            f"combine for {opname} returned {len(results)} "
+                            f"results for {self.size} ranks")
+                        self.ctx.fail(err)
+                        raise err
+                    st["results"] = results
+                    st["contribs"] = []
+                    fold_pending = True
+                deposited.append((rnd, st, opname))
+            if fold_pending:
+                self.cond.notify_all()   # one wakeup for the whole batch
+            out = []
+            for rnd, st, opname in deposited:
+                if st["results"] is None:
+                    t0 = _pv.monotonic() if sc is not None else 0.0
+                    self._wait_for(lambda st=st: st["results"] is not None,
+                                   f"collective {opname}",
+                                   limit=collective_wait_limit(opname))
+                    if sc is not None:
+                        sc.spans.append(
+                            ("rendezvous", t0, _pv.monotonic()))
+                out.append(st["results"][rank])
+                st["picked"] += 1
+                if st["picked"] == self.size:
+                    self.rounds.pop(rnd, None)
+            return out
+        finally:
+            self.cond.release()
+
 
 class SpmdContext:
     """State shared by all ranks of one SPMD job (the "world").

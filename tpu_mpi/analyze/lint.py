@@ -14,7 +14,10 @@ cheap to prove from source alone — before any rank runs:
 - **L108** overlapping RMA accesses to one target inside one fence epoch;
 - **L109** persistent-request misuse: ``Start`` called twice without an
   intervening ``Wait``, the plan's buffer mutated between ``Start`` and
-  ``Wait``, or ``Start`` on a freed plan / freed communicator;
+  ``Wait``, ``Start`` on a freed plan / freed communicator, or — when the
+  unit literally sets ``TPU_MPI_AUTO_ARM_DONATE=1`` — in-place mutation
+  of an allocating ``Allreduce`` result (the auto-armed donated plan may
+  re-donate that buffer on a later round);
 - **L110** an operation on a communicator after ``Comm_revoke`` (with no
   intervening ``Comm_agree``) or on the parent after ``Comm_shrink``;
 - **L111** serve-session misuse: an RPC on a detached session, or a
@@ -157,6 +160,11 @@ class _Unit:
         # SessionComm var -> owning session var
         self._sessions: Dict[str, Optional[int]] = {}
         self._sess_comms: Dict[str, str] = {}
+        # L109 auto-arm lane: only armed by a literal
+        # os.environ["TPU_MPI_AUTO_ARM_DONATE"] = "1" in this unit;
+        # name -> line of the allocating Allreduce that produced it
+        self._auto_donate = False
+        self._auto_live: Dict[str, int] = {}
         self._epoch = 0
         self._lock_depth = 0
         self._scan(stmts, arm=(), cond=False)
@@ -234,8 +242,65 @@ class _Unit:
             self._isend_effects(st, call, name)
             self._persistent_effects(st, call, name)
             self._ft_effects(st, call, name)
+        self._auto_arm_effects(st)
         self._mutation_effects(st)
         self._assign_clears(st)
+
+    # -- L109 auto-arm bookkeeping: donated armed-result lifetime -----------
+
+    @staticmethod
+    def _is_environ(node) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id == "environ"
+        return (isinstance(node, ast.Attribute) and node.attr == "environ"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "os")
+
+    def _auto_arm_effects(self, st):
+        """Track the donate-knob gate and live donated-result names. The
+        gate only opens on a *literal* env assignment, so the rule is
+        structurally silent on the shipped tree (zero-FP contract)."""
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                if (isinstance(t, ast.Subscript) and self._is_environ(t.value)
+                        and isinstance(t.slice, ast.Constant)
+                        and t.slice.value == "TPU_MPI_AUTO_ARM_DONATE"):
+                    self._auto_donate = False
+            return
+        for call in ast.walk(st):
+            # os.environ.pop("TPU_MPI_AUTO_ARM_DONATE", ...) closes the gate
+            if (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "pop"
+                    and self._is_environ(call.func.value)
+                    and call.args and isinstance(call.args[0], ast.Constant)
+                    and call.args[0].value == "TPU_MPI_AUTO_ARM_DONATE"):
+                self._auto_donate = False
+        if not isinstance(st, ast.Assign) or len(st.targets) != 1:
+            return
+        t = st.targets[0]
+        if (isinstance(t, ast.Subscript) and self._is_environ(t.value)
+                and isinstance(t.slice, ast.Constant)
+                and t.slice.value == "TPU_MPI_AUTO_ARM_DONATE"
+                and isinstance(st.value, ast.Constant)):
+            self._auto_donate = str(st.value.value).strip().lower() \
+                not in ("", "0", "false", "no", "off")
+            return
+        target = self._assign_target(st)
+        if target is None:
+            return
+        v = st.value
+        if isinstance(v, ast.Call) and _call_name(v) == "Allreduce":
+            # allocating form: a result binding while the donate knob is
+            # set may alias the armed plan's donated ring slot
+            if self._auto_donate:
+                self._auto_live[target] = st.lineno
+            else:
+                self._auto_live.pop(target, None)
+        elif isinstance(v, ast.Name) and v.id in self._auto_live:
+            self._auto_live[target] = self._auto_live[v.id]
+        else:
+            self._auto_live.pop(target, None)
 
     # -- L106 bookkeeping (runs inline with the ordered scan) ---------------
 
@@ -461,6 +526,16 @@ class _Unit:
                             line, context=f"{p['kind']} at line "
                                           f"{p['init_line']}")
                 p["buf"] = None         # one diagnostic per plan
+        src = self._auto_live.pop(varname, None)
+        if src is not None:
+            self.L.diag("L109",
+                        f"result {varname!r} of the allocating Allreduce at "
+                        f"line {src} is mutated in place — with "
+                        f"TPU_MPI_AUTO_ARM_DONATE=1 the auto-armed plan may "
+                        f"re-donate this buffer on a later round; copy it "
+                        f"before writing",
+                        line,
+                        context="TPU_MPI_AUTO_ARM_DONATE=1 set in this unit")
 
     def _assign_clears(self, st):
         """Rebinding a tracked name retires whatever it pointed at."""

@@ -47,8 +47,9 @@ from .analyze import events as _ev
 from .error import CollectiveMismatchError, MPIError
 from .operators import Op, as_op
 from .overlap import (ChunkSchedule, CollectivePlan, PersistentCollRequest,
-                      PlanRegistration, plans as _plans, progress_begin,
-                      progress_note, registry as _registry)
+                      PlanRegistration, demote_fast_armed as _demote_fast_armed,
+                      plans as _plans, progress_begin, progress_note,
+                      registry as _registry)
 
 
 def _run(comm: Comm, contrib: Any, combine, opname: str, plan=None,
@@ -1056,6 +1057,160 @@ def _explore_reduce_variant(comm: Comm, cplan: CollectivePlan, op: Op,
                           cplan.schedule, cplan.generation, algo=algo)
 
 
+def _auto_arm_gate(comm, args, sendbuf, recvbuf, op, count, payload, alloc):
+    """ISSUE-11 tentpole (a): promote a repeated plain ``Allreduce``
+    signature onto the registered persistent path with zero API change.
+
+    Returns ``(runner, model)``. ``runner`` — when the signature's
+    consecutive-identical-call streak has crossed
+    ``config.auto_arm_threshold`` and a :class:`PlanRegistration` bound —
+    executes the whole armed round (rendezvous + copy-out) and the caller
+    returns its value directly; ``None`` means take the generic path.
+    ``model`` is non-None only under tracing with ``auto_arm_donate`` opted
+    in: traced runs always DEMOTE to the fully-evented legacy lane (bitwise
+    identical by construction), but the donation window the untraced run
+    would have had is modeled with synthetic Start/Wait events so the R302
+    pass can still flag a stale aliased result being fed back in — the
+    caller invokes ``model(out)`` with the allocating flavor's result.
+
+    Demotion is loud-free and total: trace arming, outstanding nonblocking
+    traffic, buffer-identity churn, shape/dtype churn on the lane
+    (``PlanCache.auto_note``), ``Comm.free`` (``plans.invalidate``), and
+    config reloads (generation check below) all push the signature back to
+    the generic star. Without ``auto_arm_donate`` the armed lane runs the
+    copy-out contract (``_register_allreduce(donate=False)``), so no user-
+    visible aliasing exists for R302 to worry about."""
+    from . import config
+    from ._runtime import current_env
+    cfg = config.load()
+    if not (cfg.auto_arm and cfg.registered_buffers):
+        return None, None
+    env = current_env()
+    if env is None:
+        return None, None
+    ctx, world_rank = env
+    cid = comm.cid
+    # per-rank key: the thread tier shares ONE PlanCache across rank
+    # threads, and each rank's streak/arming is its own
+    key = (cid, comm.rank(), "Allreduce", op, int(count),
+           str(getattr(payload, "dtype", None)), type(payload).__name__)
+    e = _plans.auto_note(key, sendbuf, recvbuf)
+    if e is None:
+        return None, None
+    threshold = max(int(cfg.auto_arm_threshold), 1)
+
+    if _ev.enabled():
+        if e.armed:
+            _plans.auto_demote(e)
+        if not (cfg.auto_arm_donate and alloc and e.streak >= threshold):
+            return None, None
+        # model the donated-result ring the untraced run would alias:
+        # round k's Start re-donates the slot under round k-2's result
+        rnd = e.rounds
+        e.rounds += 1
+        inval = None
+        for r, res in e.results:
+            if r == rnd - 2:
+                inval = _ev.buf_id(res)
+        _ev.record_start(comm, "pallreduce", id(e), rnd, invalidates=inval)
+
+        def model(out):
+            e.results.append((rnd, out))
+            _ev.record_wait(comm, "pallreduce", id(e), rnd, result=out)
+        return None, model
+
+    st = _nb_state(ctx, cid, world_rank, create=False)
+    if st is not None and st.outstanding:
+        # in-flight I* ops own the initiation order; stay generic (the
+        # generic path runs through the worker) and drop the armed round
+        if e.armed:
+            _plans.auto_demote(e)
+        return None, None
+
+    reg = e.reg
+    if reg is not None and (reg.released or reg.generation
+                            != config.GENERATION):
+        _plans.auto_demote(e)
+        reg = None
+    if reg is None:
+        if e.streak < threshold or e.ineligible_gen == config.GENERATION:
+            return None, None
+        reg = _register_allreduce(comm, args, donate=cfg.auto_arm_donate)
+        if reg is None or not reg.knob_on:
+            if reg is not None:
+                _registry.discard(reg)
+            e.ineligible_gen = config.GENERATION
+            return None, None
+        _plans.auto_bind(e, reg)
+
+    # publish the front door: the NEXT identical call dispatches from
+    # Allreduce() itself on one dict probe + identity compares, skipping
+    # argument parsing and this key construction (_auto_hot_run)
+    _plans.auto_hot_set((cid, key[1]),
+                        (args, e, sendbuf,
+                         getattr(sendbuf, "nbytes", None)))
+
+    def runner():
+        _plans.auto_hit(e)
+        # flush this thread's stacked fast-armed persistent rounds first
+        # so initiation order stays program order; the outstanding-work
+        # check _ordered_run would redo just happened above
+        if not getattr(_nb_worker_tls, "active", False):
+            _demote_fast_armed(cid)
+        return reg.run_round()
+    return runner, None
+
+
+_AUTO_MISS = object()
+
+
+def _auto_hot_run(args: tuple) -> Any:
+    """ISSUE-11 front door: dispatch a repeat of an already-armed plain
+    ``Allreduce`` straight to its registered round on one dict probe plus
+    per-element identity compares against the exact argument tuple that
+    armed — skipping argument parsing and signature-key construction, the
+    two per-call costs that kept the auto-armed lane measurably over the
+    hand-armed Start/Wait figure. Any mismatch — different argument
+    objects, tracing armed, a released or stale-generation registration,
+    outstanding nonblocking traffic, an in-place resize of the send
+    operand — returns ``_AUTO_MISS`` and the call falls through to
+    :func:`_reduce_family`, whose full gate owns every demotion edge."""
+    comm = args[-1]
+    if not isinstance(comm, Comm):
+        return _AUTO_MISS
+    try:
+        lane = (comm.cid, comm.rank())
+    except Exception:
+        return _AUTO_MISS               # not Init'd etc.: legacy error path
+    rec = _plans.auto_hot_get(lane)
+    if rec is None:
+        return _AUTO_MISS
+    pargs, e, send, nbytes = rec
+    if len(pargs) != len(args):
+        return _AUTO_MISS
+    for a, b in zip(pargs, args):
+        if a is not b:
+            return _AUTO_MISS
+    from . import config
+    reg = e.reg
+    if reg is None or reg.generation != config.GENERATION \
+            or getattr(send, "nbytes", None) != nbytes \
+            or not reg.armable():
+        return _AUTO_MISS
+    # stats stay truthful without the table lock: every field touched here
+    # is owned by this rank's thread (the signature key is per-(cid, rank))
+    # except the aggregate hit counter, which tolerates a lost update
+    e.calls += 1
+    e.streak += 1
+    e.hits += 1
+    _plans.auto_hits += 1
+    # same program-order rule as the gate's runner: stacked fast-armed
+    # persistent rounds on this thread initiate first
+    if not getattr(_nb_worker_tls, "active", False):
+        _demote_fast_armed(lane[0])
+    return reg.run_round()
+
+
 def _reduce_family(args, has_root: bool, mode: str, name: str) -> Any:
     sendbuf, recvbuf, count, op, root, comm, alloc = _parse_reduce_args(args, has_root, name)
     rank, size = comm.rank(), comm.size()
@@ -1081,6 +1236,19 @@ def _reduce_family(args, has_root: bool, mode: str, name: str) -> Any:
         payload = wire_view(sendbuf, count)
     else:
         payload = to_wire(sendbuf, count)
+
+    # auto-arm (ISSUE 11): a repeated same-signature plain Allreduce is
+    # promoted onto the registered persistent path; the armed runner skips
+    # plan lookup AND bandit exploration (auto-armed plans never explore —
+    # the explored variant would fork the call off its registered opname
+    # lockstep). Under tracing the gate only returns a trace model.
+    _model = None
+    if mode == "reduce" and not has_root and name == "Allreduce" \
+            and not scalar_in:
+        _runner, _model = _auto_arm_gate(comm, args, sendbuf, recvbuf, op,
+                                         count, payload, alloc)
+        if _runner is not None:
+            return _runner()
 
     cplan = _reduce_plan(comm, name, mode, op, count, payload)
     if mode == "reduce" and _tune_online.state() is not None:
@@ -1117,10 +1285,13 @@ def _reduce_family(args, has_root: bool, mode: str, name: str) -> Any:
                 return out.item() if out.ndim == 0 or out.size == 1 else out
             shaped = _shape_result(result, sendbuf, count)
             if sc is None:
-                return clone_like(sendbuf, shaped)
-            t0 = _pv.monotonic()
-            out = clone_like(sendbuf, shaped)
-            sc.spans.append(("copy", t0, _pv.monotonic()))
+                out = clone_like(sendbuf, shaped)
+            else:
+                t0 = _pv.monotonic()
+                out = clone_like(sendbuf, shaped)
+                sc.spans.append(("copy", t0, _pv.monotonic()))
+            if _model is not None:
+                _model(out)     # R302 donation-window model (auto-arm)
             return out
         target = sendbuf if inplace else recvbuf
         if sc is None:
@@ -1157,7 +1328,13 @@ def Reduce(*args) -> Any:
 def Allreduce(*args) -> Any:
     """``Allreduce(send, recv, [count,] op, comm)`` | ``Allreduce(IN_PLACE,
     buf, op, comm)`` | allocating ``Allreduce(send, op, comm)``
-    (src/collective.jl:691-738). Deterministic rank-ordered reduction."""
+    (src/collective.jl:691-738). Deterministic rank-ordered reduction. A
+    repeated identical call auto-arms onto the registered persistent path
+    (ISSUE-11) and repeat hits dispatch through the front door below."""
+    if len(args) >= 3:
+        out = _auto_hot_run(args)
+        if out is not _AUTO_MISS:
+            return out
     return _reduce_family(args, has_root=False, mode="reduce", name="Allreduce")
 
 
@@ -1530,7 +1707,8 @@ def _comm_of(args) -> Comm:
 # per-call setup entirely — the training-loop shape.
 # ---------------------------------------------------------------------------
 
-def _registered_device_fold(op: Op, count: int, dtype: Any, size: int):
+def _registered_device_fold(op: Op, count: int, dtype: Any, size: int,
+                            donate: bool = True):
     """The donated-accumulator fold executable for the registered device
     lane: ONE XLA computation compiled AOT at plan creation with
     ``donate_argnums`` on the accumulator, so every round's rank-ordered
@@ -1566,10 +1744,11 @@ def _registered_device_fold(op: Op, count: int, dtype: Any, size: int):
         return acc
 
     try:
-        donated = jax.jit(chain, donate_argnums=(0,)) \
-            .lower(sds, *([sds] * size)).compile()
         plain = jax.jit(plain_fold).lower(*([sds] * size)).compile()
-        ring = [jnp.zeros((count,), dt), jnp.zeros((count,), dt)]
+        if donate:
+            donated = jax.jit(chain, donate_argnums=(0,)) \
+                .lower(sds, *([sds] * size)).compile()
+            ring = [jnp.zeros((count,), dt), jnp.zeros((count,), dt)]
     except Exception:
         return None                 # host-only / untraceable op: no lane
     from .buffers import is_jax_array as _isjax
@@ -1583,6 +1762,12 @@ def _registered_device_fold(op: Op, count: int, dtype: Any, size: int):
             _isjax(c) and tuple(c.shape) == (count,) and c.dtype == dt
             for c in cs)
         if good:
+            if not donate:
+                # copy-out contract (auto-armed lane): the AOT chain still
+                # skips per-round trace/lower work, but every round's output
+                # is a fresh array — no slot is ever re-donated under a
+                # result the user may still hold (the R302 hazard).
+                return [plain(*cs)] * n
             slot = ring[k & 1]
             # an operand aliasing the accumulator (a rank fed a previous
             # result straight back) can't be donated over — fold fresh
@@ -1598,10 +1783,19 @@ def _registered_device_fold(op: Op, count: int, dtype: Any, size: int):
     return combine
 
 
-def _register_allreduce(comm: Comm, args) -> Optional[PlanRegistration]:
+def _register_allreduce(comm: Comm, args,
+                        donate: bool = True) -> Optional[PlanRegistration]:
     """Build the registered-buffer fast path of one ``Allreduce_init``
     signature (the ISSUE-6 tentpole), or None when the operands are not
     eligible (every round then takes the generic worker path).
+
+    ``donate=False`` selects the auto-arm copy-out contract (ISSUE 11):
+    the allocating flavor returns a FRESH array every round instead of the
+    plan-private registered result, and the device lane compiles only the
+    non-donated fold — bitwise identical to the generic path with none of
+    the R302 donated-reuse hazard, at the cost of one output copy.
+    Hand-armed ``Allreduce_init`` callers keep ``donate=True`` (documented
+    persistent in-place result semantics).
 
     Everything a round needs is resolved and PINNED here, at plan-creation
     time:
@@ -1706,11 +1900,18 @@ def _register_allreduce(comm: Comm, args) -> Optional[PlanRegistration]:
                 if int(np.prod(shape, dtype=np.int64)) == count else out
             scratch = (acc, out)
 
-            def copyout(res):
-                if res is not out:
-                    np.copyto(out, np.asarray(res).reshape(-1),
-                              casting="unsafe")
-                return ret
+            if donate:
+                def copyout(res):
+                    if res is not out:
+                        np.copyto(out, np.asarray(res).reshape(-1),
+                                  casting="unsafe")
+                    return ret
+            else:
+                def copyout(res):
+                    if res is not out:
+                        np.copyto(out, np.asarray(res).reshape(-1),
+                                  casting="unsafe")
+                    return np.array(ret, copy=True)
         else:
             tgt = sendbuf if inplace else recvbuf
             tgtview = sendview if inplace else pinned_wire_view(tgt, count)
@@ -1728,7 +1929,8 @@ def _register_allreduce(comm: Comm, args) -> Optional[PlanRegistration]:
         # ---- device lane: donated-accumulator fold, thread tier only ----
         payload = to_wire(sendbuf, count)
         cplan = _reduce_plan(comm, "Allreduce", "reduce", op, count, payload)
-        combine = _registered_device_fold(op, count, payload.dtype, size)
+        combine = _registered_device_fold(op, count, payload.dtype, size,
+                                          donate=donate)
         if combine is None:
             return None
         contrib = lambda: to_wire(sendbuf, count)   # rebind-aware snapshot
@@ -1794,10 +1996,25 @@ def _register_allreduce(comm: Comm, args) -> Optional[PlanRegistration]:
                 _pv.op_end(sc, comm, coll="allreduce", algo=sig.get("algo"),
                            dtype=sig.get("dtype"), nbytes=pv_nbytes)
 
+    # batched-submission hook (ISSUE 11): the pieces Waitall needs to
+    # deposit K armed rounds through ONE rendezvous wakeup on the thread
+    # tier (CollectiveChannel.run_batch). Proc-tier batching happens a
+    # layer down (framed "batchv" coalescing in ProcChannel), so only the
+    # thread tier publishes the parts.
+    round_parts = None
+    if thread_tier:
+        round_parts = {
+            "channel": channel, "rank": rank, "contrib": contrib,
+            "combine": combine, "opname": opname, "hint": hint,
+            "runkw": runkw, "copyout": copyout, "comm": comm,
+            "sig": sig, "pv_nbytes": pv_nbytes,
+        }
+
     return _registry.add(PlanRegistration(
         cid, config.GENERATION, run_round, scratch=scratch, wire=sendview,
         shm_release=shm_release, knob_on=True, nb_probe=nb_probe,
-        inplace_optin=bool(inplace or alloc)))
+        inplace_optin=bool(inplace or (alloc and donate)),
+        round_parts=round_parts))
 
 def _persistent_round(req: PersistentCollRequest, fn):
     """Run one legacy-lane persistent round on the worker thread, tagging

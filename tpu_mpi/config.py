@@ -141,6 +141,32 @@ class Config:
     # each round allocation-free on the calling thread; off = every round
     # takes the generic per-call path (parse, plan lookup, worker hop).
     registered_buffers: bool = True
+    # auto-arming (docs/performance.md "Auto-arming"): plain repeated
+    # same-signature collectives (the training-loop `comm.Allreduce(x)`
+    # case) are transparently promoted onto the registered persistent path
+    # after `auto_arm_threshold` identical calls — no `Allreduce_init`
+    # required. Results keep copy-out semantics (bitwise-identical to the
+    # generic path, never aliased). Off = only hand-armed persistent
+    # requests take the registered path.
+    auto_arm: bool = True
+    # consecutive identical calls (same comm, op, buffer objects, count,
+    # dtype) before a signature auto-arms.
+    auto_arm_threshold: int = 4
+    # explicit donation opt-in for the AUTO-armed lane: allocating-flavor
+    # results are handed out as the registered fold slot itself (zero
+    # copy-out) — round k's result is re-donated by round k+2, so holding
+    # a result across two later calls reads in-flight data (the R302
+    # hazard the race detector models). Off (default) = copy-out.
+    auto_arm_donate: bool = False
+    # batched submission (docs/performance.md "Batched submission"): max
+    # queued ops (chunk frames of one collective, or a Waitall run of
+    # armed persistent rounds) coalesced into ONE rendezvous round trip —
+    # one writev scatter-gather frame on the native transport, one
+    # condvar wakeup on the thread tier. <=1 disables coalescing.
+    batch_max_ops: int = 16
+    # byte budget per coalesced flush: a batch frame closes early once its
+    # payloads reach this size. 0 = no byte cap (count cap only).
+    batch_max_bytes: int = 1 << 22
     # performance-variable (pvar) collection level (docs/observability.md):
     # 0 disables every counter (one branch per op remains), 1 collects.
     # Pcontrol(level) overrides this at runtime without a config reload.
@@ -215,6 +241,11 @@ _ENV_MAP = {
     "tune_shim": "TPU_MPI_TUNE_SHIM",
     "coll_shm_max_bytes": "TPU_MPI_COLL_SHM_MAX_BYTES",
     "registered_buffers": "TPU_MPI_REGISTERED_BUFFERS",
+    "auto_arm": "TPU_MPI_AUTO_ARM",
+    "auto_arm_threshold": "TPU_MPI_AUTO_ARM_THRESHOLD",
+    "auto_arm_donate": "TPU_MPI_AUTO_ARM_DONATE",
+    "batch_max_ops": "TPU_MPI_BATCH_MAX_OPS",
+    "batch_max_bytes": "TPU_MPI_BATCH_MAX_BYTES",
     "pvars": "TPU_MPI_PVARS",
     "pvars_dump": "TPU_MPI_PVARS_DUMP",
     "pvars_hist_bins": "TPU_MPI_PVARS_HIST_BINS",

@@ -103,21 +103,68 @@ class CollectivePlan:
         self.algo = algo
 
 
+class AutoArmEntry:
+    """Auto-arm state of ONE repeated collective signature (ISSUE-11
+    tentpole): the consecutive-identical-call streak, the buffer
+    identities it was counted against, and — once the streak crosses
+    ``config.auto_arm_threshold`` — the bound :class:`PlanRegistration`
+    whose ``run_round`` the plain call is promoted onto. Owned by
+    :class:`PlanCache`; demotion drops the registration (releasing its
+    pinned scratch and any shm slot lease) but keeps counting, so the
+    signature re-arms after another full streak."""
+
+    __slots__ = ("key", "streak", "calls", "send", "recv", "reg", "hits",
+                 "demotions", "rounds", "results", "ineligible_gen")
+
+    def __init__(self, key: Any):
+        self.key = key
+        self.streak = 0         # consecutive calls with identical buffers
+        self.calls = 0          # every call noted against this signature
+        self.send = _NO_BUF     # buffer identities of the current streak
+        self.recv = _NO_BUF
+        self.reg = None         # live PlanRegistration once armed
+        self.hits = 0           # rounds run on the armed fast path
+        self.demotions = 0
+        self.rounds = 0         # armed-round ordinal (R302 trace model)
+        self.results = deque(maxlen=4)   # recent result refs (id keep-alive)
+        self.ineligible_gen = None  # registration factory said no (per gen)
+
+    @property
+    def armed(self) -> bool:
+        return self.reg is not None
+
+
+_NO_BUF = object()   # "no buffer seen yet" sentinel (None is a real value)
+
+
 class PlanCache:
     """Bounded LRU of :class:`CollectivePlan` keyed on the collective's
     full call signature: (cid, family, op identity, count, dtype, array
     kind, flavor). Entries from a stale ``config.GENERATION`` miss (the
     pipeline knobs feed the schedule), and :meth:`invalidate` drops a
     freed communicator's plans. Unhashable keys (an unhashable custom op)
-    simply never cache."""
+    simply never cache.
+
+    Also owns the **auto-arm table** (ISSUE-11): per-signature
+    :class:`AutoArmEntry` records counting repeated identical plain
+    collective calls toward transparent promotion onto the registered
+    persistent path, plus the aggregate armed/demoted/hit counters that
+    ``stats()`` (and ``tpurun --stats`` / the serve broker) report."""
 
     CAP = 128
+    AUTO_CAP = 32
 
     def __init__(self):
         self._lock = threading.Lock()
         self._plans: "OrderedDict[Any, CollectivePlan]" = OrderedDict()
         self.hits = 0
         self.misses = 0
+        self._auto: "OrderedDict[Any, AutoArmEntry]" = OrderedDict()
+        self._auto_last: dict = {}      # (cid, rank) -> last signature seen
+        self._auto_hot: dict = {}       # (cid, rank) -> front-door record
+        self.auto_arms = 0
+        self.auto_demotions = 0
+        self.auto_hits = 0
 
     def get(self, key: Any) -> Optional[CollectivePlan]:
         from . import config
@@ -147,20 +194,143 @@ class PlanCache:
             while len(self._plans) > self.CAP:
                 self._plans.popitem(last=False)
 
+    # -- auto-arm table (ISSUE-11) ------------------------------------------
+
+    def auto_note(self, key: Any, send: Any, recv: Any) -> \
+            Optional[AutoArmEntry]:
+        """Advance the identity streak of one signature and return its
+        entry. A call with DIFFERENT buffer objects than the previous one
+        resets the streak (and demotes a live registration — fresh-array
+        loops never arm, object churn demotes loud-free); ``None`` when the
+        key is unhashable."""
+        try:
+            hash(key)
+        except TypeError:
+            return None
+        with self._lock:
+            # shape/dtype churn on the same (cid, rank) lane demotes the
+            # previously-armed signature: a loop whose operand geometry
+            # changed is no longer the loop that armed, and its pinned
+            # scratch must not linger
+            lane = (key[0], key[1]) if isinstance(key, tuple) \
+                and len(key) >= 2 else key
+            prev = self._auto_last.get(lane)
+            if prev is not None and prev != key:
+                pe = self._auto.get(prev)
+                if pe is not None:
+                    self._auto_demote_locked(pe)
+                    pe.streak = 0
+            self._auto_last[lane] = key
+            e = self._auto.get(key)
+            if e is None:
+                e = self._auto[key] = AutoArmEntry(key)
+                while len(self._auto) > self.AUTO_CAP:
+                    _, old = self._auto.popitem(last=False)
+                    self._auto_demote_locked(old)
+            else:
+                self._auto.move_to_end(key)
+            if e.send is not send or e.recv is not recv:
+                self._auto_demote_locked(e)
+                e.streak = 0
+                e.send, e.recv = send, recv
+                e.ineligible_gen = None
+            e.streak += 1
+            e.calls += 1
+            return e
+
+    def auto_hot_get(self, lane: Any):
+        """Front-door record of one (cid, rank) lane, or None. Lock-free:
+        a single dict probe under the GIL — the caller re-validates the
+        registration (released/generation) before trusting it, so a racing
+        demotion at worst costs one fall-through to the full gate."""
+        return self._auto_hot.get(lane)
+
+    def auto_hot_set(self, lane: Any, rec: tuple) -> None:
+        """Publish the armed front-door record for a lane (the exact
+        argument tuple of the call that just ran armed, its entry, and the
+        send operand's byte size as an in-place-resize tripwire)."""
+        self._auto_hot[lane] = rec
+
+    def auto_bind(self, e: AutoArmEntry, reg: Any) -> None:
+        """Attach a freshly-built registration to an entry (arm event)."""
+        with self._lock:
+            if e.reg is not None:
+                self._auto_demote_locked(e)
+            e.reg = reg
+            e.rounds = 0
+            self.auto_arms += 1
+
+    def auto_hit(self, e: AutoArmEntry) -> None:
+        with self._lock:
+            e.hits += 1
+            self.auto_hits += 1
+
+    def auto_demote(self, e: AutoArmEntry) -> None:
+        """Drop an entry's registration (trace arming, nonblocking traffic,
+        identity churn, config reload, LRU pressure). Counting continues —
+        the signature re-arms after another full streak."""
+        with self._lock:
+            self._auto_demote_locked(e)
+
+    def _auto_demote_locked(self, e: AutoArmEntry) -> None:
+        reg, e.reg = e.reg, None
+        if reg is None:
+            return
+        # the front-door record holds strong refs to the armed call's
+        # buffers; drop it with the registration so demotion releases them
+        if isinstance(e.key, tuple) and len(e.key) >= 2:
+            self._auto_hot.pop((e.key[0], e.key[1]), None)
+        e.demotions += 1
+        self.auto_demotions += 1
+        try:
+            registry.discard(reg)
+        except Exception:
+            pass
+
     def invalidate(self, cid: Any = None) -> None:
         """Drop every plan (no args) or one communicator's plans
-        (``Comm.free``)."""
+        (``Comm.free``). Auto-arm entries of the communicator are demoted
+        and dropped too (their registrations release pinned scratch and
+        shm slot leases)."""
         with self._lock:
             if cid is None:
                 self._plans.clear()
+                for e in self._auto.values():
+                    self._auto_demote_locked(e)
+                self._auto.clear()
+                self._auto_last.clear()
+                self._auto_hot.clear()
                 return
             for k in [k for k in self._plans if k[0] == cid]:
                 del self._plans[k]
+            for k in [k for k in self._auto if k[0] == cid]:
+                self._auto_demote_locked(self._auto.pop(k))
+            for lane in [ln for ln in self._auto_last
+                         if isinstance(ln, tuple) and ln[0] == cid]:
+                del self._auto_last[lane]
+            for lane in [ln for ln in self._auto_hot if ln[0] == cid]:
+                del self._auto_hot[lane]
 
     def stats(self) -> dict:
         with self._lock:
+            sigs = {}
+            for k, e in self._auto.items():
+                label = "/".join(str(p) for p in k)
+                sigs[label] = {
+                    "calls": e.calls, "streak": e.streak,
+                    "armed": e.reg is not None, "hits": e.hits,
+                    "demotions": e.demotions,
+                    "hit_rate": (e.hits / e.calls) if e.calls else 0.0,
+                }
             return {"entries": len(self._plans), "hits": self.hits,
-                    "misses": self.misses}
+                    "misses": self.misses,
+                    "auto": {"tracked": len(self._auto),
+                             "armed": sum(1 for e in self._auto.values()
+                                          if e.reg is not None),
+                             "arms": self.auto_arms,
+                             "demotions": self.auto_demotions,
+                             "hits": self.auto_hits,
+                             "signatures": sigs}}
 
 
 #: The process-wide plan cache. ``Comm.free`` invalidates per-cid; config
@@ -231,13 +401,13 @@ class PlanRegistration:
 
     __slots__ = ("cid", "generation", "scratch", "wire", "run_round",
                  "shm_release", "released", "knob_on", "_nb_probe",
-                 "inplace_optin")
+                 "inplace_optin", "round_parts")
 
     def __init__(self, cid: int, generation: int, run_round: Callable[[], Any],
                  scratch: tuple = (), wire: Any = None,
                  shm_release: Optional[Callable[[], None]] = None,
                  knob_on: bool = True, nb_probe: Optional[Callable] = None,
-                 inplace_optin: bool = False):
+                 inplace_optin: bool = False, round_parts: Any = None):
         self.cid = cid
         self.generation = generation
         self.run_round = run_round
@@ -248,6 +418,12 @@ class PlanRegistration:
         self.knob_on = knob_on
         self._nb_probe = nb_probe       # () -> outstanding nb ops on the comm
         self.inplace_optin = inplace_optin
+        # batched-submission hook (ISSUE-11): the round's split pieces
+        # (channel, rank, contrib, combine, opname, runkw, copyout, …) so a
+        # Waitall over several armed rounds can deposit them all through ONE
+        # thread-tier rendezvous (CollectiveChannel.run_batch). None on the
+        # multi-process tier and for registrations that predate the split.
+        self.round_parts = round_parts
 
     def armable(self) -> bool:
         """Whether a Start may take the fast path right now: the knob is on,
@@ -268,6 +444,7 @@ class PlanRegistration:
         self.released = True
         self.scratch = ()
         self.wire = None
+        self.round_parts = None
         rel, self.shm_release = self.shm_release, None
         if rel is not None:
             rel()
@@ -297,6 +474,18 @@ class BufferRegistry:
         for reg in regs:
             reg.release()
         return len(regs)
+
+    def discard(self, reg: PlanRegistration) -> None:
+        """Release ONE registration and drop it from the ledger (auto-arm
+        demotion — the comm stays alive, only this plan's pinned buffers
+        and shm lease go)."""
+        with self._lock:
+            lst = self._by_cid.get(reg.cid)
+            if lst is not None and reg in lst:
+                lst.remove(reg)
+                if not lst:
+                    del self._by_cid[reg.cid]
+        reg.release()
 
     def leased(self, cid: Any = None) -> int:
         """Outstanding shm slot leases (one comm, or all) — the strict-mode
@@ -345,6 +534,114 @@ def demote_fast_armed(cid: Any = None) -> None:
     for c in cids:
         for req in list(armed.get(c, ())):
             req._demote()
+
+
+def flush_fast_armed(cid: Any, upto: Any = None) -> None:
+    """Complete fast-armed rounds of one comm on THIS thread, in Start
+    order, stopping after ``upto`` (a :class:`PersistentCollRequest`) or
+    draining the whole stack. Runs of 2+ rounds whose registrations carry
+    ``round_parts`` go through batched rendezvous submission
+    (``CollectiveChannel.run_batch``) — K rounds deposit through ONE
+    channel lock acquisition and ONE wakeup (ISSUE-11 tentpole (b)) —
+    chunked by ``config.batch_max_ops`` / ``config.batch_max_bytes``.
+    Each completed request gets its ``result``/``status`` set exactly as
+    an inline fast-armed ``wait`` would."""
+    lst = _armed_list(cid)
+    if not lst:
+        return
+    run = []
+    for r in lst:
+        run.append(r)
+        if upto is not None and r is upto:
+            break
+    from . import config
+    cfg = config.load()
+    cap = max(int(cfg.batch_max_ops), 1)
+    max_bytes = int(cfg.batch_max_bytes)
+    i = 0
+    while i < len(run):
+        group = [run[i]]
+        nbytes = int((run[i]._reg.round_parts or {}).get("pv_nbytes") or 0) \
+            if run[i]._reg is not None and run[i]._reg.round_parts else 0
+        i += 1
+        while i < len(run) and len(group) < cap:
+            reg = run[i]._reg
+            if reg is None or reg.round_parts is None \
+                    or (group[0]._reg is None
+                        or group[0]._reg.round_parts is None):
+                break
+            b = int(reg.round_parts.get("pv_nbytes") or 0)
+            if max_bytes > 0 and nbytes + b > max_bytes:
+                break
+            group.append(run[i])
+            nbytes += b
+            i += 1
+        _flush_group(cid, group)
+
+
+def _flush_group(cid: Any, group: list) -> None:
+    from .pointtopoint import STATUS_EMPTY
+    lst = _armed_list(cid)
+    for r in group:
+        r._fast_armed = False
+        if r in lst:
+            lst.remove(r)
+    if len(group) == 1 or any(r._reg is None or r._reg.round_parts is None
+                              for r in group):
+        # no batch lane: inline rounds in Start order (the pre-batching
+        # fast-armed wait), each its own rendezvous
+        for r in group:
+            r.result = r._reg.run_round()
+            r.status = STATUS_EMPTY
+            r._trace_complete()
+        return
+    from . import perfvars as _pv
+    parts = [r._reg.round_parts for r in group]
+    channel = parts[0]["channel"]
+    rank = parts[0]["rank"]
+    ops = [(p["contrib"](), p["combine"], p["opname"],
+            bool(p["runkw"].get("unlocked_fold"))) for p in parts]
+    sc = _pv.op_begin() if _pv.enabled() else None
+    try:
+        results = channel.run_batch(rank, ops)
+        for r, p, res in zip(group, parts, results):
+            if sc is None:
+                r.result = p["copyout"](res)
+            else:
+                t0 = _pv.monotonic()
+                r.result = p["copyout"](res)
+                sc.spans.append(("copy", t0, _pv.monotonic()))
+            r.status = STATUS_EMPTY
+            r._trace_complete()
+    finally:
+        _pv.note_batch(cid, len(group))
+        if sc is not None:
+            p0 = parts[0]
+            sig = p0["sig"]
+            _pv.op_end(sc, p0["comm"], coll="allreduce",
+                       algo=sig.get("algo"), dtype=sig.get("dtype"),
+                       nbytes=sum(int(p.get("pv_nbytes") or 0)
+                                  for p in parts))
+
+
+def waitall_flush(reqs) -> None:
+    """Batch-complete every fast-armed persistent round in ``reqs``
+    (``Waitall``'s ISSUE-11 hook): per comm, flush the armed stack in
+    Start order up to the DEEPEST member of ``reqs``, so the whole run
+    submits through one rendezvous wakeup regardless of the order the
+    caller listed the requests in."""
+    by_cid: dict = {}
+    for r in reqs:
+        if isinstance(r, PersistentCollRequest) and r._fast_armed \
+                and r._reg is not None:
+            by_cid.setdefault(r._reg.cid, set()).add(id(r))
+    for cid, ids in by_cid.items():
+        deepest = None
+        for r in _armed_list(cid):
+            if id(r) in ids:
+                deepest = r
+        if deepest is not None:
+            flush_fast_armed(cid, upto=deepest)
 
 
 class PersistentCollRequest:
@@ -425,10 +722,24 @@ class PersistentCollRequest:
         if reg is not None and reg.armable():
             lst = _armed_list(reg.cid)
             if lst:
-                # a second Start on the same comm: demote the earlier armed
-                # rounds to the worker (initiation order = Start order);
-                # the worker is then busy, so this round goes legacy too
-                demote_fast_armed(reg.cid)
+                # earlier armed rounds on this comm. When every round —
+                # theirs and ours — carries the batched-submission parts
+                # (thread tier) and the stack is under the batch cap, STACK
+                # instead of demoting: Wait/Waitall completes the stack in
+                # Start order through one rendezvous wakeup
+                # (flush_fast_armed -> CollectiveChannel.run_batch,
+                # ISSUE-11). Otherwise demote the earlier armed rounds to
+                # the worker (initiation order = Start order); the worker
+                # is then busy, so this round goes legacy too.
+                from . import config
+                cap = int(config.load().batch_max_ops)
+                stackable = (cap > 1 and len(lst) < cap
+                             and reg.round_parts is not None
+                             and all(r._reg is not None
+                                     and r._reg.round_parts is not None
+                                     for r in lst))
+                if not stackable:
+                    demote_fast_armed(reg.cid)
             if reg.armable():
                 self._fast_armed = True
                 _armed_list(reg.cid).append(self)
@@ -458,8 +769,12 @@ class PersistentCollRequest:
 
     def test(self) -> bool:
         if self._fast_armed:
-            # Test must not block: hand the round to the worker and poll it
-            self._demote()
+            # Test must not block: hand the round to the worker and poll
+            # it. Demote the comm's WHOLE armed stack — initiation order
+            # is Start order, so earlier stacked rounds must reach the
+            # worker before (and later ones may not stay deferred behind)
+            # this one.
+            demote_fast_armed(self._reg.cid)
         if self._inner is None:
             return True
         done = self._inner.test()
@@ -470,13 +785,9 @@ class PersistentCollRequest:
     def wait(self):
         from .pointtopoint import STATUS_EMPTY
         if self._fast_armed:
-            self._fast_armed = False
-            lst = _armed_list(self._reg.cid)
-            if self in lst:
-                lst.remove(self)
-            self.result = self._reg.run_round()
-            self.status = STATUS_EMPTY
-            self._trace_complete()
+            # completes every armed round up to ours in Start order —
+            # batched through one rendezvous wakeup when stacked
+            flush_fast_armed(self._reg.cid, upto=self)
             return self.status
         if self._inner is None:
             return self.status or STATUS_EMPTY

@@ -117,7 +117,8 @@ class CommPvars:
                  "recvs", "wait_ns", "ops", "times", "phase_ns", "rma",
                  "hist", "pipe_ops", "pipe_chunks", "pipe_fold_ns",
                  "pipe_wait_ns", "explore_calls", "explore_explored",
-                 "table_swaps", "last_swap_gen")
+                 "table_swaps", "last_swap_gen", "batch_flushes",
+                 "batch_ops")
 
     def __init__(self, rank: int, cid: int):
         self.rank = rank
@@ -149,6 +150,10 @@ class CommPvars:
         self.explore_explored = 0
         self.table_swaps = 0
         self.last_swap_gen = 0
+        # batched rendezvous submission (ISSUE-11): flushes and the ops
+        # they carried — occupancy = ops / flushes
+        self.batch_flushes = 0
+        self.batch_ops = 0
 
     def snapshot(self) -> dict:
         bins = max(4, int(config.load().pvars_hist_bins))
@@ -185,6 +190,12 @@ class CommPvars:
                              if self.explore_calls else None),
                 "table_swaps": self.table_swaps,
                 "last_swap_gen": self.last_swap_gen,
+            },
+            "batch": {
+                "flushes": self.batch_flushes,
+                "ops": self.batch_ops,
+                "occupancy": (round(self.batch_ops / self.batch_flushes, 4)
+                              if self.batch_flushes else None),
             },
         }
 
@@ -441,6 +452,17 @@ def note_pipelined(cid: int, nchunks: int, fold_ns: int,
         acct.pipe_chunks += int(nchunks)
         acct.pipe_fold_ns += int(fold_ns)
         acct.pipe_wait_ns += int(wait_after_first_ns)
+
+
+def note_batch(cid: int, nops: int) -> None:
+    """One batched-submission flush on this comm (ISSUE-11): ``nops``
+    queued ops went through one rendezvous round trip."""
+    acct = _acct(cid=cid)
+    if acct is None:
+        return
+    with _store_lock:
+        acct.batch_flushes += 1
+        acct.batch_ops += int(nops)
 
 
 def note_explore(comm: Any, explored: bool) -> None:

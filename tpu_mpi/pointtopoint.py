@@ -574,7 +574,12 @@ def Test(req: Request):
 
 
 def Waitall(reqs: Sequence[Request]) -> list[Status]:
-    """Block until all complete (ref ``Waitall!`` :453-471)."""
+    """Block until all complete (ref ``Waitall!`` :453-471). A run of
+    fast-armed persistent collective rounds completes through batched
+    rendezvous submission first — one channel wakeup for the whole run
+    (``overlap.waitall_flush``) — before the per-request waits."""
+    from .overlap import waitall_flush
+    waitall_flush(reqs)
     return [r.wait() for r in reqs]
 
 

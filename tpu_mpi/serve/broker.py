@@ -689,9 +689,11 @@ class Broker:
         totals = self.flush_ledger()
         with self._lease_lock:
             live = sorted(self._leases)
+        from ..overlap import plans
         return {"address": self.address, "pool": self.pool.info(),
                 "tenants_attached": live, "totals": totals,
-                "ledger": self.ledger.report(), "queue": self.fq.stats()}
+                "ledger": self.ledger.report(), "queue": self.fq.stats(),
+                "plan_cache": plans.stats()}
 
 
 # -- tpurun --serve CLI -------------------------------------------------------

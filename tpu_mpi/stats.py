@@ -34,6 +34,9 @@ def aggregate(records: Sequence[dict]) -> dict:
            "wait_s": 0.0}
     pipe = {"ops": 0, "chunks": 0, "fold_s": 0.0, "wait_after_first_s": 0.0}
     plan = {"hits": 0, "misses": 0}
+    auto = {"tracked": 0, "armed": 0, "arms": 0, "demotions": 0, "hits": 0,
+            "signatures": {}}
+    batch = {"flushes": 0, "ops": 0}
     explore = {"calls": 0, "explored": 0, "table_swaps": 0,
                "last_swap_gen": 0}
     arm_counts: Dict[Tuple[str, str], int] = {}
@@ -42,6 +45,19 @@ def aggregate(records: Sequence[dict]) -> dict:
         pc = rec.get("plan_cache") or {}
         plan["hits"] += int(pc.get("hits", 0))
         plan["misses"] += int(pc.get("misses", 0))
+        au = pc.get("auto") or {}
+        for k in ("tracked", "armed", "arms", "demotions", "hits"):
+            auto[k] += int(au.get(k, 0))
+        for label, sig in (au.get("signatures") or {}).items():
+            ent = auto["signatures"].setdefault(
+                label, {"calls": 0, "hits": 0, "demotions": 0,
+                        "armed": False})
+            ent["calls"] += int(sig.get("calls", 0))
+            ent["hits"] += int(sig.get("hits", 0))
+            ent["demotions"] += int(sig.get("demotions", 0))
+            ent["armed"] = ent["armed"] or bool(sig.get("armed"))
+            ent["hit_rate"] = (round(ent["hits"] / ent["calls"], 4)
+                               if ent["calls"] else None)
         for comm in rec.get("comms", ()):
             nranks.add(int(comm.get("size") or 0))
             for k in ("bytes_sent", "bytes_recv", "sends", "recvs", "wait_s"):
@@ -53,6 +69,9 @@ def aggregate(records: Sequence[dict]) -> dict:
             pl = comm.get("pipeline") or {}
             for k in pipe:
                 pipe[k] += pl.get(k, 0)
+            ba = comm.get("batch") or {}
+            batch["flushes"] += int(ba.get("flushes") or 0)
+            batch["ops"] += int(ba.get("ops") or 0)
             ex = comm.get("explore") or {}
             explore["calls"] += int(ex.get("calls") or 0)
             explore["explored"] += int(ex.get("explored") or 0)
@@ -81,7 +100,11 @@ def aggregate(records: Sequence[dict]) -> dict:
     return {
         "nranks": sorted(n for n in nranks if n),
         "colls": colls, "hist": hist, "phase_s": phase, "rma": rma,
-        "totals": tot, "plan_cache": plan, "pipeline": pipe,
+        "totals": tot, "plan_cache": plan, "auto_arm": auto,
+        "batch": {**batch,
+                  "occupancy": (round(batch["ops"] / batch["flushes"], 4)
+                                if batch["flushes"] else None)},
+        "pipeline": pipe,
         "overlap_fraction": (round(pipe["fold_s"] / busy, 4) if busy
                              else None),
         "explore": explore,
@@ -152,6 +175,20 @@ def render(agg: dict, out=None) -> None:
     if lk:
         w(f"plan cache: {pc['hits']}/{lk} hits "
           f"({pc['hits'] / lk * 100:.0f}%)\n")
+    au = agg.get("auto_arm") or {}
+    if au.get("arms") or au.get("tracked"):
+        w(f"auto-arm: {au['armed']} armed / {au['tracked']} tracked "
+          f"signatures, {au['arms']} arms, {au['demotions']} demotions, "
+          f"{au['hits']} armed-path hits\n")
+        for label, sig in sorted(au.get("signatures", {}).items()):
+            hr = sig.get("hit_rate")
+            w(f"  {label}: {sig['calls']} calls, {sig['hits']} hits"
+              + (f" ({hr:.0%})" if hr is not None else "")
+              + (", armed" if sig.get("armed") else "") + "\n")
+    ba = agg.get("batch") or {}
+    if ba.get("flushes"):
+        w(f"batched submission: {ba['ops']} ops / {ba['flushes']} flushes "
+          f"(occupancy {ba['occupancy']:.2f})\n")
     rma = agg["rma"]
     if any(rma.values()):
         w(f"rma epochs: {rma['fence']} fences, {rma['lock']} locks, "
@@ -229,7 +266,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          for (c, a, b), v in sorted(agg["colls"].items())],
                "hist": agg["hist"], "phase_s": agg["phase_s"],
                "totals": agg["totals"], "rma": agg["rma"],
-               "plan_cache": agg["plan_cache"], "pipeline": agg["pipeline"],
+               "plan_cache": agg["plan_cache"], "auto_arm": agg["auto_arm"],
+               "batch": agg["batch"], "pipeline": agg["pipeline"],
                "overlap_fraction": agg["overlap_fraction"],
                "explore": agg["explore"],
                "explore_fraction": agg["explore_fraction"],

@@ -36,7 +36,16 @@ preserve that. Three pieces do:
 
 Registered-buffer persistent plans (``Allreduce_init`` rounds) bypass the
 per-call decision point by design and therefore never explore; they pick
-up a swapped table at their next generation rebind.
+up a swapped table at their next generation rebind. AUTO-ARMED plans
+(ISSUE 11: a plain ``Allreduce`` loop promoted onto the registered path
+by ``collective._auto_arm_gate``) inherit the same rule structurally —
+the armed runner returns before ``_explore_reduce_variant`` is ever
+consulted. Lockstep survives the combination: arming is a deterministic
+function of the per-rank call stream (identical across ranks in an SPMD
+program), so every rank stops reaching the decision point at the same
+call, and under tracing every rank demotes together (trace enablement is
+config-global), keeping per-call ``Event.algo`` sequences rank-identical
+with auto-arm and ``TPU_MPI_TUNE_EXPLORE`` both on.
 
 The fleet angle — ``python -m tpu_mpi.tune merge`` folding per-rank pvar
 dumps and measured tables into one shared database ``select()`` loads —
