@@ -1,0 +1,186 @@
+"""Latency-SLO sweep for the continuous-batching inference engine.
+
+Open-loop offered load against one warm MoE serve broker: per load point,
+requests arrive on their own deterministic schedule (thread per request,
+own tenant lease — arrival never waits on service), each asking the engine
+for ``max_new`` greedy tokens. Reported per point:
+
+- **p50/p99 latency** and **p50/p99 per-request tokens/s** (tokens over
+  the request's own wall time, queueing included — the number a tenant
+  actually experiences);
+- aggregate delivered tokens/s;
+- the broker's own SLO bookkeeping: hits, misses, evictions (typed retriable
+  :class:`~tpu_mpi.error.SLOExpiredError` rejections of requests that
+  waited past ``TPU_MPI_INFER_SLO_MS`` without being scheduled).
+
+The **knee** is the first offered load where the engine visibly saturates:
+SLO evictions appear, or p99 latency crosses the SLO. The CI ``infer`` job
+gates the committed JSON on schema: p50 tokens/s finite at the lowest
+load, and the knee field recorded.
+
+Run:
+    python benchmarks/infer_sweep.py [--loads 2,10,50] [--duration 3]
+        [--slo-ms 1500] [--json benchmarks/results/infer-slo-cpusim.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def pctl(xs: list, q: float):
+    if not xs:
+        return None
+    ys = sorted(xs)
+    return ys[min(len(ys) - 1, int(q * len(ys)))]
+
+
+def run_point(broker, rps: float, duration_s: float, prompt_len: int,
+              max_new: int, max_clients: int) -> dict:
+    from tpu_mpi import serve
+    from tpu_mpi.error import SLOExpiredError
+
+    n = max(1, int(round(rps * duration_s)))
+    gate = threading.Semaphore(max_clients)
+    lock = threading.Lock()
+    lat_ms, tps, evicted, errors = [], [], [0], [0]
+    before = dict(broker.stats().get("infer") or {})
+    t_start = time.perf_counter()
+
+    def worker(i: int) -> None:
+        delay = i / rps - (time.perf_counter() - t_start)
+        if delay > 0:
+            time.sleep(delay)
+        prompt = [(7 * i + j) % 64 for j in range(prompt_len)]
+        with gate:
+            try:
+                s = serve.attach(broker.address, token=broker.token,
+                                 tenant=f"lp{rps}x{i}")
+            except Exception:           # lease pressure counts as an error
+                with lock:
+                    errors[0] += 1
+                return
+            try:
+                t0 = time.perf_counter()
+                toks = s.generate(prompt, max_new=max_new)
+                dt = time.perf_counter() - t0
+                with lock:
+                    lat_ms.append(dt * 1e3)
+                    tps.append(len(toks) / dt)
+            except SLOExpiredError:
+                with lock:
+                    evicted[0] += 1
+            except Exception:
+                with lock:
+                    errors[0] += 1
+            finally:
+                s.detach()
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    wall_s = time.perf_counter() - t_start
+    after = dict(broker.stats().get("infer") or {})
+    delta = {k: after.get(k, 0) - before.get(k, 0)
+             for k in ("slo_hits", "slo_misses", "slo_evictions", "tokens")}
+    completed = len(lat_ms)
+    return {
+        "offered_load_rps": rps, "requests": n, "completed": completed,
+        "evicted": evicted[0], "errors": errors[0],
+        "wall_s": round(wall_s, 3),
+        "p50_latency_ms": pctl(lat_ms, 0.50), "p99_latency_ms": pctl(lat_ms, 0.99),
+        "p50_tokens_per_s": pctl(tps, 0.50), "p99_tokens_per_s": pctl(tps, 0.99),
+        "delivered_tokens_per_s": round(completed * max_new / wall_s, 3),
+        "broker_slo": delta,
+    }
+
+
+def find_knee(points: list, slo_ms: int):
+    """First offered load where the engine saturates: SLO evictions appear
+    or p99 latency crosses the SLO. None = no knee inside the sweep."""
+    for p in points:
+        over = (p["p99_latency_ms"] is not None and slo_ms > 0
+                and p["p99_latency_ms"] > slo_ms)
+        if p["evicted"] > 0 or over:
+            return p["offered_load_rps"]
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--nranks", type=int, default=4)
+    ap.add_argument("--loads", default="2,10,50",
+                    help="comma-separated offered loads (requests/s)")
+    ap.add_argument("--duration", type=float, default=3.0)
+    ap.add_argument("--prompt-len", type=int, default=12)
+    ap.add_argument("--max-new", type=int, default=8)
+    ap.add_argument("--slo-ms", type=int, default=1500)
+    ap.add_argument("--max-clients", type=int, default=48)
+    ap.add_argument("--json", default=None,
+                    help="write results JSON here (e.g. "
+                         "benchmarks/results/infer-slo-cpusim.json)")
+    args = ap.parse_args()
+    loads = [float(x) for x in args.loads.split(",") if x.strip()]
+
+    os.environ["TPU_MPI_INFER_SLO_MS"] = str(args.slo_ms)
+    from tpu_mpi import config, serve
+    config.load(refresh=True)
+    broker = serve.Broker(nranks=args.nranks, token="bench",
+                          max_tenants=args.max_clients + 8, infer=True)
+    broker.run_in_thread()
+    points = []
+    try:
+        # one warmup generation absorbs client/engine one-offs
+        s = serve.attach(broker.address, token="bench", tenant="warm")
+        s.generate(list(range(args.prompt_len)), max_new=2)
+        s.detach()
+        for rps in loads:
+            pt = run_point(broker, rps, args.duration, args.prompt_len,
+                           args.max_new, args.max_clients)
+            points.append(pt)
+            print(f"load {rps:>6.1f} req/s: {pt['completed']}/{pt['requests']} "
+                  f"ok, {pt['evicted']} evicted, "
+                  f"p50 {pt['p50_tokens_per_s'] or 0:.1f} tok/s, "
+                  f"p99 lat {pt['p99_latency_ms'] or 0:.0f} ms")
+            deadline = time.time() + 60
+            while time.time() < deadline:     # drain before the next point
+                inf = broker.stats().get("infer") or {}
+                if not inf.get("pending") and not inf.get("active"):
+                    break
+                time.sleep(0.05)
+    finally:
+        broker.close()
+
+    knee = find_knee(points, args.slo_ms)
+    record = {
+        "benchmark": "infer-slo", "substrate": "cpu-sim",
+        "nranks": args.nranks, "slo_ms": args.slo_ms,
+        "prompt_len": args.prompt_len, "max_new": args.max_new,
+        "duration_s": args.duration, "points": points,
+        "knee": {"offered_load_rps": knee, "found": knee is not None},
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    print(f"knee: {knee if knee is not None else 'not reached in sweep'}")
+    if args.json:
+        os.makedirs(os.path.dirname(args.json), exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(record, f, indent=1)
+        print(f"wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

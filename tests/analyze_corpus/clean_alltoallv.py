@@ -1,0 +1,29 @@
+# nprocs: 2
+#
+# Clean fixture: Alltoallv with per-rank-VARYING but mutually consistent
+# counts — rank i's scounts[j] equals rank j's rcounts[i] for every pair,
+# which is exactly what the T202 per-peer count check verifies from the
+# scounts/rcounts vectors the event IR now records. Must produce zero
+# trace diagnostics even though no two count vectors are equal.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+
+if rank == 0:
+    scounts, rcounts = [1, 2], [1, 3]
+    send = np.array([0.0, 1.0, 2.0])
+else:
+    scounts, rcounts = [3, 1], [2, 1]
+    send = np.array([10.0, 11.0, 12.0, 13.0])
+
+recv = np.zeros(sum(rcounts))
+MPI.Alltoallv(send, recv, scounts, rcounts, comm)
+
+if rank == 0:
+    assert np.array_equal(recv, [0.0, 10.0, 11.0, 12.0])
+else:
+    assert np.array_equal(recv, [1.0, 2.0, 13.0])
+MPI.Barrier(comm)

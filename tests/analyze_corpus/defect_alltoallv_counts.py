@@ -1,0 +1,24 @@
+# nprocs: 2
+#
+# Defect: Alltoallv per-peer count disagreement. Rank 0 ships 2 elements
+# toward rank 1 (scounts[1] == 2) but rank 1 budgeted only 1 from rank 0
+# (rcounts[0] == 1). The allocating form sizes its result from the
+# SENDERS' counts, so the exchange completes without a runtime error —
+# rank 1 silently gets more data than its stated receive plan — and only
+# the cross-rank trace check can see the books don't balance.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+
+if rank == 0:
+    scounts, rcounts = [1, 2], [1, 1]
+    send = np.array([0.0, 1.0, 2.0])
+else:
+    scounts, rcounts = [1, 1], [1, 1]   # expects 1 from rank 0 — gets 2
+    send = np.array([10.0, 11.0])
+
+out = MPI.Alltoallv(send, scounts, rcounts, comm)   # trace: T202
+MPI.Barrier(comm)

@@ -49,3 +49,18 @@ def test_serve_example_runs():
         capture_output=True, text=True, timeout=180, env=env, cwd=REPO)
     assert res.returncode == 0, res.stderr
     assert "two tenants, one warm pool" in res.stdout
+
+
+def test_moe_serve_example_runs():
+    # 13-moe-serve.py hosts broker + engine + tenants in one process too
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("TPU_MPI_PROC_RANK", None)
+    env.pop("TPU_MPI_SERVE_SOCKET", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "13-moe-serve.py")],
+        capture_output=True, text=True, timeout=180, env=env, cwd=REPO)
+    assert res.returncode == 0, res.stderr
+    assert "batched and solo greedy decode agree bitwise" in res.stdout

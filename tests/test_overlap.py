@@ -199,6 +199,27 @@ def test_plan_cache_generation_invalidates_on_config_reload():
     assert pc.get("k") is None                     # knobs may have changed
 
 
+def test_plan_cache_max_knob_bounds_cache_and_counts_evictions(monkeypatch):
+    monkeypatch.setenv("TPU_MPI_PLAN_CACHE_MAX", "8")
+    config.load(refresh=True)
+    try:
+        pc = PlanCache()
+        for i in range(20):
+            pc.put((3, i), _mkplan())
+        st = pc.stats()
+        assert st["cap"] == 8
+        assert st["entries"] == 8                  # bounded by the knob
+        assert st["evictions"] == 12               # surplus dropped LRU-first
+        assert pc.get((3, 0)) is None and pc.get((3, 19)) is not None
+        # the floor: absurdly small values clamp to 8, not 0
+        monkeypatch.setenv("TPU_MPI_PLAN_CACHE_MAX", "1")
+        config.load(refresh=True)
+        assert pc.stats()["cap"] == 8
+    finally:
+        monkeypatch.undo()
+        config.load(refresh=True)
+
+
 def test_repeated_allreduce_reuses_plan(nprocs):
     def body():
         comm = MPI.COMM_WORLD

@@ -259,3 +259,61 @@ def test_pp_moe_transformer_trains():
     for name in ("w_qkv", "w_in", "w_out", "w_gate", "embed"):
         assert not np.allclose(np.asarray(params[name]),
                                np.asarray(params0[name])), f"{name} never trained"
+
+
+# ---------------------------------------------------------------------------
+# Expert-parallel capacity contract (tpu_mpi/parallel/ep.py)
+# ---------------------------------------------------------------------------
+
+def test_moe_dispatch_combine_over_capacity_drops_exact_zeros():
+    """Tokens past an expert's capacity come back as exact zeros, and the
+    whole dispatch/combine is bitwise deterministic across repeats."""
+    mesh = xla.make_mesh({"ep": 4})
+    t, d, cap = 8, 4, 3
+    tokens = (jnp.arange(4 * t * d, dtype=jnp.float32) + 1.0).reshape(4 * t, d)
+    idx = jnp.zeros(4 * t, dtype=jnp.int32)        # everyone floods expert 0
+
+    def body(tok, ei):
+        return moe_dispatch_combine(tok, ei, lambda z: 2.0 * z,
+                                    capacity=cap, axis="ep")
+
+    f = jax.jit(jax.shard_map(body, mesh=mesh,
+                              in_specs=(P("ep"), P("ep")),
+                              out_specs=P("ep")))
+    out = np.asarray(f(tokens, idx))
+    # per shard of t local tokens, slots 0..t-1: the first `cap` survive
+    kept = np.zeros(4 * t, dtype=bool)
+    for shard in range(4):
+        kept[shard * t: shard * t + cap] = True
+    assert np.array_equal(out[kept], 2.0 * np.asarray(tokens)[kept])
+    assert (out[~kept] == 0.0).all()               # dropped rows: exact zeros
+    assert np.array_equal(out, np.asarray(f(tokens, idx)))  # bitwise repeat
+
+
+@pytest.mark.parametrize("n", [1, 4])
+def test_moe_host_dispatch_combine_over_capacity(n):
+    """Host-path (Alltoallv) variant of the same contract, on the 1-rank
+    and 4-rank thread tiers: sender-side capacity keeps the first
+    `capacity` tokens per destination in original order, drops the rest as
+    exact zeros, and repeats bitwise identically."""
+    from tpu_mpi.testing import run_spmd
+
+    def body():
+        from tpu_mpi.parallel.ep import moe_host_dispatch_combine
+        comm = MPI.COMM_WORLD
+        size, rank = comm.size(), comm.rank()
+        t, d, cap = 6, 3, 2
+        tokens = (np.arange(t * d, dtype=np.float32) + 1.0
+                  + 100.0 * rank).reshape(t, d)
+        idx = np.full(t, (rank + 1) % size, dtype=np.int64)
+        out1 = moe_host_dispatch_combine(tokens, idx, lambda z: 2.0 * z,
+                                         comm, capacity=cap)
+        out2 = moe_host_dispatch_combine(tokens, idx, lambda z: 2.0 * z,
+                                         comm, capacity=cap)
+        expected = np.zeros_like(tokens)
+        expected[:cap] = 2.0 * tokens[:cap]
+        return (np.array_equal(out1, expected),
+                np.array_equal(out1, out2))
+
+    results = run_spmd(body, n)
+    assert all(ok and rep for ok, rep in results)

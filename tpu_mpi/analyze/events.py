@@ -239,8 +239,9 @@ class persistent_scope:
 def record_collective(comm: Any, opname: str,
                       sig: Optional[dict] = None) -> Optional[Event]:
     """One collective entry on this rank; ``sig`` carries the cross-rank-
-    checkable signature fields (root/dtype/count) when the caller knows
-    them precisely (reductions, Bcast)."""
+    checkable signature fields (root/dtype/count, plus per-peer
+    scounts/rcounts for the ``*v`` family) when the caller knows them
+    precisely (reductions, Bcast, Alltoallv)."""
     env = _env()
     if env is None:
         return None
@@ -249,13 +250,14 @@ def record_collective(comm: Any, opname: str,
     sig = sig or {}
     f, ln = call_site()
     ptag = getattr(_tls, "phandle", None)
+    extra = {k: list(sig[k]) for k in ("scounts", "rcounts") if k in sig}
     ev = Event("coll", wrank, op=str(opname), cid=comm.cid,
                grp=tuple(comm.group), root=sig.get("root"),
                dtype=sig.get("dtype"), count=sig.get("count"),
                algo=sig.get("algo"), bufid=sig.get("bufid"),
                handle=ptag[0] if ptag else None,
                round=ptag[1] if ptag else None,
-               file=f, line=ln)
+               file=f, line=ln, extra=extra or None)
     return tr.record(ev)
 
 

@@ -8,8 +8,10 @@ checks what no single rank can check alone:
   eviction cannot misalign the comparison);
 - **T202** — same collective, disagreeing signature: root ranks, or
   dtype/count where the caller supplied a precise signature (reductions,
-  Bcast — per-rank-varying Gatherv/Alltoallv counts are deliberately not
-  compared);
+  Bcast — per-rank-varying Gatherv counts are deliberately not compared),
+  plus per-peer count agreement for the ``*v`` family: Alltoallv events
+  carry ``scounts``/``rcounts`` in ``extra``, and rank i's ``scounts[j]``
+  must equal rank j's ``rcounts[i]``;
 - **T203** — a sent message that was never received (suppressed when the
   receiver's ring overflowed: absence of evidence is not evidence);
 - **T207** — ULFM protocol divergence: ranks of one communicator disagree on
@@ -128,7 +130,44 @@ def _check_collectives(tr) -> List[Diagnostic]:
                 f"{sorted({ev.count for ev in counted})} "
                 f"(collective round {seq} of comm {cid})",
                 file=anchor.file, line=anchor.line, rank=anchor.rank))
+        out += _check_vector_counts(cid, grp, seq, evs)
     return out
+
+
+def _check_vector_counts(cid, grp, seq, evs) -> List[Diagnostic]:
+    """Per-peer count agreement for ``*v`` collectives: events carrying
+    ``scounts``/``rcounts`` in ``extra`` (Alltoallv records both) must
+    satisfy ``rank_i.scounts[j] == rank_j.rcounts[i]`` — what rank i ships
+    toward peer slot j is exactly what rank j budgeted for peer slot i.
+    Position in the count vectors is the rank's index within the group.
+    One diagnostic per round (the first disagreeing pair), anchored at the
+    lower-rank participant."""
+    vevs = [ev for ev in evs
+            if isinstance(ev.extra, dict) and "scounts" in ev.extra
+            and "rcounts" in ev.extra]
+    if len(vevs) < 2:
+        return []
+    pos = {ev.rank: grp.index(ev.rank) for ev in vevs if ev.rank in grp}
+    for a in sorted(vevs, key=lambda ev: ev.rank):
+        for b in sorted(vevs, key=lambda ev: ev.rank):
+            i, j = pos.get(a.rank), pos.get(b.rank)
+            if i is None or j is None:
+                continue
+            sc, rc = list(a.extra["scounts"]), list(b.extra["rcounts"])
+            if len(sc) != len(grp) or len(rc) != len(grp):
+                continue       # malformed vectors already fail at runtime
+            if sc[j] != rc[i]:
+                anchor = a if a.rank <= b.rank else b
+                return [Diagnostic(
+                    "T202",
+                    f"per-peer count disagrees in {anchor.op}: world rank "
+                    f"{a.rank} sends {sc[j]} element(s) to world rank "
+                    f"{b.rank}, which expects {rc[i]} (collective round "
+                    f"{seq} of comm {cid})",
+                    file=anchor.file, line=anchor.line, rank=anchor.rank,
+                    context=f"group {list(grp)}: scounts[{a.rank}]={sc}, "
+                            f"rcounts[{b.rank}]={rc}")]
+    return []
 
 
 # ---------------------------------------------------------------------------

@@ -937,8 +937,12 @@ def Alltoallv(*args) -> Any:
                           _coll_select(comm, "alltoallv", None,
                                        numeric=numeric),
                           numeric=numeric)
+    # per-peer counts ride the event IR so the trace verifier can check
+    # rank i's scounts[j] against rank j's rcounts[i] (T202 family)
     mine = _run(comm, payload, combine, f"Alltoallv@{comm.cid}",
-                plan=("alltoallv", algo), _sig={"algo": algo})
+                plan=("alltoallv", algo),
+                _sig={"algo": algo, "scounts": list(scounts),
+                      "rcounts": list(rcounts)})
     if alloc:
         return clone_like(sendbuf, mine)
     write_flat(recvbuf, mine, sum(rcounts))

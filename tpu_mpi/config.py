@@ -204,6 +204,22 @@ class Config:
     # shared secret a client must present in the session handshake; "" (the
     # default) means the broker accepts any token — loopback/dev mode.
     session_token: str = ""
+    # inference engine (docs/serving.md "Inference engine"): per-request
+    # latency SLO in milliseconds — a generation request whose deadline
+    # expires before it finishes is EVICTED with a typed retriable
+    # SLOExpiredError rather than hung. 0 = no deadline.
+    infer_slo_ms: int = 0
+    # max concurrently-decoding sessions per continuous-batching step; also
+    # the per-expert routing capacity so admitted tokens are never dropped.
+    infer_max_batch: int = 8
+    # KV-cache paged-block granularity in tokens; also the partition size
+    # for cross-stage prefill streaming over Psend_init/Precv_init.
+    kv_block_tokens: int = 16
+    # LRU bound on the persistent-collective plan cache AND the auto-arm
+    # signature table (the auto table is capped at max(8, this // 4)) —
+    # the shape-churn pressure guard; evictions are counted in the pvar
+    # plan-cache block. Minimum 8.
+    plan_cache_max: int = 128
 
     def replace(self, **kw: Any) -> "Config":
         d = {f.name: getattr(self, f.name) for f in fields(self)}
@@ -256,6 +272,10 @@ _ENV_MAP = {
     "serve_max_tenants": "TPU_MPI_SERVE_MAX_TENANTS",
     "serve_quota_bytes": "TPU_MPI_SERVE_QUOTA_BYTES",
     "session_token": "TPU_MPI_SESSION_TOKEN",
+    "infer_slo_ms": "TPU_MPI_INFER_SLO_MS",
+    "infer_max_batch": "TPU_MPI_INFER_MAX_BATCH",
+    "kv_block_tokens": "TPU_MPI_KV_BLOCK_TOKENS",
+    "plan_cache_max": "TPU_MPI_PLAN_CACHE_MAX",
 }
 
 _lock = threading.Lock()

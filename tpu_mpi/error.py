@@ -62,6 +62,7 @@ ERR_REVOKED = 70
 ERR_QUOTA = 71
 ERR_SERVE_BUSY = 72
 ERR_SESSION = 73
+ERR_SLO_EXPIRED = 74
 
 _ERROR_STRINGS = {
     SUCCESS: "MPI_SUCCESS: no error",
@@ -123,6 +124,9 @@ _ERROR_STRINGS = {
     ERR_SESSION: "TPU_ERR_SESSION: session handshake or lease violation "
                  "(bad token, tenant limit, revoked lease, or a cid outside "
                  "the leased namespace)",
+    ERR_SLO_EXPIRED: "TPU_ERR_SLO_EXPIRED: generation request evicted — its "
+                     "latency-SLO deadline expired before completion; "
+                     "retriable under lighter load",
 }
 
 # tpu_mpi.analyze diagnostic code -> MPI error class. The analyzer's own
@@ -289,6 +293,27 @@ class ServeBusyError(MPIError):
         super().__init__(msg, code=code)
         self.tenant = tenant
         self.depth = int(depth)
+
+
+class SLOExpiredError(MPIError):
+    """A generation request's latency-SLO deadline expired before it could
+    finish, and the inference scheduler evicted it (docs/serving.md
+    "Inference engine"). Like :class:`ServeBusyError` this is retriable
+    backpressure: the request was rolled back, nothing is half-generated on
+    the wire, and resubmitting under lighter load is always safe.
+    ``tenant``/``rid`` identify the evicted request; ``slo_ms`` is the
+    deadline it missed."""
+
+    CODE = ERR_SLO_EXPIRED
+    retriable = True
+
+    def __init__(self, msg: str = "generation SLO deadline expired",
+                 code: "int | None" = None, tenant: "str | None" = None,
+                 rid: "int | None" = None, slo_ms: int = 0):
+        super().__init__(msg, code=code)
+        self.tenant = tenant
+        self.rid = rid
+        self.slo_ms = int(slo_ms)
 
 
 class SessionError(MPIError):

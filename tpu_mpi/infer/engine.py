@@ -1,0 +1,401 @@
+"""Expert-parallel MoE inference executor on the serve tier's warm pool.
+
+Topology: the pool's R ranks split into 2 pipeline stages of ``ep = R//2``
+experts each — stage 0 owns layers ``[0, L/2)`` on ranks ``[0, ep)``,
+stage 1 owns ``[L/2, L)`` on ranks ``[ep, R)``; rank ``s`` pairs with rank
+``s + ep``. Every admitted request has a *home slot* ``s``: its KV chains
+and attention run on the pair ``(s, s + ep)``, while its MoE FFN tokens
+route to whichever expert rank the gate picks via
+:func:`tpu_mpi.parallel.ep.moe_host_dispatch_combine` — two Alltoallv
+rendezvous plus a count Alltoall per layer per step, all passing through
+the algorithm-selection layer and the online bandit's decision point.
+
+Determinism contract (the scheduler-order-independence acceptance): every
+batch-size-dependent reduction is forbidden. Attention is computed one
+token row at a time against that session's own KV; experts apply row-wise
+inside the dispatcher; the MoE capacity (``block_tokens`` for prefill,
+``max_batch`` for decode) always covers a sender's worst case, so no
+token is ever dropped by co-batching. A request's token sequence is a
+function of its prompt and the model alone.
+
+Rank-uniformity contract: all R ranks execute the SAME :class:`StepPlan`,
+so every rank makes the identical sequence of collective calls per step —
+non-home ranks contribute zero token rows. That is what lets prefill and
+decode co-batch freely without collective-order divergence (T201).
+
+Prefill streams stage 0 -> stage 1 through the partitioned-op machinery
+(:class:`~tpu_mpi.infer.kvcache.PartitionStreamWriter` /
+``PartitionStreamReader``): stage 1 attends over prompt block p while
+stage 0 is still computing block p+1. Decode hidden states cross stages
+as one plain Send/Recv per step, counts known from the shared plan.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import config
+from .. import perfvars
+from ..error import MPIError
+from .. import error as _ec
+from .kvcache import PagedKVCache, PartitionStreamReader, PartitionStreamWriter
+
+PREFILL_TAG_BASE = 0x5A00     # + stream ordinal % 4096 (partitioned tags)
+DECODE_TAG_BASE = 0x4D00      # + step seq % 4096 (plain Send/Recv)
+N_STAGES = 2
+
+
+class Prefill:
+    __slots__ = ("rid", "slot", "tokens", "tag")
+
+    def __init__(self, rid: int, slot: int, tokens: List[int], tag: int):
+        self.rid, self.slot, self.tokens, self.tag = rid, slot, tokens, tag
+
+
+class Decode:
+    __slots__ = ("rid", "slot", "token", "pos")
+
+    def __init__(self, rid: int, slot: int, token: int, pos: int):
+        self.rid, self.slot, self.token, self.pos = rid, slot, token, pos
+
+
+class StepPlan:
+    """One continuous-batching step, identical on every rank: prefills
+    then decodes (both rid-ordered), plus sessions to release."""
+
+    __slots__ = ("seq", "prefills", "decodes", "releases")
+
+    def __init__(self, seq: int, prefills: List[Prefill],
+                 decodes: List[Decode], releases: List[int]):
+        self.seq = seq
+        self.prefills = sorted(prefills, key=lambda p: p.rid)
+        self.decodes = sorted(decodes, key=lambda d: d.rid)
+        self.releases = sorted(releases)
+
+
+def _gelu(x: np.ndarray) -> np.ndarray:
+    return 0.5 * x * (1.0 + np.tanh(0.7978845608028654
+                                    * (x + 0.044715 * x * x * x)))
+
+
+def _rms_row(x: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    return x * (1.0 / np.sqrt(np.mean(x * x) + 1e-6)) * scale
+
+
+def _softmax_row(x: np.ndarray) -> np.ndarray:
+    e = np.exp(x - x.max())
+    return e / e.sum()
+
+
+def _rope_row(x: np.ndarray, pos: int) -> np.ndarray:
+    """Rotary embedding of one token's (h, dh) heads at global ``pos``."""
+    half = x.shape[-1] // 2
+    ang = pos / (10000.0 ** (np.arange(half, dtype=np.float32)
+                             / np.float32(half)))
+    cos, sin = np.cos(ang), np.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    return np.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+
+
+class InferEngine:
+    """The per-rank model shards + KV caches + step executor. Owned by the
+    broker; driven one :class:`StepPlan` at a time by the scheduler."""
+
+    def __init__(self, pool, cfg=None, *, seed: int = 0,
+                 max_batch: Optional[int] = None,
+                 block_tokens: Optional[int] = None,
+                 kv_blocks: Optional[int] = None):
+        from ..models.transformer import TransformerConfig
+        nr = pool.nranks
+        if nr < 2 or nr % 2:
+            raise MPIError(
+                f"inference engine needs an even warm pool of >= 2 ranks "
+                f"(2 pipeline stages x ep experts), got {nr}",
+                code=_ec.ERR_ARG)
+        knobs = config.load()
+        self.pool = pool
+        self.ep = nr // 2
+        self.cfg = cfg or TransformerConfig(vocab=64, d_model=32, n_heads=2,
+                                            n_layers=2, d_ff=64, max_seq=128)
+        if self.cfg.n_layers % N_STAGES:
+            raise MPIError(f"n_layers={self.cfg.n_layers} must split over "
+                           f"{N_STAGES} pipeline stages", code=_ec.ERR_ARG)
+        self.layers_local = self.cfg.n_layers // N_STAGES
+        self.seed = int(seed)
+        self.max_batch = max(1, int(knobs.infer_max_batch
+                                    if max_batch is None else max_batch))
+        self.block_tokens = max(1, int(knobs.kv_block_tokens
+                                       if block_tokens is None
+                                       else block_tokens))
+        if kv_blocks is None:
+            per_sess = self.layers_local * math.ceil(self.cfg.max_seq
+                                                     / self.block_tokens)
+            kv_blocks = self.max_batch * per_sess
+        self.kv_blocks = int(kv_blocks)
+        self._state: Dict[int, dict] = {}
+        self._reserved = [0] * self.ep
+        self._resv_lock = threading.Lock()
+        self.wcomm = None
+        self.ep_comms = (None, None)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        """Build engine comms and per-rank shards; the pool must be warm."""
+        import jax
+        from ..comm import Comm
+        from ..models.transformer import (transformer_pp_moe_host_params,
+                                          transformer_pp_moe_init)
+        ctx = self.pool.ctx
+        nr = self.pool.nranks
+        self.wcomm = Comm(tuple(range(nr)), ctx.alloc_cid(), ctx=ctx,
+                          name="infer-world")
+        self.ep_comms = (
+            Comm(tuple(range(self.ep)), ctx.alloc_cid(), ctx=ctx,
+                 name="infer-ep0"),
+            Comm(tuple(range(self.ep, nr)), ctx.alloc_cid(), ctx=ctx,
+                 name="infer-ep1"))
+        params = transformer_pp_moe_init(jax.random.PRNGKey(self.seed),
+                                         self.cfg, self.ep)
+        for r in range(nr):
+            stage, slot = (0, r) if r < self.ep else (1, r - self.ep)
+            self._state[r] = {
+                "stage": stage, "slot": slot,
+                "sp": transformer_pp_moe_host_params(
+                    params, self.cfg, self.ep, stage, N_STAGES, slot),
+                "kv": PagedKVCache(self.kv_blocks, self.block_tokens,
+                                   self.cfg.n_heads, self.cfg.head_dim),
+            }
+
+    # -- admission accounting (scheduler side) -------------------------------
+    def kv_demand(self, prompt_len: int, max_new: int) -> int:
+        """Blocks one request can touch on each of its home ranks."""
+        return self.layers_local * math.ceil((prompt_len + max_new)
+                                             / self.block_tokens)
+
+    def can_admit(self, slot: int, need: int) -> bool:
+        with self._resv_lock:
+            return self._reserved[slot] + need <= self.kv_blocks
+
+    def reserve(self, slot: int, need: int) -> None:
+        with self._resv_lock:
+            self._reserved[slot] += need
+
+    def unreserve(self, slot: int, need: int) -> None:
+        with self._resv_lock:
+            self._reserved[slot] = max(0, self._reserved[slot] - need)
+
+    def kv_stats(self) -> dict:
+        caches = [st["kv"].stats() for st in self._state.values()]
+        with self._resv_lock:
+            reserved = max(self._reserved) if self._reserved else 0
+        return {"blocks_per_rank": self.kv_blocks,
+                "block_tokens": self.block_tokens,
+                "in_use_max": max(c["in_use"] for c in caches),
+                "peak_in_use_max": max(c["peak_in_use"] for c in caches),
+                "alloc_failures": sum(c["alloc_failures"] for c in caches),
+                "reserved_max": reserved}
+
+    # -- step execution ------------------------------------------------------
+    def run_step(self, plan: StepPlan) -> Dict[int, int]:
+        """Execute one plan on every pool rank; returns {rid: next token}.
+        The per-rank closures enqueue under the pool's dispatch lock so
+        engine steps interleave atomically with tenant collective ops."""
+        results: Dict[int, int] = {}
+        errs: list = []
+        done = threading.Event()
+        remaining = [self.pool.nranks]
+        lock = threading.Lock()
+
+        def make(rank):
+            def run(_r):
+                try:
+                    out = self._rank_step(rank, plan)
+                    if out:
+                        with lock:
+                            results.update(out)
+                except BaseException as e:      # noqa: BLE001 - reported below
+                    errs.append(e)
+                finally:
+                    with lock:
+                        remaining[0] -= 1
+                        if remaining[0] == 0:
+                            done.set()
+            return run
+
+        with self.pool._dispatch_lock:
+            for r in range(self.pool.nranks):
+                self.pool._queues[r].put((None, make(r)))
+        if not done.wait(timeout=300.0):
+            raise MPIError(f"inference step {plan.seq} timed out on the "
+                           f"pool", code=_ec.ERR_OTHER)
+        if errs:
+            err = errs[0]
+            if isinstance(err, MPIError):
+                raise err
+            raise MPIError(f"inference step failed: {err!r}",
+                           code=_ec.ERR_OTHER)
+        return results
+
+    def _rank_step(self, rank: int, plan: StepPlan) -> Dict[int, int]:
+        st = self._state[rank]
+        out = (self._stage0_step(st, plan) if st["stage"] == 0
+               else self._stage1_step(st, plan))
+        for rid in plan.releases:
+            st["kv"].close(rid)
+        return out
+
+    # -- shared layer math ---------------------------------------------------
+    def _attn_row(self, st: dict, rid: int, li: int, x: np.ndarray,
+                  pos: int) -> np.ndarray:
+        """Attention of ONE token row at global position ``pos`` over the
+        session's own KV chain (appending this token first). Row-at-a-time
+        on purpose: no reduction ever spans co-batched sessions."""
+        sp = st["sp"]
+        d, h = self.cfg.d_model, self.cfg.n_heads
+        dh = self.cfg.head_dim
+        y = _rms_row(x, sp["ln1"][li])
+        qkv = y @ sp["w_qkv"][li]
+        q = _rope_row(qkv[:d].reshape(h, dh), pos)
+        k = _rope_row(qkv[d:2 * d].reshape(h, dh), pos)
+        v = qkv[2 * d:].reshape(h, dh)
+        st["kv"].append(rid, li, k, v)
+        K, V = st["kv"].view(rid, li)                       # (t, h, dh)
+        s = np.einsum("hd,thd->ht", q, K) / np.sqrt(np.float32(dh))
+        s = s - s.max(axis=1, keepdims=True)
+        w = np.exp(s)
+        w = w / w.sum(axis=1, keepdims=True)
+        o = np.einsum("ht,thd->hd", w, V).reshape(d)
+        return x + o @ sp["w_proj"][li]
+
+    def _moe_rows(self, st: dict, comm, li: int, xs: np.ndarray,
+                  capacity: int) -> np.ndarray:
+        """The MoE FFN half-layer over this rank's ``(k, d)`` rows: gate,
+        dispatch to expert ranks, combine, residual. Called by EVERY rank
+        of the stage each round (k may be 0) — rank-uniform collectives."""
+        from ..parallel.ep import moe_host_dispatch_combine
+        sp = st["sp"]
+        d = self.cfg.d_model
+        k = xs.shape[0]
+        if k:
+            ys = np.stack([_rms_row(x, sp["ln2"][li]) for x in xs])
+            gates = np.stack([_softmax_row(y @ sp["w_gate"][li]) for y in ys])
+            eidx = gates.argmax(axis=1)
+            psel = gates[np.arange(k), eidx].astype(np.float32)
+        else:
+            ys = np.zeros((0, d), np.float32)
+            eidx = np.zeros(0, np.int64)
+            psel = np.zeros(0, np.float32)
+        w_in, w_out = sp["w_in"][li], sp["w_out"][li]
+
+        def expert(rows):
+            return _gelu(rows @ w_in) @ w_out
+
+        moe = moe_host_dispatch_combine(ys.astype(np.float32), eidx, expert,
+                                        comm, capacity=capacity)
+        return xs + moe * psel[:, None]
+
+    def _sample(self, st: dict, x: np.ndarray) -> int:
+        """Greedy next token from one final hidden row (ties -> lowest
+        token id, np.argmax's first-maximum rule)."""
+        sp = st["sp"]
+        logits = _rms_row(x, sp["ln_f"]) @ sp["embed"].T
+        return int(np.argmax(logits))
+
+    # -- stage bodies --------------------------------------------------------
+    def _stage0_step(self, st: dict, plan: StepPlan) -> Dict[int, int]:
+        cfg, B, slot = self.cfg, self.block_tokens, st["slot"]
+        sp, L0 = st["sp"], self.layers_local
+        serial_ns = 0
+        for pf in plan.prefills:
+            tlen = len(pf.tokens)
+            nparts = math.ceil(tlen / B)
+            mine = pf.slot == slot
+            writer = (PartitionStreamWriter(nparts, B, cfg.d_model,
+                                            self.ep + slot, pf.tag,
+                                            self.wcomm)
+                      if mine else None)
+            for p in range(nparts):
+                lo, hi = p * B, min((p + 1) * B, tlen)
+                t0 = time.perf_counter_ns()
+                if mine:
+                    xs = np.stack([sp["embed"][t].copy()
+                                   for t in pf.tokens[lo:hi]])
+                else:
+                    xs = np.zeros((0, cfg.d_model), np.float32)
+                for li in range(L0):
+                    for j in range(xs.shape[0]):
+                        xs[j] = self._attn_row(st, pf.rid, li, xs[j], lo + j)
+                    xs = self._moe_rows(st, self.ep_comms[0], li, xs, B)
+                if mine:
+                    writer.publish(p, xs)
+                    serial_ns += time.perf_counter_ns() - t0
+            if writer is not None:
+                writer.finish()
+        mine_dec = [dc for dc in plan.decodes if dc.slot == slot]
+        xs = (np.stack([sp["embed"][dc.token].copy() for dc in mine_dec])
+              if mine_dec else np.zeros((0, cfg.d_model), np.float32))
+        for li in range(L0):
+            for j, dc in enumerate(mine_dec):
+                xs[j] = self._attn_row(st, dc.rid, li, xs[j], dc.pos)
+            xs = self._moe_rows(st, self.ep_comms[0], li, xs, self.max_batch)
+        if mine_dec:
+            from .. import pointtopoint as p2p
+            p2p.Send(np.ascontiguousarray(xs, dtype=np.float32),
+                     self.ep + slot, DECODE_TAG_BASE + plan.seq % 4096,
+                     self.wcomm)
+        if serial_ns and perfvars.enabled():
+            perfvars.note_infer(stage_serial_ns=serial_ns)
+        return {}
+
+    def _stage1_step(self, st: dict, plan: StepPlan) -> Dict[int, int]:
+        cfg, B, slot = self.cfg, self.block_tokens, st["slot"]
+        L1 = self.layers_local
+        results: Dict[int, int] = {}
+        pwait_ns = 0
+        for pf in plan.prefills:
+            tlen = len(pf.tokens)
+            nparts = math.ceil(tlen / B)
+            mine = pf.slot == slot
+            reader = (PartitionStreamReader(nparts, B, cfg.d_model, slot,
+                                            pf.tag, self.wcomm)
+                      if mine else None)
+            last = None
+            for p in range(nparts):
+                lo, hi = p * B, min((p + 1) * B, tlen)
+                if mine:
+                    xs = np.ascontiguousarray(
+                        reader.take(p)[:hi - lo]).astype(np.float32)
+                else:
+                    xs = np.zeros((0, cfg.d_model), np.float32)
+                for li in range(L1):
+                    for j in range(xs.shape[0]):
+                        xs[j] = self._attn_row(st, pf.rid, li, xs[j], lo + j)
+                    xs = self._moe_rows(st, self.ep_comms[1], li, xs, B)
+                if mine and hi == tlen:
+                    last = xs[-1]
+            if reader is not None:
+                reader.finish()
+                pwait_ns += reader.wait_ns
+                results[pf.rid] = self._sample(st, last)
+        mine_dec = [dc for dc in plan.decodes if dc.slot == slot]
+        if mine_dec:
+            from .. import pointtopoint as p2p
+            xs = np.zeros((len(mine_dec), cfg.d_model), np.float32)
+            p2p.Recv(xs, slot, DECODE_TAG_BASE + plan.seq % 4096, self.wcomm)
+        else:
+            xs = np.zeros((0, cfg.d_model), np.float32)
+        for li in range(L1):
+            for j, dc in enumerate(mine_dec):
+                xs[j] = self._attn_row(st, dc.rid, li, xs[j], dc.pos)
+            xs = self._moe_rows(st, self.ep_comms[1], li, xs, self.max_batch)
+        for j, dc in enumerate(mine_dec):
+            results[dc.rid] = self._sample(st, xs[j])
+        if pwait_ns and perfvars.enabled():
+            perfvars.note_infer(pwait_ns=pwait_ns)
+        return results

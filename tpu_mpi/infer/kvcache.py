@@ -1,0 +1,187 @@
+"""Paged KV-cache manager + cross-stage partition streaming (tpu_mpi.infer).
+
+Two concerns live here, both per-rank state of the inference engine:
+
+- :class:`PagedKVCache` — attention key/value storage in fixed-size token
+  blocks (``TPU_MPI_KV_BLOCK_TOKENS`` wide) drawn from one preallocated
+  pool, chained per ``(session, layer)``. Paging is what makes admission a
+  counting problem: the scheduler admits a request iff the blocks its
+  whole generation can touch are still free, so a full cache turns into
+  queueing delay (and eventually a typed SLO eviction) instead of a
+  mid-generation failure.
+- :class:`PartitionStreamWriter` / :class:`PartitionStreamReader` — the
+  prefill activation stream between pipeline stages, built on the MPI-4
+  partitioned ops (``Psend_init``/``Pready`` producing,
+  ``Precv_init``/``Parrived`` consuming). Stage k marks each block of
+  prompt activations ready as it finishes computing it; stage k+1 starts
+  attending over block p while block p+1 is still being produced. The
+  reader accounts its blocked time (``wait_ns``) so the pvar infer block
+  can show the overlap won over a serial stage hand-off.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..error import MPIError
+from .. import error as _ec
+
+
+class PagedKVCache:
+    """Block-paged K/V storage for one rank.
+
+    ``n_blocks`` blocks of ``block_tokens`` tokens, each token a
+    ``(n_heads, head_dim)`` K and V row. Chains grow one token at a time
+    (:meth:`append`) and are read back as contiguous ``(t, h, dh)`` views
+    (:meth:`view`). All methods are thread-safe; the scheduler reads
+    :meth:`free_blocks` / :meth:`stats` while rank workers mutate.
+    """
+
+    def __init__(self, n_blocks: int, block_tokens: int, n_heads: int,
+                 head_dim: int, dtype=np.float32):
+        self.n_blocks = int(n_blocks)
+        self.block_tokens = int(block_tokens)
+        self.k = np.zeros((n_blocks, block_tokens, n_heads, head_dim), dtype)
+        self.v = np.zeros_like(self.k)
+        # pop() from the tail: allocation order is a pure function of the
+        # alloc/release history, never of timing
+        self._free: List[int] = list(range(self.n_blocks - 1, -1, -1))
+        self._chains: Dict[Tuple[int, int], List[int]] = {}
+        self._len: Dict[Tuple[int, int], int] = {}
+        self._lock = threading.Lock()
+        self.peak_in_use = 0
+        self.alloc_failures = 0
+
+    def append(self, sid: int, layer: int, k_row: np.ndarray,
+               v_row: np.ndarray) -> None:
+        """Append one token's ``(h, dh)`` K/V rows to a chain, growing it
+        by a fresh block on a block boundary."""
+        key = (sid, layer)
+        with self._lock:
+            n = self._len.get(key, 0)
+            chain = self._chains.setdefault(key, [])
+            if n % self.block_tokens == 0:
+                if not self._free:
+                    self.alloc_failures += 1
+                    raise MPIError(
+                        f"KV cache exhausted: {self.n_blocks} blocks all in "
+                        f"use (raise TPU_MPI_KV_BLOCK_TOKENS pool sizing or "
+                        f"lower TPU_MPI_INFER_MAX_BATCH)",
+                        code=_ec.ERR_BUFFER)
+                chain.append(self._free.pop())
+                in_use = self.n_blocks - len(self._free)
+                if in_use > self.peak_in_use:
+                    self.peak_in_use = in_use
+            b, off = chain[n // self.block_tokens], n % self.block_tokens
+            self.k[b, off] = k_row
+            self.v[b, off] = v_row
+            self._len[key] = n + 1
+
+    def view(self, sid: int, layer: int) -> Tuple[np.ndarray, np.ndarray]:
+        """The chain's K and V as dense ``(t, h, dh)`` arrays (copies —
+        the caller attends over a stable snapshot)."""
+        key = (sid, layer)
+        with self._lock:
+            n = self._len.get(key, 0)
+            chain = list(self._chains.get(key, ()))
+            B = self.block_tokens
+            out_k = np.empty((n,) + self.k.shape[2:], self.k.dtype)
+            out_v = np.empty_like(out_k)
+            for i, b in enumerate(chain):
+                lo = i * B
+                take = min(B, n - lo)
+                if take <= 0:
+                    break
+                out_k[lo:lo + take] = self.k[b, :take]
+                out_v[lo:lo + take] = self.v[b, :take]
+        return out_k, out_v
+
+    def length(self, sid: int, layer: int) -> int:
+        with self._lock:
+            return self._len.get((sid, layer), 0)
+
+    def close(self, sid: int) -> int:
+        """Release every chain of one session; returns blocks freed."""
+        freed = 0
+        with self._lock:
+            for key in [k for k in self._chains if k[0] == sid]:
+                chain = self._chains.pop(key)
+                self._len.pop(key, None)
+                self._free.extend(reversed(chain))
+                freed += len(chain)
+        return freed
+
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def stats(self) -> dict:
+        with self._lock:
+            in_use = self.n_blocks - len(self._free)
+            return {"blocks": self.n_blocks,
+                    "block_tokens": self.block_tokens,
+                    "in_use": in_use, "peak_in_use": self.peak_in_use,
+                    "chains": len(self._chains),
+                    "alloc_failures": self.alloc_failures}
+
+
+class PartitionStreamWriter:
+    """Producer side of the stage k -> k+1 prefill activation stream: a
+    partitioned send whose partitions are token blocks. :meth:`publish`
+    copies one finished block into the send buffer and ``Pready``s it —
+    the partition ships immediately, while later blocks are still being
+    computed."""
+
+    def __init__(self, nparts: int, block_tokens: int, width: int,
+                 dest: int, tag: int, comm):
+        from .. import pointtopoint as p2p
+        self.nparts = int(nparts)
+        self.block_tokens = int(block_tokens)
+        self.buf = np.zeros((self.nparts * self.block_tokens, width),
+                            np.float32)
+        self._req = p2p.Psend_init(self.buf, self.nparts, dest, tag, comm)
+        self._req.start()
+
+    def publish(self, p: int, rows: np.ndarray) -> None:
+        o = p * self.block_tokens
+        k = rows.shape[0]
+        if k:
+            self.buf[o:o + k] = rows
+        self._req.pready(p)
+
+    def finish(self) -> None:
+        self._req.wait()
+
+
+class PartitionStreamReader:
+    """Consumer side: a partitioned receive polled one token block at a
+    time. :meth:`take` blocks until partition ``p`` has arrived and
+    returns its rows; the time spent blocked accumulates in ``wait_ns`` —
+    the overlap evidence (a reader that waits much less than the producer
+    computes is consuming behind the producer, not after it)."""
+
+    def __init__(self, nparts: int, block_tokens: int, width: int,
+                 src: int, tag: int, comm):
+        from .. import pointtopoint as p2p
+        self.nparts = int(nparts)
+        self.block_tokens = int(block_tokens)
+        self.buf = np.zeros((self.nparts * self.block_tokens, width),
+                            np.float32)
+        self._req = p2p.Precv_init(self.buf, self.nparts, src, tag, comm)
+        self._req.start()
+        self.wait_ns = 0
+
+    def take(self, p: int) -> np.ndarray:
+        t0 = time.perf_counter_ns()
+        while not self._req.parrived(p):
+            time.sleep(0)
+        self.wait_ns += time.perf_counter_ns() - t0
+        o = p * self.block_tokens
+        return self.buf[o:o + self.block_tokens]
+
+    def finish(self) -> None:
+        self._req.wait()

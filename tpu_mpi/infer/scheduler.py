@@ -1,0 +1,312 @@
+"""Continuous-batching request scheduler for the inference engine.
+
+One daemon thread turns the request stream into :class:`StepPlan`s:
+
+- **Admission**: requests queue FIFO per arrival (the broker's FairQueue
+  already ordered them across tenants); the head is admitted when a batch
+  slot AND its whole KV-block demand on its home pair are free — paged-KV
+  backpressure becomes queueing delay, never a mid-generation failure.
+- **SLO eviction**: with ``TPU_MPI_INFER_SLO_MS`` set, a request still
+  *pending* past its deadline is evicted with the typed, retriable
+  :class:`~tpu_mpi.error.SLOExpiredError`; a request that completes is
+  booked as an SLO hit or miss against the same deadline.
+- **Continuous batching**: every step co-schedules the newly admitted
+  prefills with every in-flight decode — one engine step, one new token
+  per active request. Finished/cancelled sessions ride out in the plan's
+  release list so every rank frees their KV chains in lockstep.
+
+Token values never depend on what else is in a batch (the engine's
+row-wise contract), so greedy sequences are bitwise identical whether
+requests arrive together or staggered.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from .. import config
+from .. import error as _ec
+from .. import perfvars
+from ..error import MPIError, SessionError, SLOExpiredError
+from .engine import Decode, InferEngine, Prefill, StepPlan, PREFILL_TAG_BASE
+
+monotonic = time.monotonic
+
+
+class InferRequest:
+    """One generation request and its outbound token stream. The broker
+    handler thread consumes ``out``: ("tok", [ids]) chunks, then one
+    ("done", info) or ("err", exception)."""
+
+    __slots__ = ("rid", "tenant", "prompt", "max_new", "slot", "kv_need",
+                 "tag", "slo_ms", "deadline", "submitted", "pos",
+                 "generated", "out", "state")
+
+    def __init__(self, rid: int, tenant: str, prompt: List[int],
+                 max_new: int, slot: int, kv_need: int, slo_ms: int):
+        self.rid = rid
+        self.tenant = tenant
+        self.prompt = list(prompt)
+        self.max_new = int(max_new)
+        self.slot = slot
+        self.kv_need = kv_need
+        self.tag = 0
+        self.slo_ms = int(slo_ms)
+        self.submitted = monotonic()
+        self.deadline = (self.submitted + self.slo_ms / 1e3
+                         if self.slo_ms > 0 else None)
+        self.pos = 0                      # next feed position (set at prefill)
+        self.generated: List[int] = []
+        self.out: "queue.Queue" = queue.Queue()
+        self.state = "pending"
+
+    def fail(self, exc: BaseException) -> None:
+        if self.state in ("done", "failed"):
+            return
+        self.state = "failed"
+        self.out.put(("err", exc))
+
+    def finish(self, info: dict) -> None:
+        self.state = "done"
+        self.out.put(("done", info))
+
+
+class InferScheduler:
+    """The continuous-batching loop over one :class:`InferEngine`."""
+
+    def __init__(self, engine: InferEngine, *,
+                 max_batch: Optional[int] = None,
+                 slo_ms: Optional[int] = None):
+        knobs = config.load()
+        self.engine = engine
+        self.max_batch = max(1, int(engine.max_batch if max_batch is None
+                                    else max_batch))
+        self.slo_ms = int(knobs.infer_slo_ms if slo_ms is None else slo_ms)
+        self._lock = threading.Lock()
+        self._pending: Deque[InferRequest] = deque()
+        self._active: List[InferRequest] = []
+        self._releases: List[InferRequest] = []
+        self._rid = itertools.count(1)
+        self._seq = itertools.count(0)
+        self._stream = itertools.count(0)
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._dead: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self.counters = {"admitted": 0, "completed": 0, "cancelled": 0,
+                         "slo_evictions": 0, "slo_hits": 0, "slo_misses": 0,
+                         "steps": 0, "step_ns": 0, "tokens": 0,
+                         "batch_slots": 0, "prefill_tokens": 0}
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop,
+                                        name="infer-sched", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        exc = SessionError("inference engine shutting down")
+        with self._lock:
+            doomed = list(self._pending) + list(self._active)
+            self._pending.clear()
+            self._active.clear()
+        for r in doomed:
+            r.fail(exc)
+
+    # -- intake --------------------------------------------------------------
+    def submit(self, tenant: str, prompt: List[int],
+               max_new: int) -> InferRequest:
+        """Queue one generation request (validation is the broker's job);
+        returns immediately — tokens stream through ``req.out``."""
+        if self._dead is not None:
+            raise MPIError(f"inference engine is down: {self._dead}",
+                           code=_ec.ERR_OTHER)
+        rid = next(self._rid)
+        slot = (rid - 1) % self.engine.ep
+        need = self.engine.kv_demand(len(prompt), max_new)
+        req = InferRequest(rid, tenant, prompt, max_new, slot, need,
+                           self.slo_ms)
+        with self._lock:
+            self._pending.append(req)
+        self._wake.set()
+        return req
+
+    def cancel_tenant(self, tenant: str) -> int:
+        """Evict every request of a revoked tenant: pending ones fail
+        immediately, in-flight ones leave the batch and their KV chains
+        are released on the next step. Survivor tenants never notice."""
+        exc = SessionError(f"lease for tenant {tenant!r} revoked "
+                           f"mid-generation")
+        with self._lock:
+            dropped = [r for r in self._pending if r.tenant == tenant]
+            self._pending = deque(r for r in self._pending
+                                  if r.tenant != tenant)
+            victims = [r for r in self._active if r.tenant == tenant]
+            self._active = [r for r in self._active if r.tenant != tenant]
+            for r in victims:
+                r.state = "cancelled"
+                self._releases.append(r)
+            self.counters["cancelled"] += len(dropped) + len(victims)
+        for r in dropped + victims:
+            r.fail(exc)
+        self._wake.set()
+        return len(dropped) + len(victims)
+
+    # -- the batching loop ---------------------------------------------------
+    def _evict_expired(self, now: float) -> None:
+        still: Deque[InferRequest] = deque()
+        for r in self._pending:
+            if r.deadline is not None and now > r.deadline:
+                self.counters["slo_evictions"] += 1
+                if perfvars.enabled():
+                    perfvars.note_infer(slo_evictions=1)
+                r.fail(SLOExpiredError(
+                    f"request rid={r.rid} waited past its "
+                    f"{r.slo_ms}ms SLO deadline without being scheduled "
+                    f"(engine saturated) — retry under lighter load",
+                    tenant=r.tenant, rid=r.rid, slo_ms=r.slo_ms))
+            else:
+                still.append(r)
+        self._pending = still
+
+    def _build_plan(self) -> Optional[tuple]:
+        """Under the lock: evict, admit, snapshot one step. Returns
+        (plan, prefills, decodes) or None when there is nothing to do."""
+        self._evict_expired(monotonic())
+        prefills: List[InferRequest] = []
+        while (self._pending
+               and len(self._active) + len(prefills) < self.max_batch):
+            head = self._pending[0]
+            if not self.engine.can_admit(head.slot, head.kv_need):
+                break                     # KV backpressure: FIFO holds
+            self._pending.popleft()
+            self.engine.reserve(head.slot, head.kv_need)
+            head.tag = PREFILL_TAG_BASE + next(self._stream) % 4096
+            head.state = "running"
+            self.counters["admitted"] += 1
+            prefills.append(head)
+        decodes = list(self._active)
+        releases = self._releases
+        self._releases = []
+        if not prefills and not decodes and not releases:
+            self._wake.clear()
+            return None
+        plan = StepPlan(next(self._seq),
+                        [Prefill(r.rid, r.slot, r.prompt, r.tag)
+                         for r in prefills],
+                        [Decode(r.rid, r.slot, r.generated[-1], r.pos)
+                         for r in decodes],
+                        [r.rid for r in releases])
+        return plan, prefills, decodes, releases
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=0.2)
+            if self._stop.is_set():
+                return
+            with self._lock:
+                built = self._build_plan()
+            if built is None:
+                continue
+            plan, prefills, decodes, releases = built
+            t0 = time.perf_counter_ns()
+            try:
+                results = self.engine.run_step(plan)
+            except BaseException as e:      # noqa: BLE001 - engine is down
+                self._dead = e
+                with self._lock:
+                    doomed = prefills + decodes + list(self._pending)
+                    self._pending.clear()
+                    self._active.clear()
+                for r in doomed:
+                    r.fail(e if isinstance(e, MPIError) else
+                           MPIError(f"inference step failed: {e!r}",
+                                    code=_ec.ERR_OTHER))
+                return
+            step_ns = time.perf_counter_ns() - t0
+            self._book_step(plan, prefills, decodes, releases, results,
+                            step_ns)
+
+    def _book_step(self, plan, prefills, decodes, releases, results,
+                   step_ns) -> None:
+        emitted = 0
+        now = monotonic()
+        with self._lock:
+            for r in releases:
+                self.engine.unreserve(r.slot, r.kv_need)
+            for r in prefills:
+                r.pos = len(r.prompt)     # first decode feeds at this pos
+            for r in prefills + decodes:
+                if r.state != "running":
+                    continue              # cancelled while the step ran
+                tok = results.get(r.rid)
+                if tok is None:
+                    continue
+                if r in prefills:
+                    self._active.append(r)
+                else:
+                    r.pos += 1
+                r.generated.append(tok)
+                emitted += 1
+                r.out.put(("tok", [tok]))
+                if len(r.generated) >= r.max_new:
+                    self._active.remove(r)
+                    self._releases.append(r)
+                    hit = r.deadline is None or now <= r.deadline
+                    self.counters["slo_hits" if hit else "slo_misses"] += 1
+                    self.counters["completed"] += 1
+                    if perfvars.enabled():
+                        perfvars.note_infer(
+                            **{"slo_hits" if hit else "slo_misses": 1})
+                    r.finish({"total_tokens": len(r.generated),
+                              "slo_hit": hit,
+                              "latency_ms": round((now - r.submitted) * 1e3,
+                                                  3)})
+            self.counters["steps"] += 1
+            self.counters["step_ns"] += step_ns
+            self.counters["tokens"] += emitted
+            self.counters["batch_slots"] += len(prefills) + len(decodes)
+            self.counters["prefill_tokens"] += sum(len(r.prompt)
+                                                   for r in prefills)
+            if self._pending or self._releases:
+                self._wake.set()
+        if perfvars.enabled():
+            perfvars.note_infer(steps=1, step_ns=step_ns, tokens=emitted,
+                                batch_slots=len(prefills) + len(decodes),
+                                prefills=len(prefills))
+            kv = self.engine.kv_stats()
+            perfvars.set_infer_gauges(
+                max_batch=self.max_batch,
+                kv_blocks_per_rank=kv["blocks_per_rank"],
+                kv_in_use_max=kv["in_use_max"],
+                kv_peak_in_use_max=kv["peak_in_use_max"],
+                kv_alloc_failures=kv["alloc_failures"])
+
+    # -- reporting -----------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            c = dict(self.counters)
+            pending, active = len(self._pending), len(self._active)
+        finished = c["slo_hits"] + c["slo_misses"]
+        decode_s = c["step_ns"] / 1e9
+        return {
+            "max_batch": self.max_batch, "slo_ms": self.slo_ms,
+            "pending": pending, "active": active, **c,
+            "tokens_per_s": (round(c["tokens"] / decode_s, 3)
+                             if decode_s > 0 else None),
+            "batch_occupancy": (round(c["batch_slots"]
+                                      / (c["steps"] * self.max_batch), 4)
+                                if c["steps"] else None),
+            "slo_hit_rate": (round(c["slo_hits"] / finished, 4)
+                             if finished else None),
+            "kv": self.engine.kv_stats(),
+        }

@@ -304,6 +304,40 @@ def _pp_moe_stage(cfg: TransformerConfig, n_experts: int, ep_axis: str,
     return x
 
 
+def transformer_pp_moe_host_params(params: dict, cfg: TransformerConfig,
+                                   n_experts: int, stage: int,
+                                   n_stages: int, expert: int) -> dict:
+    """Numpy slice of one (pipeline stage, expert) shard of
+    :func:`transformer_pp_moe_init` params, for the host-path inference
+    engine (``tpu_mpi.infer``): the stage's slab of layer tensors plus
+    ONLY this rank's expert FFN weights (w_in/w_out lose their expert
+    dim). ``embed``/``ln_f`` ride along on every rank — stage 0 embeds,
+    the last stage computes logits."""
+    import numpy as np
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"n_layers={cfg.n_layers} must divide over "
+                         f"{n_stages} pipeline stages")
+    if not (0 <= expert < n_experts):
+        raise ValueError(f"expert {expert} out of range [0, {n_experts})")
+    per = cfg.n_layers // n_stages
+    lo, hi = stage * per, (stage + 1) * per
+
+    def host(a):
+        return np.ascontiguousarray(np.asarray(a, dtype=np.float32))
+
+    return {
+        "embed": host(params["embed"]),
+        "ln_f": host(params["ln_f"]),
+        "ln1": host(params["ln1"][lo:hi]),
+        "w_qkv": host(params["w_qkv"][lo:hi]),
+        "w_proj": host(params["w_proj"][lo:hi]),
+        "ln2": host(params["ln2"][lo:hi]),
+        "w_gate": host(params["w_gate"][lo:hi]),
+        "w_in": host(params["w_in"][lo:hi, expert]),
+        "w_out": host(params["w_out"][lo:hi, expert]),
+    }
+
+
 def transformer_pp_moe_train_step(cfg: TransformerConfig, mesh,
                                   n_experts: int, lr: float = 1e-2, *,
                                   dp_axis: str = "dp", pp_axis: str = "pp",

@@ -143,7 +143,10 @@ class PlanCache:
     kind, flavor). Entries from a stale ``config.GENERATION`` miss (the
     pipeline knobs feed the schedule), and :meth:`invalidate` drops a
     freed communicator's plans. Unhashable keys (an unhashable custom op)
-    simply never cache.
+    simply never cache. Both tables are LRU-bounded by the
+    ``TPU_MPI_PLAN_CACHE_MAX`` pressure guard (variable batch shapes mint
+    a new signature per ``(count, dtype)``); evictions are counted and
+    reported in the pvar plan-cache block.
 
     Also owns the **auto-arm table** (ISSUE-11): per-signature
     :class:`AutoArmEntry` records counting repeated identical plain
@@ -151,7 +154,7 @@ class PlanCache:
     persistent path, plus the aggregate armed/demoted/hit counters that
     ``stats()`` (and ``tpurun --stats`` / the serve broker) report."""
 
-    CAP = 128
+    CAP = 128            # built-in default; TPU_MPI_PLAN_CACHE_MAX overrides
     AUTO_CAP = 32
 
     def __init__(self):
@@ -159,12 +162,34 @@ class PlanCache:
         self._plans: "OrderedDict[Any, CollectivePlan]" = OrderedDict()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0              # plans dropped by LRU cap pressure
         self._auto: "OrderedDict[Any, AutoArmEntry]" = OrderedDict()
         self._auto_last: dict = {}      # (cid, rank) -> last signature seen
         self._auto_hot: dict = {}       # (cid, rank) -> front-door record
         self.auto_arms = 0
         self.auto_demotions = 0
         self.auto_hits = 0
+        self.auto_evictions = 0         # auto-arm entries dropped by the cap
+        self._cap_gen = None            # config.GENERATION the caps reflect
+        self._cap = self.CAP
+        self._auto_cap = self.AUTO_CAP
+        # prime the knob read now: the first-ever config.load() bumps
+        # GENERATION, which must not happen inside a later put() (it would
+        # invalidate the very plan being stored)
+        with self._lock:
+            self._caps()
+
+    def _caps(self) -> tuple:
+        """(plan cap, auto-table cap), re-read from config per generation —
+        the TPU_MPI_PLAN_CACHE_MAX pressure guard for shape churn. Caller
+        holds the lock."""
+        from . import config
+        if self._cap_gen != config.GENERATION:
+            cap = max(8, int(config.load().plan_cache_max))
+            self._cap_gen = config.GENERATION
+            self._cap = cap
+            self._auto_cap = max(8, cap // 4)
+        return self._cap, self._auto_cap
 
     def get(self, key: Any) -> Optional[CollectivePlan]:
         from . import config
@@ -189,10 +214,12 @@ class PlanCache:
         except TypeError:
             return
         with self._lock:
+            cap, _ = self._caps()
             self._plans[key] = plan
             self._plans.move_to_end(key)
-            while len(self._plans) > self.CAP:
+            while len(self._plans) > cap:
                 self._plans.popitem(last=False)
+                self.evictions += 1
 
     # -- auto-arm table (ISSUE-11) ------------------------------------------
 
@@ -223,10 +250,12 @@ class PlanCache:
             self._auto_last[lane] = key
             e = self._auto.get(key)
             if e is None:
+                _, auto_cap = self._caps()
                 e = self._auto[key] = AutoArmEntry(key)
-                while len(self._auto) > self.AUTO_CAP:
+                while len(self._auto) > auto_cap:
                     _, old = self._auto.popitem(last=False)
                     self._auto_demote_locked(old)
+                    self.auto_evictions += 1
             else:
                 self._auto.move_to_end(key)
             if e.send is not send or e.recv is not recv:
@@ -322,14 +351,18 @@ class PlanCache:
                     "demotions": e.demotions,
                     "hit_rate": (e.hits / e.calls) if e.calls else 0.0,
                 }
+            cap, auto_cap = self._caps()
             return {"entries": len(self._plans), "hits": self.hits,
                     "misses": self.misses,
+                    "cap": cap, "evictions": self.evictions,
                     "auto": {"tracked": len(self._auto),
                              "armed": sum(1 for e in self._auto.values()
                                           if e.reg is not None),
                              "arms": self.auto_arms,
                              "demotions": self.auto_demotions,
                              "hits": self.auto_hits,
+                             "cap": auto_cap,
+                             "evictions": self.auto_evictions,
                              "signatures": sigs}}
 
 

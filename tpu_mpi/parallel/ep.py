@@ -6,6 +6,18 @@ shapes, so variable counts become a fixed per-expert *capacity* with masking
 (the padded-all_to_all strategy SURVEY.md §2.3 prescribes for `*v` ops);
 one ``lax.all_to_all`` ships token buffers to their experts and one ships
 results back.
+
+Two realizations live here:
+
+- :func:`moe_dispatch_combine` — the jit/shard_map path for training steps
+  (static shapes, capacity masking, ``lax.all_to_all``);
+- :func:`moe_host_dispatch_combine` — the host-path decode-step variant
+  used by the inference engine (``tpu_mpi.infer``): true variable counts
+  over :func:`tpu_mpi.Alltoallv` on an ``ep`` communicator, which routes
+  every decode step through the algorithm-selection layer and the online
+  bandit's decision point (``collective._maybe_explore``). Token routing
+  is nonstationary traffic — exactly what the epsilon-greedy explorer was
+  built for.
 """
 
 from __future__ import annotations
@@ -14,6 +26,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -48,3 +61,65 @@ def moe_dispatch_combine(tokens: jnp.ndarray, expert_idx: jnp.ndarray,
     # gather results back to token order
     gathered = back[expert_idx, jnp.clip(slot, 0, capacity - 1)]
     return jnp.where(keep[:, None], gathered, 0.0)
+
+
+def moe_host_dispatch_combine(tokens: np.ndarray, expert_idx: np.ndarray,
+                              expert_fn: Callable[[np.ndarray], np.ndarray],
+                              comm, *, capacity: int) -> np.ndarray:
+    """Top-1 MoE dispatch/combine on the host path: rank == expert over an
+    ``ep`` communicator, shipped with :func:`tpu_mpi.Alltoallv` (true
+    variable counts — the padded-capacity trick is only an XLA constraint).
+
+    tokens: (t, d) float32 local tokens (t may be 0); expert_idx: (t,)
+    target rank per token; expert_fn: this rank's expert, applied row-wise
+    to whatever tokens arrive. Tokens beyond ``capacity`` per destination
+    are dropped and come back as exact zeros (same contract as the jit
+    path). Returns (t, d), bitwise-deterministic for a fixed routing.
+
+    Every call makes exactly two Alltoallv rendezvous (dispatch, combine)
+    plus one int64 Alltoall for the return counts — three decision-point
+    visits per decode step for the online autotuner.
+    """
+    from .. import collective as _c
+    tokens = np.ascontiguousarray(tokens)
+    if tokens.ndim != 2:
+        tokens = tokens.reshape(-1, tokens.shape[-1] if tokens.size else 1)
+    t, d = tokens.shape
+    n = comm.size()
+    idx = np.asarray(expert_idx, dtype=np.int64).reshape(-1)
+
+    # sender-side capacity bound: the first `capacity` tokens per
+    # destination in original token order (stable — routing determines the
+    # drop set, not arrival jitter)
+    picked = [np.flatnonzero(idx == e)[:capacity] for e in range(n)]
+    scounts = [int(p.size) for p in picked]
+    order = (np.concatenate(picked) if picked else
+             np.zeros(0, np.int64)).astype(np.int64)
+    send = tokens[order] if t else tokens.reshape(0, d)
+
+    rcounts = np.zeros(n, np.int64)
+    _c.Alltoall(np.asarray(scounts, np.int64), rcounts, 1, comm)
+    rcounts = [int(c) for c in rcounts]
+    sc_el = [c * d for c in scounts]
+    rc_el = [c * d for c in rcounts]
+
+    flat_in = np.zeros(sum(rc_el), tokens.dtype)
+    _c.Alltoallv(np.ascontiguousarray(send.reshape(-1)), flat_in,
+                 sc_el, rc_el, comm)
+    arrived = flat_in.reshape(-1, d)
+
+    # apply the expert one row at a time: a token's result can never
+    # depend on how many neighbors happened to share its exchange (BLAS
+    # picks shape-dependent summation orders for larger operands), which
+    # is what makes greedy decode scheduler-order independent.
+    out = np.empty_like(arrived)
+    for i in range(arrived.shape[0]):
+        out[i] = expert_fn(arrived[i:i + 1])[0]
+
+    flat_back = np.zeros(sum(sc_el), tokens.dtype)
+    _c.Alltoallv(np.ascontiguousarray(out.reshape(-1)), flat_back,
+                 rc_el, sc_el, comm)
+    combined = np.zeros((t, d), tokens.dtype)   # dropped rows: exact zeros
+    if order.size:
+        combined[order] = flat_back.reshape(-1, d)
+    return combined

@@ -465,6 +465,42 @@ def note_batch(cid: int, nops: int) -> None:
         acct.batch_ops += int(nops)
 
 
+# -- inference-engine block (tpu_mpi.infer) ----------------------------------
+#
+# Process-global (the engine spans every pool rank, so per-comm attribution
+# would just smear one logical step over three comms): counters accumulate,
+# gauges overwrite. Snapshot surfaces them as the top-level "infer" block
+# next to plan_cache.
+
+_infer: Dict[str, int] = {}
+_infer_gauges: Dict[str, int] = {}
+
+
+def note_infer(**counts: int) -> None:
+    """Accumulate inference-engine counters (steps, tokens, batch_slots,
+    prefills, step_ns, pwait_ns, stage_serial_ns, slo_hits/misses/
+    evictions, ...)."""
+    with _store_lock:
+        for k, v in counts.items():
+            _infer[k] = _infer.get(k, 0) + int(v)
+
+
+def set_infer_gauges(**vals: int) -> None:
+    """Overwrite inference-engine gauges (KV pressure, max_batch)."""
+    with _store_lock:
+        for k, v in vals.items():
+            _infer_gauges[k] = int(v)
+
+
+def infer_snapshot() -> dict:
+    """The infer block of :func:`snapshot` (may be empty): accumulated
+    counters plus the latest gauges under ``"gauges"``."""
+    with _store_lock:
+        if not _infer and not _infer_gauges:
+            return {}
+        return {**_infer, "gauges": dict(_infer_gauges)}
+
+
 def note_explore(comm: Any, explored: bool) -> None:
     """One online-autotuner decision on this comm (tpu_mpi.tune_online):
     ``explored`` when the call was routed to an alternate arm."""
@@ -523,7 +559,8 @@ def snapshot(rank: Optional[int] = None, reset: bool = False) -> dict:
                 del _store[k]
             _store_gen += 1
     return {"schema": 1, "kind": "tpu_mpi-pvars", "level": level(),
-            "comms": comms, "plan_cache": plans.stats()}
+            "comms": comms, "plan_cache": plans.stats(),
+            "infer": infer_snapshot()}
 
 
 def comm_snapshot(comm: Any, reset: bool = False) -> dict:
@@ -547,6 +584,8 @@ def reset() -> None:
     global _store_gen
     with _store_lock:
         _store.clear()
+        _infer.clear()
+        _infer_gauges.clear()
         _store_gen += 1
 
 

@@ -309,7 +309,8 @@ class Broker:
                  max_tenants: Optional[int] = None,
                  quota_bytes: Optional[int] = None,
                  quantum: int = 1 << 16, max_depth: int = 64,
-                 max_inflight: int = 2, ns_span: int = 256):
+                 max_inflight: int = 2, ns_span: int = 256,
+                 infer=None):
         cfg = config.load()
         self.token = cfg.session_token if token is None else token
         self.max_tenants = (cfg.serve_max_tenants if max_tenants is None
@@ -334,11 +335,24 @@ class Broker:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self.started = threading.Event()
+        # inference engine (tpu_mpi.infer): None = off; True or a kwarg
+        # dict for InferEngine = build it at start()
+        self._infer_spec = infer
+        self.infer_engine = None
+        self._infer_sched = None
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
         """Warm the pool, bind the socket, start dispatcher + acceptor."""
         self.pool.start()
+        if self._infer_spec:
+            from ..infer import InferEngine, InferScheduler
+            spec = (dict(self._infer_spec)
+                    if isinstance(self._infer_spec, dict) else {})
+            self.infer_engine = InferEngine(self.pool, **spec)
+            self.infer_engine.start()
+            self._infer_sched = InferScheduler(self.infer_engine)
+            self._infer_sched.start()
         self._listener, self.address = protocol.listen(self._socket_spec)
         self._listener.settimeout(0.2)
         d = threading.Thread(target=self._dispatch_loop,
@@ -375,6 +389,8 @@ class Broker:
             leases = list(self._leases.values())
         for lease in leases:
             self.revoke_lease(lease, "broker shutting down")
+        if self._infer_sched is not None:
+            self._infer_sched.close()
         self.fq.close()
         if self._listener is not None:
             try:
@@ -395,6 +411,12 @@ class Broker:
             _ev.record_serve(self.pool.ctx, "dispatch", cid=op.cid,
                              tenant=op.tenant, kind=op.kind, oid=op.oid,
                              nbytes=op.nbytes)
+            if op.kind == "generate":
+                # DRR decided its admission slot; the scheduler batches it
+                # from here — the fq slot frees immediately so a streaming
+                # generation never starves the tenant's collective lane
+                self._op_done(op)
+                continue
             self.pool.run_op(op, self._op_done)
 
     def _op_done(self, op: PoolOp) -> None:
@@ -455,6 +477,10 @@ class Broker:
                 f"lease for tenant {lease.tenant!r} revoked ({reason}) "
                 f"before the op dispatched")
             op.done.set()
+        if self._infer_sched is not None:
+            # in-flight generations leave the batch; their KV chains free
+            # on the next step — survivors keep streaming
+            self._infer_sched.cancel_tenant(lease.tenant)
         self.pool.release_ns(lease.tenant)
         from ..overlap import plans
         for cid in list(lease.comms):
@@ -545,6 +571,9 @@ class Broker:
                 pass
 
     def _serve_op(self, lease: Lease, meta: dict, arrays: list) -> None:
+        if meta.get("op") == "generate":
+            self._serve_generate(lease, meta, arrays)
+            return
         try:
             reply_meta, reply_arrays = self._admit_and_run(lease, meta,
                                                            arrays)
@@ -607,6 +636,93 @@ class Broker:
             raise MPIError(f"pool execution failed: {err}",
                            code=_ec.ERR_OTHER)
         return self._reply_for(lease, op)
+
+    # -- streaming generation (tpu_mpi.infer) --------------------------------
+    def _serve_generate(self, lease: Lease, meta: dict,
+                        arrays: list) -> None:
+        """One generation request, streamed: admission (quota + fair
+        queue) then repeated RESULT frames ``{"stream": True, "tokens":
+        [...], "done": bool}`` as the scheduler emits tokens. Typed errors
+        (SLO eviction, revocation) arrive as a terminal ERROR frame."""
+        try:
+            req = self._admit_generate(lease, meta, arrays)
+        except MPIError as e:
+            with lease.send_lock:
+                protocol.send_frame(lease.conn, protocol.ERROR,
+                                    protocol.error_meta(e))
+            return
+        while True:
+            try:
+                kind, payload = req.out.get(timeout=300.0)
+            except queue.Empty:
+                kind, payload = "err", SessionError(
+                    f"generation rid={req.rid} stalled on the engine")
+            if kind == "tok":
+                with lease.send_lock:
+                    protocol.send_frame(
+                        lease.conn, protocol.RESULT,
+                        {"op": "generate", "rid": req.rid, "stream": True,
+                         "done": False,
+                         "tokens": [int(t) for t in payload]})
+            elif kind == "done":
+                with lease.send_lock:
+                    protocol.send_frame(
+                        lease.conn, protocol.RESULT,
+                        {"op": "generate", "rid": req.rid, "stream": True,
+                         "done": True, "tokens": [], **payload})
+                return
+            else:
+                with lease.send_lock:
+                    protocol.send_frame(lease.conn, protocol.ERROR,
+                                        protocol.error_meta(payload))
+                return
+
+    def _admit_generate(self, lease: Lease, meta: dict, arrays: list):
+        if self._infer_sched is None:
+            raise MPIError(
+                "this broker has no inference engine (start it with "
+                "tpurun --serve --infer, or Broker(infer=True))",
+                code=_ec.ERR_UNSUPPORTED_OPERATION)
+        if len(arrays) != 1:
+            raise MPIError("generate takes exactly one prompt token array",
+                           code=_ec.ERR_ARG)
+        prompt = np.asarray(arrays[0])
+        if prompt.ndim != 1 or prompt.dtype.kind not in "iu" \
+                or prompt.size == 0:
+            raise MPIError("generate prompt must be a non-empty 1-D integer "
+                           "token array", code=_ec.ERR_ARG)
+        cfg = self.infer_engine.cfg
+        max_new = int(meta.get("max_new", 16))
+        if max_new < 1:
+            raise MPIError(f"max_new must be >= 1, got {max_new}",
+                           code=_ec.ERR_ARG)
+        lo, hi = int(prompt.min()), int(prompt.max())
+        if lo < 0 or hi >= cfg.vocab:
+            raise MPIError(f"prompt token {lo if lo < 0 else hi} outside "
+                           f"vocab [0, {cfg.vocab})", code=_ec.ERR_ARG)
+        if int(prompt.size) + max_new > cfg.max_seq:
+            raise MPIError(
+                f"prompt ({prompt.size}) + max_new ({max_new}) exceeds the "
+                f"model's max_seq ({cfg.max_seq})", code=_ec.ERR_ARG)
+        # admission charge: prompt bytes in + generated ids out
+        nbytes = int(prompt.nbytes) + 8 * max_new
+        self.ledger.charge(lease.tenant, nbytes)
+        op = PoolOp(next(self._oid), lease.tenant, "generate",
+                    lease.root_cid, [], "sum", 0)
+        op.nbytes = nbytes
+        try:
+            self.fq.submit(op)
+        except MPIError as e:
+            if getattr(e, "retriable", False):
+                self.ledger.note_busy(lease.tenant)
+            raise
+        if not op.done.wait(timeout=120.0):
+            raise SessionError(f"generate (oid={op.oid}) timed out in the "
+                               f"fair queue")
+        if op.error is not None:
+            raise op.error
+        return self._infer_sched.submit(lease.tenant,
+                                        [int(t) for t in prompt], max_new)
 
     def _validate_arrays(self, lease: Lease, opname: str, arrays: list,
                          meta: dict) -> None:
@@ -693,7 +809,9 @@ class Broker:
         return {"address": self.address, "pool": self.pool.info(),
                 "tenants_attached": live, "totals": totals,
                 "ledger": self.ledger.report(), "queue": self.fq.stats(),
-                "plan_cache": plans.stats()}
+                "plan_cache": plans.stats(),
+                "infer": (self._infer_sched.stats()
+                          if self._infer_sched is not None else None)}
 
 
 # -- tpurun --serve CLI -------------------------------------------------------
@@ -727,6 +845,9 @@ def main(argv: Optional[list] = None) -> int:
                    help="session token (default: TPU_MPI_SESSION_TOKEN)")
     p.add_argument("--max-tenants", type=int, default=None)
     p.add_argument("--quota-bytes", type=int, default=None)
+    p.add_argument("--infer", action="store_true",
+                   help="serve token generation (tpu_mpi.infer): a "
+                        "2-stage x N-expert MoE engine on the warm pool")
     p.add_argument("--stats", action="store_true",
                    help="report per-tenant usage of a running broker and "
                         "exit")
@@ -744,10 +865,13 @@ def main(argv: Optional[list] = None) -> int:
 
     broker = Broker(nranks=args.nranks, socket_spec=args.socket,
                     token=args.token, max_tenants=args.max_tenants,
-                    quota_bytes=args.quota_bytes)
+                    quota_bytes=args.quota_bytes,
+                    infer=True if args.infer else None)
     broker.start()
     print(f"tpu_mpi serve: broker up — pool={args.nranks} ranks, "
-          f"socket={broker.address} (pid {os.getpid()})", flush=True)
+          f"socket={broker.address}"
+          + (", inference engine on" if args.infer else "")
+          + f" (pid {os.getpid()})", flush=True)
     try:
         broker.serve_forever()
     except KeyboardInterrupt:

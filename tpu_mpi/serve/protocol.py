@@ -41,7 +41,7 @@ import numpy as np
 
 from .. import error as _ec
 from ..error import (MPIError, QuotaExceededError, ServeBusyError,
-                     SessionError)
+                     SessionError, SLOExpiredError)
 
 # frame kinds
 HELLO = 1
@@ -147,7 +147,7 @@ def error_meta(exc: BaseException) -> dict:
             "message": str(getattr(exc, "args", [exc])[0]) if exc.args
                        else str(exc),
             "retriable": bool(getattr(exc, "retriable", False))}
-    for attr in ("tenant", "used", "quota", "depth"):
+    for attr in ("tenant", "used", "quota", "depth", "rid", "slo_ms"):
         v = getattr(exc, attr, None)
         if v is not None:
             meta[attr] = v
@@ -165,6 +165,10 @@ def raise_for_error(meta: dict) -> None:
     if code == _ec.ERR_SERVE_BUSY:
         raise ServeBusyError(msg, tenant=meta.get("tenant"),
                              depth=int(meta.get("depth", 0)))
+    if code == _ec.ERR_SLO_EXPIRED:
+        raise SLOExpiredError(msg, tenant=meta.get("tenant"),
+                              rid=meta.get("rid"),
+                              slo_ms=int(meta.get("slo_ms", 0)))
     if code == _ec.ERR_SESSION:
         raise SessionError(msg)
     raise MPIError(msg, code=code)

@@ -100,6 +100,39 @@ class ClientSession:
     def barrier(self, comm: Optional[SessionComm] = None) -> None:
         self._op({"op": "barrier", "cid": self._cid(comm)})
 
+    # -- token generation (tpu_mpi.infer) ------------------------------------
+    def generate(self, prompt: Any, max_new: int = 16,
+                 on_token=None) -> List[int]:
+        """Generate ``max_new`` tokens from an integer ``prompt`` on the
+        broker's inference engine, streaming: RESULT frames arrive as the
+        engine emits tokens (``on_token(id)`` per token, when given) and
+        the full greedy sequence is returned. Typed errors pass through —
+        an SLO eviction raises the retriable
+        :class:`~tpu_mpi.error.SLOExpiredError`."""
+        arr = np.ascontiguousarray(np.asarray(prompt, dtype=np.int32))
+        with self._lock:
+            if self._closed:
+                raise SessionError("session is detached")
+            protocol.send_frame(self._sock, protocol.OP,
+                                {"op": "generate", "cid": self.comm.cid,
+                                 "max_new": int(max_new)}, [arr])
+            tokens: List[int] = []
+            while True:
+                rkind, rmeta, _ = protocol.recv_frame(self._sock)
+                if rkind == protocol.ERROR:
+                    protocol.raise_for_error(rmeta)
+                if rkind != protocol.RESULT:
+                    raise SessionError(
+                        f"expected streamed RESULT, got "
+                        f"{protocol.KIND_NAMES.get(rkind, rkind)}")
+                new = [int(t) for t in rmeta.get("tokens", ())]
+                tokens.extend(new)
+                if on_token is not None:
+                    for t in new:
+                        on_token(t)
+                if rmeta.get("done"):
+                    return tokens
+
     # -- communicator management ---------------------------------------------
     def comm_dup(self, comm: Optional[SessionComm] = None) -> SessionComm:
         """Duplicate a communicator; the new cid is allocated inside this

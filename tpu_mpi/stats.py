@@ -33,9 +33,10 @@ def aggregate(records: Sequence[dict]) -> dict:
     tot = {"bytes_sent": 0, "bytes_recv": 0, "sends": 0, "recvs": 0,
            "wait_s": 0.0}
     pipe = {"ops": 0, "chunks": 0, "fold_s": 0.0, "wait_after_first_s": 0.0}
-    plan = {"hits": 0, "misses": 0}
+    plan = {"hits": 0, "misses": 0, "evictions": 0}
     auto = {"tracked": 0, "armed": 0, "arms": 0, "demotions": 0, "hits": 0,
-            "signatures": {}}
+            "evictions": 0, "signatures": {}}
+    infer: Dict[str, Any] = {"gauges": {}}
     batch = {"flushes": 0, "ops": 0}
     explore = {"calls": 0, "explored": 0, "table_swaps": 0,
                "last_swap_gen": 0}
@@ -45,9 +46,18 @@ def aggregate(records: Sequence[dict]) -> dict:
         pc = rec.get("plan_cache") or {}
         plan["hits"] += int(pc.get("hits", 0))
         plan["misses"] += int(pc.get("misses", 0))
+        plan["evictions"] += int(pc.get("evictions", 0))
         au = pc.get("auto") or {}
-        for k in ("tracked", "armed", "arms", "demotions", "hits"):
+        for k in ("tracked", "armed", "arms", "demotions", "hits",
+                  "evictions"):
             auto[k] += int(au.get(k, 0))
+        for k, v in (rec.get("infer") or {}).items():
+            if k == "gauges":
+                for g, gv in (v or {}).items():
+                    infer["gauges"][g] = max(int(infer["gauges"].get(g, 0)),
+                                             int(gv))
+            else:
+                infer[k] = int(infer.get(k, 0)) + int(v)
         for label, sig in (au.get("signatures") or {}).items():
             ent = auto["signatures"].setdefault(
                 label, {"calls": 0, "hits": 0, "demotions": 0,
@@ -111,6 +121,7 @@ def aggregate(records: Sequence[dict]) -> dict:
         "explore_fraction": (round(explore["explored"] / explore["calls"], 4)
                              if explore["calls"] else None),
         "arm_counts": arm_counts,
+        "infer": infer,
     }
 
 
@@ -174,7 +185,9 @@ def render(agg: dict, out=None) -> None:
     lk = pc["hits"] + pc["misses"]
     if lk:
         w(f"plan cache: {pc['hits']}/{lk} hits "
-          f"({pc['hits'] / lk * 100:.0f}%)\n")
+          f"({pc['hits'] / lk * 100:.0f}%)"
+          + (f", {pc['evictions']} evictions (TPU_MPI_PLAN_CACHE_MAX)"
+             if pc.get("evictions") else "") + "\n")
     au = agg.get("auto_arm") or {}
     if au.get("arms") or au.get("tracked"):
         w(f"auto-arm: {au['armed']} armed / {au['tracked']} tracked "
@@ -209,6 +222,34 @@ def render(agg: dict, out=None) -> None:
         w("  per-arm samples: " + "  ".join(
             f"{c}/{a}={n}" for (c, a), n in sorted(agg["arm_counts"].items()))
           + "\n")
+
+    inf = agg.get("infer") or {}
+    if inf.get("steps"):
+        g = inf.get("gauges") or {}
+        dec_s = inf.get("step_ns", 0) / 1e9
+        tps = inf.get("tokens", 0) / dec_s if dec_s > 0 else 0.0
+        mb = int(g.get("max_batch") or 0)
+        occ = (inf.get("batch_slots", 0) / (inf["steps"] * mb)
+               if mb else None)
+        fin = inf.get("slo_hits", 0) + inf.get("slo_misses", 0)
+        w(f"\ninference engine: {inf['steps']} steps, "
+          f"{inf.get('tokens', 0)} tokens ({tps:.1f} tok/s), "
+          f"{inf.get('prefills', 0)} prefills\n")
+        if occ is not None:
+            w(f"  batch occupancy {occ:.2f} of max_batch={mb}\n")
+        if fin:
+            w(f"  SLO: {inf.get('slo_hits', 0)}/{fin} hit "
+              f"({inf.get('slo_hits', 0) / fin:.0%}), "
+              f"{inf.get('slo_evictions', 0)} evictions\n")
+        if g.get("kv_blocks_per_rank"):
+            w(f"  KV pressure: peak {g.get('kv_peak_in_use_max', 0)}/"
+              f"{g['kv_blocks_per_rank']} blocks/rank, "
+              f"{g.get('kv_alloc_failures', 0)} alloc failures\n")
+        pw, ser = inf.get("pwait_ns", 0), inf.get("stage_serial_ns", 0)
+        if ser:
+            w(f"  prefill stream: stage-1 waited {pw / 1e6:.2f}ms of the "
+              f"{ser / 1e6:.2f}ms stage-0 produce time "
+              f"({1 - pw / ser:.0%} overlapped)\n")
 
 
 def _launch_and_collect(launch_args: List[str]) -> List[dict]:
@@ -271,6 +312,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                "overlap_fraction": agg["overlap_fraction"],
                "explore": agg["explore"],
                "explore_fraction": agg["explore_fraction"],
+               "infer": agg["infer"],
                "arm_counts": {f"{c}|{a}": n
                               for (c, a), n in sorted(
                                   agg["arm_counts"].items())},
