@@ -31,8 +31,14 @@ hardware allows:
   threshold, star rendezvous below — the tier VERDICT r1 item 4 asked to
   quantify). Runs via ``launch_processes``.
 - ``procs_<algo>`` — one lane per tpu_mpi.tune portfolio algorithm (star,
-  shm, rdouble, rabenseifner, ring), each forced via TPU_MPI_COLL_ALGO in
-  lockstep inside one SPMD launch; selected with ``--lanes procs_algos``.
+  shm, rdouble, rabenseifner, ring — plus ``procs_hier``, the two-level
+  composite, whenever the world has a usable domain split: set
+  ``TPU_MPI_DOMAINS=2`` to emulate it on one machine), each forced via
+  TPU_MPI_COLL_ALGO in lockstep inside one SPMD launch; selected with
+  ``--lanes procs_algos``. Hier rows carry a ``phase_s`` breakdown
+  (intra_fold / inter_exchange / allgather seconds from a short pvar-on
+  window after the timed loop), and the record is stamped with the
+  world's ``topology`` key.
 
 Usage: python benchmarks/allreduce_sweep.py [--max-bytes N] [--ranks N]
        [--lanes host,psum,pallas,procs,procs_algos] [-o results/file.json]
@@ -293,7 +299,7 @@ def bench_pallas(sizes: list[int]) -> list[dict]:
 
 
 def bench_procs(nranks: int, max_bytes: int,
-                algos: bool = False) -> list[dict] | dict:
+                algos: bool = False, min_bytes: int = 8) -> list[dict] | dict:
     """Cross-process Allreduce sweep: re-enter this script as an SPMD child
     under launch_processes; rank 0 writes rows to --rows-out.
 
@@ -309,7 +315,8 @@ def bench_procs(nranks: int, max_bytes: int,
     with tempfile.NamedTemporaryFile("r", suffix=".jsonl") as rows_f:
         code = launch_processes(
             os.path.abspath(__file__), nranks,
-            ["--max-bytes", str(max_bytes), "--rows-out", rows_f.name] + extra,
+            ["--max-bytes", str(max_bytes), "--min-bytes", str(min_bytes),
+             "--rows-out", rows_f.name] + extra,
             timeout=3600)
         if code != 0:
             print(f"procs lane failed with exit code {code}", file=sys.stderr)
@@ -323,7 +330,8 @@ def bench_procs(nranks: int, max_bytes: int,
         return lanes
 
 
-def _procs_child(max_bytes: int, rows_out: str, algos: bool = False) -> None:
+def _procs_child(max_bytes: int, rows_out: str, algos: bool = False,
+                 min_bytes: int = 8) -> None:
     import time
     import numpy as np
     import tpu_mpi as MPI
@@ -350,17 +358,18 @@ def _procs_child(max_bytes: int, rows_out: str, algos: bool = False) -> None:
         return best
 
     with open(rows_out or os.devnull, "a") as f:
-        for nbytes in size_sweep(max_bytes):
+        for nbytes in size_sweep(max_bytes, min_bytes):
             n = max(1, nbytes // 4)
             warmup, iters = iters_for(nbytes)
             iters = max(2, iters // 4)       # wire rounds cost more
             if algos:
                 # identical schedule on every rank: the eligibility inputs
-                # (size, bytes, same-host shm) are rank-uniform
+                # (size, bytes, same-host shm, domain split) are rank-uniform
                 lane = _tune.candidates(
                     "allreduce", size, n * 4, commutative=True,
                     elementwise=True, numeric=True,
-                    shm=os.path.isdir("/dev/shm"))
+                    shm=os.path.isdir("/dev/shm"),
+                    domains=_tune._active_domains(size))
             else:
                 lane = [None]
             for algo in lane:
@@ -368,11 +377,31 @@ def _procs_child(max_bytes: int, rows_out: str, algos: bool = False) -> None:
                     os.environ["TPU_MPI_COLL_ALGO"] = f"allreduce={algo}"
                     _cfg.load(refresh=True)
                 best = measure(n, warmup, iters)
+                phase = None
+                if algo == "hier":
+                    # per-phase evidence for the composite: a short pvar-on
+                    # window AFTER the timed loop (pvars stay off while the
+                    # lane latencies are measured), flipped in lockstep
+                    os.environ["TPU_MPI_PVARS"] = "1"
+                    _cfg.load(refresh=True)
+                    buf = np.ones(n, np.float32)
+                    out = np.zeros(n, np.float32)
+                    comm.get_pvars(reset=True)
+                    for _ in range(max(4, iters)):
+                        MPI.Allreduce(buf, out, MPI.SUM, comm)
+                    ph = comm.get_pvars(reset=True)["phase_s"]
+                    os.environ.pop("TPU_MPI_PVARS", None)
+                    _cfg.load(refresh=True)
+                    phase = {k: round(ph.get(k, 0.0), 6)
+                             for k in ("intra_fold", "inter_exchange",
+                                       "allgather")}
                 if rank == 0:
                     row = {"bytes": n * 4, "lat_us": round(best * 1e6, 2),
                            "algbw_gbps": round(n * 4 / best / 1e9, 3)}
                     if algo is not None:
                         row["algo"] = algo
+                    if phase is not None:
+                        row["phase_s"] = phase
                     f.write(json.dumps(row) + "\n")
                     f.flush()
                     tag = f"procs:{algo}" if algo else "procs"
@@ -391,6 +420,10 @@ def main() -> None:
     os.environ.setdefault("TPU_MPI_DEADLOCK_TIMEOUT", "600")
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-bytes", type=int, default=1 << 30)
+    ap.add_argument("--min-bytes", type=int, default=8,
+                    help="smallest payload in the ladder; raise it to "
+                         "extend an existing artifact's upper end without "
+                         "re-measuring the small sizes")
     ap.add_argument("--ranks", type=int, default=4)
     ap.add_argument("--lanes",
                     default="host,host_persistent,ingraph,psum,pallas")
@@ -402,14 +435,19 @@ def main() -> None:
     args = ap.parse_args()
 
     if os.environ.get("TPU_MPI_PROC_RANK") is not None:
-        _procs_child(args.max_bytes, args.rows_out, args.algos)
+        _procs_child(args.max_bytes, args.rows_out, args.algos,
+                     args.min_bytes)
         return
 
     plat = detect_platform()
-    sizes = size_sweep(args.max_bytes)
+    sizes = size_sweep(args.max_bytes, args.min_bytes)
     lanes = args.lanes.split(",")
+    from tpu_mpi import tune as _tune
     record: dict = {"benchmark": "allreduce_sweep", "platform": plat,
-                    "ranks": args.ranks, "lanes": {}}
+                    "ranks": args.ranks,
+                    "topology": _tune.topology_key(
+                        _tune._active_domains(args.ranks), args.ranks),
+                    "lanes": {}}
     multi = plat["devices"] >= 2
     if "host" in lanes or "host_persistent" in lanes:
         use_device = plat["platform"] != "cpu"
@@ -472,10 +510,12 @@ def main() -> None:
             sizes[::4] + ([sizes[-1]] if (len(sizes) - 1) % 4 else []))
         record["lanes"]["pallas"] = bench_pallas(sub)
     if "procs" in lanes:
-        record["lanes"]["procs"] = bench_procs(args.ranks, args.max_bytes)
+        record["lanes"]["procs"] = bench_procs(
+            args.ranks, args.max_bytes, min_bytes=args.min_bytes)
     if "procs_algos" in lanes or args.algos:
         record["lanes"].update(
-            bench_procs(args.ranks, args.max_bytes, algos=True))
+            bench_procs(args.ranks, args.max_bytes, algos=True,
+                        min_bytes=args.min_bytes))
     from common import assert_artifact_schema
     assert_artifact_schema(record)        # artifact hygiene: fail, not emit
     emit(args.out, record)

@@ -1,0 +1,63 @@
+# nprocs: 4
+#
+# Defect class: one rank silently drops off the hierarchical tier. The
+# world runs under TPU_MPI_DOMAINS=2 so every rank should resolve the
+# 4096-byte Allgather to the two-level "hier" composite, but a patched
+# decision point makes world rank 0 select the flat "star" instead —
+# the failure mode of a machine whose domain map drifted from the
+# fleet's (stale tuning DB, wrong TPU_MPI_DOMAINS on one host). The
+# thread tier shares one address space and executes the same in-process
+# rendezvous either way, so the run completes and produces correct
+# data; the divergence is only visible in the recorded per-rank
+# algorithm selections — exactly what the trace verifier's T213
+# algorithm-split check exists to catch before the procs tier turns it
+# into a hang or a CollectiveMismatchError.
+import os
+
+import numpy as np
+
+import tpu_mpi as MPI
+from tpu_mpi import collective, config
+
+os.environ["TPU_MPI_DOMAINS"] = "2"
+config.load(refresh=True)
+
+# Patch the single decision point so rank 0 diverges. Ranks share this
+# module; the guard keeps sibling ranks from stacking wrappers (a rare
+# double-wrap is behaviorally identical), and the unwind loop below
+# restores the original no matter how many layers were applied.
+if not getattr(collective._coll_select, "_hier_flat_twin", False):
+    _real = collective._coll_select
+
+    def _split_select(comm, coll, nbytes, **kw):
+        algo = _real(comm, coll, nbytes, **kw)
+        if coll == "allgather":
+            from tpu_mpi._runtime import current_env
+            env = current_env()
+            if env is not None and env[1] == 0:
+                return "star"        # rank 0 falls back to the flat tier
+        return algo
+
+    _split_select._hier_flat_twin = True
+    _split_select._orig = _real
+    collective._coll_select = _split_select
+
+try:
+    comm = MPI.COMM_WORLD
+    rank = MPI.Comm_rank(comm)
+    size = MPI.Comm_size(comm)
+
+    data = np.arange(512, dtype=np.float64) + rank
+    gathered = np.zeros(512 * size)
+    MPI.Allgather(data, gathered, 512, comm)     # trace: T213
+    for r in range(size):
+        assert np.array_equal(gathered[r * 512:(r + 1) * 512],
+                              np.arange(512, dtype=np.float64) + r)
+    MPI.Barrier(comm)
+finally:
+    cur = collective._coll_select
+    while hasattr(cur, "_orig"):
+        cur = cur._orig
+    collective._coll_select = cur
+    os.environ.pop("TPU_MPI_DOMAINS", None)
+    config.load(refresh=True)

@@ -41,6 +41,8 @@ CODES = {
     "T210": "alternate schedule deadlocks (found by analyze.explore)",
     "T211": "alternate schedule orphans a sent message",
     "T212": "wildcard receive observes schedule-dependent values",
+    "T213": "algorithm selection disagrees across ranks in a collective "
+            "round",
     "R301": "concurrent overlapping RMA accesses (vector-clock race)",
     "R302": "donated persistent-fold result used after a later Start "
             "invalidated it",

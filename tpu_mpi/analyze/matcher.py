@@ -130,6 +130,32 @@ def _check_collectives(tr) -> List[Diagnostic]:
                 f"{sorted({ev.count for ev in counted})} "
                 f"(collective round {seq} of comm {cid})",
                 file=anchor.file, line=anchor.line, rank=anchor.rank))
+        # T213: algorithm-selection divergence. The selection is required
+        # to be a deterministic function of rank-uniform inputs (see
+        # tune.select), so one rank recording a different algorithm for
+        # the same round means the run mixed tiers — at the proc tier
+        # that is a CollectiveMismatchError in flight; at the thread tier
+        # it documents a selection-determinism bug. A hierarchical run is
+        # ONE logical round here (its sub-collectives are internal
+        # alg-tier frames, never separate coll events), so composites
+        # stay clean by construction.
+        algod = [ev for ev in evs if ev.algo is not None]
+        if len(algod) > 1 and len({ev.algo for ev in algod}) > 1:
+            by_algo: Dict[str, list] = defaultdict(list)
+            for ev in algod:
+                by_algo[ev.algo].append(ev)
+            majority = max(by_algo, key=lambda a: len(by_algo[a]))
+            minority = [ev for ev in algod if ev.algo != majority]
+            anchor = min(minority, key=lambda ev: ev.rank)
+            out.append(Diagnostic(
+                "T213",
+                f"algorithm selection disagrees across ranks in "
+                f"{anchor.op}: world rank {anchor.rank} selected "
+                f"{anchor.algo!r} while rank(s) "
+                f"{sorted(ev.rank for ev in by_algo[majority])} selected "
+                f"{majority!r} (collective round {seq} of comm {cid})",
+                file=anchor.file, line=anchor.line, rank=anchor.rank,
+                context=f"group {list(grp)}"))
         out += _check_vector_counts(cid, grp, seq, evs)
     return out
 

@@ -1008,6 +1008,238 @@ class ProcChannel(_Waitable):
         out = np.concatenate([merged[r] for r in range(n)])
         return self._from_host(out.reshape(host.shape), contrib)
 
+    # -- hierarchical (two-level) composites --------------------------------
+    #
+    # The domain map (tpu_mpi/topology.py) splits this communicator into D
+    # contiguous equal blocks of r ranks (one block per host, or the
+    # TPU_MPI_DOMAINS emulation); member i is (domain i // r, position
+    # i % r). Intra-domain traffic is cheap (shm/loopback), inter-domain
+    # traffic crosses the slow fabric — each composite sends O(D) inter
+    # messages per member where the flat algorithms send O(n).
+
+    def _hier_layout(self) -> Optional[tuple]:
+        """(ndomains, ranks_per_domain) for this group, or None when the
+        world is flat or the layout is not contiguous-uniform (the only
+        shape whose cross-domain fold chain stays bitwise-equal to the
+        star — see topology.domain_shape)."""
+        from . import topology as _topo
+        return _topo.domain_shape(_topo.domain_map(self.ctx, self.group))
+
+    def _run_hier_allreduce(self, rank: int, rnd: int, contrib: Any,
+                            op, opname: str, layout: tuple) -> Any:
+        """Two-level Allreduce: intra-domain gather of raw segment pieces,
+        a cross-domain CHAIN of partial left folds, then backfill +
+        intra-domain allgather. The payload splits into r segments (one
+        per domain position, rabenseifner-style); segment p's owner in
+        domain d is position p. The chain runs in domain order — domain 0
+        folds its r pieces of segment p in rank order, ships the partial
+        to domain 1 whose owner folds ``[carried] + its r pieces``, and so
+        on — so the final domain holds EXACTLY the star's left fold of all
+        n pieces in rank order (left folds compose under chunking), and
+        the elementwise ops this tier admits are segment-separable. Inter
+        traffic: 2·(D-1) segment-sized hops per position, vs the star's
+        n-1 full-payload root ingress crossing the fabric."""
+        import functools as _ft
+        D, r = layout
+        n = len(self.group)
+        host = np.asarray(contrib)
+        work = np.ascontiguousarray(host).reshape(-1)
+        base, rem = divmod(work.size, r)
+        sizes = [base + (1 if p < rem else 0) for p in range(r)]
+        offs = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+        dom, pos = rank // r, rank % r
+        sc = _pv.scope()    # pvar phase spans; None when pvars+tracing off
+
+        # phase 1 (intra gather): my piece of segment q goes to my
+        # domain's position-q member; I collect my co-members' pieces of
+        # MY segment, in position (= rank) order
+        t0 = _pv.monotonic() if sc is not None else 0.0
+        for q in range(r):
+            if q == pos:
+                continue
+            self._send_alg(self.group[dom * r + q], rnd, ("hrs", rank),
+                           rank, opname, work[offs[q]:offs[q + 1]])
+        pieces: list = [None] * r
+        pieces[pos] = work[offs[pos]:offs[pos + 1]]
+        for q in range(r):
+            if q != pos:
+                pieces[q] = np.asarray(self._wait_alg(
+                    rnd, ("hrs", dom * r + q), opname)).reshape(-1)
+        if sc is not None:
+            sc.spans.append(("intra_fold", t0, _pv.monotonic()))
+            t0 = _pv.monotonic()
+
+        # phase 2 (inter chain): fold and carry the partial down the
+        # domain chain; the last domain ends with the full rank-order fold
+        if dom == 0:
+            partial = np.asarray(_ft.reduce(op, pieces)).reshape(-1)
+        else:
+            carried = np.asarray(self._wait_alg(
+                rnd, ("hch", (dom - 1) * r + pos), opname)).reshape(-1)
+            partial = np.asarray(
+                _ft.reduce(op, [carried] + pieces)).reshape(-1)
+        if dom < D - 1:
+            self._send_alg(self.group[(dom + 1) * r + pos], rnd,
+                           ("hch", rank), rank, opname, partial)
+            final = np.asarray(self._wait_alg(
+                rnd, ("hbf", (D - 1) * r + pos), opname)).reshape(-1)
+        else:
+            final = partial
+            for d in range(D - 1):
+                self._send_alg(self.group[d * r + pos], rnd, ("hbf", rank),
+                               rank, opname, final)
+        if sc is not None:
+            sc.spans.append(("inter_exchange", t0, _pv.monotonic()))
+            t0 = _pv.monotonic()
+
+        # phase 3 (intra allgather): everyone shares their finished
+        # segment with their co-members and reassembles in segment order
+        for q in range(r):
+            if q != pos:
+                self._send_alg(self.group[dom * r + q], rnd, ("hag", rank),
+                               rank, opname, final)
+        segs: list = [None] * r
+        segs[pos] = final
+        for q in range(r):
+            if q != pos:
+                segs[q] = np.asarray(self._wait_alg(
+                    rnd, ("hag", dom * r + q), opname)).reshape(-1)
+        out = np.concatenate(segs)
+        if sc is not None:
+            sc.spans.append(("allgather", t0, _pv.monotonic()))
+        return self._from_host(out.reshape(host.shape), contrib)
+
+    def _run_hier_allgather(self, rank: int, rnd: int, contrib: Any,
+                            opname: str, layout: tuple) -> Any:
+        """Two-level Allgather: intra-domain pairwise allgather of the
+        blocks, then one bundle (the domain's r blocks) per member to its
+        position peer in every other domain — D-1 inter messages per
+        member instead of the (D-1)·r a flat pairwise exchange crosses
+        the fabric with. Pure rank-ordered concatenation, so bitwise
+        equality to the star is structural."""
+        D, r = layout
+        n = len(self.group)
+        blk = np.asarray(contrib).reshape(-1)
+        dom, pos = rank // r, rank % r
+        sc = _pv.scope()
+        t0 = _pv.monotonic() if sc is not None else 0.0
+        for q in range(r):
+            if q != pos:
+                self._send_alg(self.group[dom * r + q], rnd, ("hga", rank),
+                               rank, opname, blk)
+        bundle: list = [None] * r
+        bundle[pos] = blk
+        for q in range(r):
+            if q != pos:
+                got = np.asarray(self._wait_alg(
+                    rnd, ("hga", dom * r + q), opname)).reshape(-1)
+                if got.size != blk.size or got.dtype != blk.dtype:
+                    err = MPIError(
+                        f"Allgather block mismatch in {opname}: rank "
+                        f"{dom * r + q} sent {got.size}x{got.dtype}, "
+                        f"rank {rank} holds {blk.size}x{blk.dtype}")
+                    self.ctx.fail(err)
+                    raise err
+                bundle[q] = got
+        if sc is not None:
+            sc.spans.append(("intra_fold", t0, _pv.monotonic()))
+            t0 = _pv.monotonic()
+        for d in range(D):
+            if d != dom:
+                self._send_alg(self.group[d * r + pos], rnd, ("hgb", rank),
+                               rank, opname, np.concatenate(bundle))
+        blocks: list = [None] * n
+        for q in range(r):
+            blocks[dom * r + q] = bundle[q]
+        for d in range(D):
+            if d == dom:
+                continue
+            got = np.asarray(self._wait_alg(
+                rnd, ("hgb", d * r + pos), opname)).reshape(-1)
+            if got.size != r * blk.size:
+                err = MPIError(
+                    f"Allgather bundle mismatch in {opname}: domain {d} "
+                    f"sent {got.size} elements, expected {r * blk.size}")
+                self.ctx.fail(err)
+                raise err
+            for q in range(r):
+                blocks[d * r + q] = got[q * blk.size:(q + 1) * blk.size]
+        if sc is not None:
+            sc.spans.append(("inter_exchange", t0, _pv.monotonic()))
+            t0 = _pv.monotonic()
+        out = np.concatenate(blocks)
+        if sc is not None:
+            sc.spans.append(("allgather", t0, _pv.monotonic()))
+        return self._from_host(out, contrib)
+
+    def _run_hier_alltoall(self, rank: int, rnd: int, contrib: Any,
+                           opname: str, layout: tuple) -> Any:
+        """Two-level Alltoall: segments for co-members travel directly;
+        segments for a foreign domain ride ONE bundle to my position peer
+        there, who forwards each piece intra-domain to its final owner —
+        D-1 inter messages per member (bundle size r·seg) instead of the
+        flat pairwise exchange's (D-1)·r fabric crossings. A pure
+        permutation: every slot receives exactly the sender's segment,
+        bitwise."""
+        D, r = layout
+        n = len(self.group)
+        arr = np.asarray(contrib)
+        segs = arr.reshape(n, arr.size // n)
+        dom, pos = rank // r, rank % r
+        sc = _pv.scope()
+        t0 = _pv.monotonic() if sc is not None else 0.0
+        # intra: direct segment to each co-member
+        for q in range(r):
+            if q != pos:
+                self._send_alg(self.group[dom * r + q], rnd, ("hai", rank),
+                               rank, opname, segs[dom * r + q])
+        if sc is not None:
+            sc.spans.append(("intra_fold", t0, _pv.monotonic()))
+            t0 = _pv.monotonic()
+        # inter: one bundle (their domain's r segments, position order)
+        # to my position peer in every other domain
+        for d in range(D):
+            if d != dom:
+                self._send_alg(
+                    self.group[d * r + pos], rnd, ("hab", rank), rank,
+                    opname,
+                    np.concatenate([segs[d * r + q] for q in range(r)]))
+        out = np.empty_like(segs)
+        out[rank] = segs[rank]
+        seg_sz = segs.shape[1]
+        # receive + forward: peer bundles carry my whole domain's pieces
+        # from the sender's domain; mine I keep, the rest I relay
+        for d in range(D):
+            if d == dom:
+                continue
+            src = d * r + pos
+            got = np.asarray(self._wait_alg(
+                rnd, ("hab", src), opname)).reshape(r, seg_sz)
+            out[src] = got[pos]
+            for q in range(r):
+                if q != pos:
+                    self._send_alg(self.group[dom * r + q], rnd,
+                                   ("haf", src), rank, opname, got[q])
+        if sc is not None:
+            sc.spans.append(("inter_exchange", t0, _pv.monotonic()))
+            t0 = _pv.monotonic()
+        # collect: co-members' direct segments, then forwarded foreign
+        # segments (from the co-member at the original sender's position)
+        for q in range(r):
+            if q != pos:
+                out[dom * r + q] = self._wait_alg(
+                    rnd, ("hai", dom * r + q), opname)
+        for d in range(D):
+            if d == dom:
+                continue
+            for q in range(r):
+                if q != pos:
+                    out[d * r + q] = self._wait_alg(
+                        rnd, ("haf", d * r + q), opname)
+        if sc is not None:
+            sc.spans.append(("allgather", t0, _pv.monotonic()))
+        return self._from_host(out.reshape(-1), contrib)
+
     def _run_tree_gather_fold(self, rank: int, rnd: int, contrib: Any,
                               combine: Callable, opname: str) -> Any:
         """Binomial-tree gather for rooted Reduce/Gather: contributions
@@ -1300,6 +1532,15 @@ class ProcChannel(_Waitable):
                     return None
                 return ("alg", lambda rank, rnd, c, opname:
                         self._run_ring_allreduce(rank, rnd, c, op, opname))
+            if algo == "hier":
+                if self._alg_array(contrib, 1, threshold=False) is None:
+                    return None
+                lay = self._hier_layout()
+                if lay is None:     # flat world: degrade to the star
+                    return None
+                return ("alg", lambda rank, rnd, c, opname:
+                        self._run_hier_allreduce(rank, rnd, c, op, opname,
+                                                 lay))
             return None
         if kind in ("reduce", "gather"):
             if plan[-1] == "binomial":
@@ -1319,6 +1560,13 @@ class ProcChannel(_Waitable):
             if (algo == "pairwise" and self._alg_array(
                     contrib, n, threshold=legacy) is not None):
                 return ("alg", self._run_pairwise_alltoall)
+            if (algo == "hier" and self._alg_array(
+                    contrib, n, threshold=False) is not None):
+                lay = self._hier_layout()
+                if lay is not None:
+                    return ("alg", lambda rank, rnd, c, opname:
+                            self._run_hier_alltoall(rank, rnd, c, opname,
+                                                    lay))
             return None
         if kind == "allgather":
             algo = plan[1] if len(plan) > 1 else "ring"
@@ -1326,6 +1574,13 @@ class ProcChannel(_Waitable):
             if (algo == "ring" and self._alg_array(
                     contrib, 1, threshold=legacy) is not None):
                 return ("alg", self._run_ring_allgather)
+            if (algo == "hier" and self._alg_array(
+                    contrib, 1, threshold=False) is not None):
+                lay = self._hier_layout()
+                if lay is not None:
+                    return ("alg", lambda rank, rnd, c, opname:
+                            self._run_hier_allgather(rank, rnd, c, opname,
+                                                     lay))
             return None
         if kind == "allgatherv":
             algo = plan[3] if len(plan) > 3 else "ring"
@@ -1764,6 +2019,8 @@ class ProcContext(SpmdContext):
         # world address table ("host:port" per rank) — the basis for
         # Comm_spawn world growth; empty when unknown (no spawn possible).
         self.addrs: list[str] = list(addrs or [])
+        # lazily-cached TPU_MPI_DOMAINS split (see _domain_split)
+        self._domain_split_cache: Optional[int] = None
         # snapshot of the debug-sequence flag (read per message on the wire
         # path; a config.load() there would take the config lock per send)
         self.debug_seq = config.load().debug_sequence_check
@@ -1893,17 +2150,40 @@ class ProcContext(SpmdContext):
                     f"could not unchoke rank {p}: {type(e).__name__}: {e}"))
 
     # -- frame transmit -------------------------------------------------------
+    def _domain_split(self) -> int:
+        """Ranks-per-domain of the ``TPU_MPI_DOMAINS`` world split (0 when
+        the override is off or does not divide the world). Cached: procs
+        children fix the env before Init and the per-send hot path cannot
+        afford a config.load() per frame."""
+        spl = self._domain_split_cache
+        if spl is None:
+            k = int(config.load().domains)
+            spl = self.size // k if (2 <= k <= self.size
+                                     and self.size % k == 0) else 0
+            self._domain_split_cache = spl
+        return spl
+
     def shm_ok(self, world_dst: int) -> bool:
-        """Whether the shm lane may carry payloads to this peer."""
-        return (0 <= world_dst < len(self._same_host)
-                and self._same_host[world_dst])
+        """Whether the shm lane may carry payloads to this peer: same host
+        AND same domain. ``TPU_MPI_DOMAINS`` emulates a multi-host split
+        on one box; traffic crossing the emulated host boundary must ride
+        the socket fabric, or the "slow inter / fast intra" asymmetry the
+        override exists to model would silently vanish."""
+        if not (0 <= world_dst < len(self._same_host)
+                and self._same_host[world_dst]):
+            return False
+        spl = self._domain_split()
+        return spl == 0 or world_dst // spl == self.local_rank // spl
 
     def coll_shm_ok(self, group) -> bool:
         """Whether a communicator may use the shared-memory collective fold
         (tune.select's ``shm`` eligibility flag): every member shares this
-        host and /dev/shm exists. Same-host membership comes from the
+        host — and this domain, under the ``TPU_MPI_DOMAINS`` emulation —
+        and /dev/shm exists. Same-host membership comes from the
         rendezvous address table, so all ranks of a single-host comm agree
-        — the rank-uniformity every tier gate requires."""
+        — the rank-uniformity every tier gate requires. A group contained
+        in ONE domain keeps the fold (intra-domain sub-comms are exactly
+        the fast fabric); a group spanning domains loses it."""
         return (os.path.isdir(_SHM_DIR)
                 and all(self.shm_ok(r) for r in group))
 

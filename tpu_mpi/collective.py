@@ -143,21 +143,27 @@ def _coll_select(comm: Comm, coll: str, nbytes: Optional[int], *,
     there the recorded selection documents what the proc tier would do."""
     from . import backend as _backend
     from . import config as _config
+    from . import topology as _topo
     from . import tune
     ctx = getattr(comm, "ctx", None)
     shm = False
     chk = getattr(ctx, "coll_shm_ok", None)
     if chk is not None:
         shm = bool(chk(comm.group))
+    # hierarchy-usable domain count: rank-uniform (a function of the
+    # member list, config.domains and the replicated address table), so
+    # every rank of the communicator selects the same tier
+    dom = _topo.domain_count(ctx, comm.group)
     # _RING_MIN_BYTES is a live module knob (tests move it mid-run to force
     # or suppress the bulk tiers) — key on it so the memo can't pin a
     # selection across a threshold change
     key = (comm.cid, coll, nbytes, commutative, elementwise, numeric, shm,
-           _config.GENERATION, _backend._RING_MIN_BYTES)
+           dom, _config.GENERATION, _backend._RING_MIN_BYTES)
     algo = _select_cache.get(key)
     if algo is None:
         algo = tune.select(coll, comm.size(), nbytes, commutative=commutative,
-                           elementwise=elementwise, shm=shm, numeric=numeric)
+                           elementwise=elementwise, shm=shm, numeric=numeric,
+                           domains=dom)
         _select_cache[key] = algo
         while len(_select_cache) > _SELECT_CAP:
             _select_cache.popitem(last=False)
@@ -177,10 +183,14 @@ def _maybe_explore(comm: Comm, coll: str, nbytes: Optional[int], algo: str, *,
     st = _tune_online.state()
     if st is None:
         return algo
-    chk = getattr(getattr(comm, "ctx", None), "coll_shm_ok", None)
+    from . import topology as _topo
+    ctx = getattr(comm, "ctx", None)
+    chk = getattr(ctx, "coll_shm_ok", None)
     shm = bool(chk(comm.group)) if chk is not None else False
+    dom = _topo.domain_count(ctx, comm.group)
     return st.decide(comm, coll, nbytes, algo, commutative=commutative,
-                     elementwise=elementwise, numeric=numeric, shm=shm)
+                     elementwise=elementwise, numeric=numeric, shm=shm,
+                     domains=dom)
 
 
 def _wire_nbytes(payload: Any) -> Optional[int]:

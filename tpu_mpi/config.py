@@ -220,6 +220,16 @@ class Config:
     # the shape-churn pressure guard; evictions are counted in the pvar
     # plan-cache block. Minimum 8.
     plan_cache_max: int = 128
+    # hierarchical collectives (docs/performance.md "Hierarchical
+    # collectives"): emulated domain count for the two-level runners.
+    # 0 (default) derives domains from the rendezvous address table (one
+    # domain per distinct host); k >= 2 partitions every communicator
+    # into k contiguous equal blocks — the cpu-sim way to exercise the
+    # multi-host split on one machine.
+    domains: int = 0
+    # byte floor for the heuristic to prefer the two-level "hier"
+    # composite on multi-domain worlds (measured tables override).
+    hier_min_bytes: int = 4096
 
     def replace(self, **kw: Any) -> "Config":
         d = {f.name: getattr(self, f.name) for f in fields(self)}
@@ -276,6 +286,8 @@ _ENV_MAP = {
     "infer_max_batch": "TPU_MPI_INFER_MAX_BATCH",
     "kv_block_tokens": "TPU_MPI_KV_BLOCK_TOKENS",
     "plan_cache_max": "TPU_MPI_PLAN_CACHE_MAX",
+    "domains": "TPU_MPI_DOMAINS",
+    "hier_min_bytes": "TPU_MPI_HIER_MIN_BYTES",
 }
 
 _lock = threading.Lock()

@@ -155,6 +155,7 @@ DIAGNOSTIC_CODES = {
     "T210": ERR_DEADLOCK,               # alternate-schedule deadlock
     "T211": ERR_PENDING,                # alternate-schedule orphaned message
     "T212": ERR_ARG,                    # schedule-dependent wildcard values
+    "T213": ERR_COLLECTIVE_MISMATCH,    # per-rank algorithm selection split
     "R301": ERR_RMA_RACE,               # vector-clock RMA race
     "R302": ERR_BUFFER,                 # donated fold result read after inval
 }

@@ -45,7 +45,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 monotonic = time.monotonic
 
-PHASES = ("rendezvous", "fold", "copy")
+PHASES = ("rendezvous", "fold", "copy",
+          # hierarchical-composite sub-phases (backend._run_hier_*)
+          "intra_fold", "inter_exchange", "allgather")
 
 _UNSET = object()
 _enabled_cache: Tuple[Any, bool] = (_UNSET, False)
@@ -545,6 +547,28 @@ def arm_stats(comm: Any) -> List[Tuple[str, str, int, int, int]]:
 # Snapshot / reset / dump
 # ---------------------------------------------------------------------------
 
+def _topology_stamp() -> str:
+    """The ``topology_key`` of the world these counters describe — stamped
+    into every dump record so ``tune merge`` can attribute samples to the
+    right fabric without a side channel. Derived from the live context
+    (domain map over the full world) when one is attached, else from
+    config alone (a flat default — better unstamped-conservative than
+    wrong)."""
+    from . import topology as _topo
+    try:
+        from ._runtime import current_env
+        env = current_env()
+        if env is not None:
+            ctx = env[0]
+            n = int(getattr(ctx, "size", 0) or 0)
+            if n >= 2:
+                dom = _topo.domain_count(ctx, tuple(range(n)))
+                return _topo.topology_key(dom, n)
+    except Exception:
+        pass
+    return _topo.topology_key(int(config.load().domains), 0)
+
+
 def snapshot(rank: Optional[int] = None, reset: bool = False) -> dict:
     """Machine-readable dump of every counter (one rank, or all ranks this
     process has accumulated). Stable schema — ``tpu_mpi.stats`` and
@@ -559,6 +583,7 @@ def snapshot(rank: Optional[int] = None, reset: bool = False) -> dict:
                 del _store[k]
             _store_gen += 1
     return {"schema": 1, "kind": "tpu_mpi-pvars", "level": level(),
+            "topology": _topology_stamp(),
             "comms": comms, "plan_cache": plans.stats(),
             "infer": infer_snapshot()}
 

@@ -49,8 +49,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from . import config
 
 __all__ = ["PORTFOLIO", "eligible", "candidates", "select", "heuristic",
-           "parse_override", "load_table", "write_table", "autotune",
-           "merge_db", "main"]
+           "parse_override", "load_table", "load_db_table", "write_table",
+           "topology_key", "autotune", "merge_db", "main"]
 
 
 # Every algorithm the proc-tier engine implements, per collective. "star"
@@ -58,22 +58,23 @@ __all__ = ["PORTFOLIO", "eligible", "candidates", "select", "heuristic",
 # "starc" pipeline is a transparent refinement of it, not a separate
 # selection). The rest map to ProcChannel runners in tpu_mpi/backend.py.
 PORTFOLIO: Dict[str, Tuple[str, ...]] = {
-    "allreduce":  ("star", "shm", "rdouble", "rabenseifner", "ring"),
+    "allreduce":  ("star", "shm", "rdouble", "rabenseifner", "ring", "hier"),
     "barrier":    ("star", "shm", "dissemination"),
     "bcast":      ("star", "binomial"),
     "reduce":     ("star", "binomial"),
     "gather":     ("star", "binomial"),
     "scatter":    ("star", "binomial"),
-    "allgather":  ("star", "ring"),
+    "allgather":  ("star", "ring", "hier"),
     "allgatherv": ("star", "ring"),
-    "alltoall":   ("star", "pairwise"),
+    "alltoall":   ("star", "pairwise", "hier"),
     "alltoallv":  ("star", "pairwise"),
 }
 
 
 def eligible(coll: str, algo: str, nranks: int, nbytes: Optional[int], *,
              commutative: bool = False, elementwise: bool = False,
-             shm: bool = False, numeric: bool = True) -> bool:
+             shm: bool = False, numeric: bool = True,
+             domains: int = 0) -> bool:
     """Whether ``algo`` may run ``coll`` for this signature.
 
     Must stay a deterministic function of rank-uniform values: collective
@@ -82,14 +83,32 @@ def eligible(coll: str, algo: str, nranks: int, nbytes: Optional[int], *,
     a single-host communicator agrees it is single-host). ``nbytes`` None
     means "payload size unknown" (object payloads) and disqualifies every
     size-gated algorithm. ``numeric`` means the payload is a fixed-dtype
-    array (not dtype=object / arbitrary pickled objects).
+    array (not dtype=object / arbitrary pickled objects). ``domains`` is
+    the hierarchy-usable domain count from ``topology.domain_count`` (0 =
+    flat world) — rank-uniform because the domain map is a function of
+    the member list plus replicated inputs.
     """
     if algo == "star":
         return True
     if nranks < 2 or algo not in PORTFOLIO.get(coll, ()):
         return False
+    if algo == "hier":
+        # two-level composite: needs >= 2 contiguous equal domains with
+        # >= 2 ranks each, raw array payloads of known size; the
+        # allreduce variant chains per-segment rank-order left folds, so
+        # it additionally needs an elementwise (segment-separable) op.
+        if domains < 2 or nranks % domains or nranks // domains < 2:
+            return False
+        if not numeric or nbytes is None:
+            return False
+        return elementwise if coll == "allreduce" else True
     if algo == "shm":
-        if not shm:
+        # the one-segment fold spans the whole communicator; a world split
+        # into >= 2 domains (real hosts, or the TPU_MPI_DOMAINS emulation)
+        # has no single shared segment — the comm layer's coll_shm_ok
+        # already reports shm=False there, and this clamp keeps callers
+        # that pass a stale flag (or probe eligibility off-comm) honest
+        if not shm or domains >= 2:
             return False
         cap = config.load().coll_shm_max_bytes
         if cap <= 0:
@@ -123,11 +142,13 @@ def eligible(coll: str, algo: str, nranks: int, nbytes: Optional[int], *,
 
 def candidates(coll: str, nranks: int, nbytes: Optional[int], *,
                commutative: bool = False, elementwise: bool = False,
-               shm: bool = False, numeric: bool = True) -> List[str]:
+               shm: bool = False, numeric: bool = True,
+               domains: int = 0) -> List[str]:
     """Eligible algorithms for a signature, portfolio order."""
     return [a for a in PORTFOLIO.get(coll, ("star",))
             if eligible(coll, a, nranks, nbytes, commutative=commutative,
-                        elementwise=elementwise, shm=shm, numeric=numeric)]
+                        elementwise=elementwise, shm=shm, numeric=numeric,
+                        domains=domains)]
 
 
 # ---------------------------------------------------------------------------
@@ -231,11 +252,33 @@ def _read_table_toml(path: str) -> dict:
         return _parse_table_text(data.decode())
 
 
-def load_table(path: str) -> Dict[Tuple[str, int], List[Tuple[int, str]]]:
-    """Load (and cache on mtime) a tuning table. A missing or malformed
-    file disables the table layer with a one-time warning — the heuristic
-    still serves, a bad table never takes the job down."""
-    path = os.path.expanduser(path)
+def _ladders_from_raw(raw: dict) -> Dict[Tuple[str, int],
+                                         List[Tuple[int, str]]]:
+    """Crossover ladders from one parsed TOML tree level: every
+    ``[<coll>.n<ranks>]`` section whose collective/algorithms the
+    portfolio knows. Unknown sections (meta, provenance, samples, topo)
+    fall through silently — forward compatibility."""
+    table: Dict[Tuple[str, int], List[Tuple[int, str]]] = {}
+    for coll, per_n in raw.items():
+        if coll not in PORTFOLIO or not isinstance(per_n, dict):
+            continue
+        for nkey, ladder in per_n.items():
+            if not (isinstance(ladder, dict) and nkey.startswith("n")):
+                continue
+            n = int(nkey[1:])
+            ent = sorted(((int(th), str(algo))
+                          for th, algo in ladder.items()
+                          if str(algo) in PORTFOLIO[coll]),
+                         reverse=True)
+            if ent:
+                table[(coll, n)] = ent
+    return table
+
+
+def _cached_table(cache_key: str, path: str, build) -> Dict:
+    """mtime-cached table load with the shared unreadable/unusable
+    warn-once behavior; ``build(raw)`` turns the parsed TOML into the
+    table for this view."""
     try:
         mtime = os.stat(path).st_mtime_ns
     except OSError:
@@ -244,25 +287,11 @@ def load_table(path: str) -> Dict[Tuple[str, int], List[Tuple[int, str]]]:
             print(f"tpu_mpi: tuning table {path!r} not readable; "
                   f"using the built-in heuristic", file=sys.stderr)
         return {}
-    hit = _table_cache.get(path)
+    hit = _table_cache.get(cache_key)
     if hit is not None and hit[0] == mtime:
         return hit[1]
-    table: Dict[Tuple[str, int], List[Tuple[int, str]]] = {}
     try:
-        raw = _read_table_toml(path)
-        for coll, per_n in raw.items():
-            if coll not in PORTFOLIO or not isinstance(per_n, dict):
-                continue
-            for nkey, ladder in per_n.items():
-                if not (isinstance(ladder, dict) and nkey.startswith("n")):
-                    continue
-                n = int(nkey[1:])
-                ent = sorted(((int(th), str(algo))
-                              for th, algo in ladder.items()
-                              if str(algo) in PORTFOLIO[coll]),
-                             reverse=True)
-                if ent:
-                    table[(coll, n)] = ent
+        table = build(_read_table_toml(path))
     except Exception as e:
         if path not in _table_warned:
             _table_warned.add(path)
@@ -271,8 +300,39 @@ def load_table(path: str) -> Dict[Tuple[str, int], List[Tuple[int, str]]]:
         table = {}
     while len(_table_cache) >= _TABLE_CACHE_CAP:
         _table_cache.pop(next(iter(_table_cache)))
-    _table_cache[path] = (mtime, table)
+    _table_cache[cache_key] = (mtime, table)
     return table
+
+
+def load_table(path: str) -> Dict[Tuple[str, int], List[Tuple[int, str]]]:
+    """Load (and cache on mtime) a tuning table. A missing or malformed
+    file disables the table layer with a one-time warning — the heuristic
+    still serves, a bad table never takes the job down."""
+    path = os.path.expanduser(path)
+    return _cached_table(path, path, _ladders_from_raw)
+
+
+def load_db_table(path: str, topology: str) -> Dict[Tuple[str, int],
+                                                    List[Tuple[int, str]]]:
+    """Per-topology view of a fleet database: the top-level ladders
+    belong to the fabric named by ``[meta] topology`` (missing/empty
+    meta = a plain v1 table, applied everywhere); every other fabric's
+    ladders live under ``[topo."<key>".<coll>.n<n>]``. A query only ever
+    sees its own topology's ladders, so ``_nearest_nranks``
+    interpolation cannot leak a foreign fabric's crossovers."""
+    path = os.path.expanduser(path)
+
+    def build(raw: dict) -> Dict:
+        meta = raw.get("meta")
+        meta_topo = str(meta.get("topology", "") if isinstance(meta, dict)
+                        else "")
+        if not meta_topo or meta_topo == topology:
+            return _ladders_from_raw(raw)
+        topo = raw.get("topo")
+        sub = topo.get(topology) if isinstance(topo, dict) else None
+        return _ladders_from_raw(sub) if isinstance(sub, dict) else {}
+
+    return _cached_table(f"{path}\x00{topology}", path, build)
 
 
 def write_table(path: str,
@@ -333,21 +393,31 @@ def _table_lookup(table: Dict[Tuple[str, int], List[Tuple[int, str]]],
 
 def heuristic(coll: str, nranks: int, nbytes: Optional[int], *,
               commutative: bool = False, elementwise: bool = False,
-              shm: bool = False, numeric: bool = True) -> str:
+              shm: bool = False, numeric: bool = True,
+              domains: int = 0) -> str:
     """Built-in crossovers (used when no measured table applies). The bulk
     threshold is ``backend._RING_MIN_BYTES`` — read live, because tests and
     users monkeypatch it / set ``TPU_MPI_RING_MIN_BYTES`` (the historical
     knob this table absorbed). Bulk algorithms take precedence over the shm
-    fold so a forced-low ring threshold behaves exactly as it always has."""
+    fold so a forced-low ring threshold behaves exactly as it always has.
+    On multi-domain worlds the two-level composite wins once the payload
+    clears ``config.hier_min_bytes`` — inter-domain messages are the
+    expensive resource there, and hierarchy sends D-1 of them per segment
+    instead of n-1."""
     from . import backend as B
 
     def ok(algo: str) -> bool:
         return eligible(coll, algo, nranks, nbytes, commutative=commutative,
-                        elementwise=elementwise, shm=shm, numeric=numeric)
+                        elementwise=elementwise, shm=shm, numeric=numeric,
+                        domains=domains)
 
     ring_min = B._RING_MIN_BYTES
     bulky = numeric and nbytes is not None and nbytes >= ring_min
+    hier_ok = (domains >= 2 and numeric and nbytes is not None
+               and nbytes >= config.load().hier_min_bytes and ok("hier"))
     if coll == "allreduce":
+        if hier_ok:
+            return "hier"
         if bulky and ok("ring"):
             return "ring"
         if ok("shm"):
@@ -358,8 +428,12 @@ def heuristic(coll: str, nranks: int, nbytes: Optional[int], *,
     if coll == "bcast":
         return "binomial"
     if coll in ("allgather", "allgatherv"):
+        if coll == "allgather" and hier_ok:
+            return "hier"
         return "ring" if bulky and ok("ring") else "star"
     if coll == "alltoall":
+        if hier_ok:
+            return "hier"
         return "pairwise" if bulky and ok("pairwise") else "star"
     if coll == "alltoallv":
         # counts differ per rank: dtype-only gate (uniform by contract),
@@ -368,9 +442,19 @@ def heuristic(coll: str, nranks: int, nbytes: Optional[int], *,
     return "star"           # reduce / gather / scatter default to the star
 
 
+def topology_key(domains: int = 0, nranks: int = 0,
+                 arch: Optional[str] = None) -> str:
+    """Shared fleet-DB topology key — delegates to
+    :func:`tpu_mpi.topology.topology_key` so the runtime, sweeps and
+    ``tune merge`` can never disagree on the spelling."""
+    from . import topology as _topo
+    return _topo.topology_key(domains, nranks, arch)
+
+
 def select(coll: str, nranks: int, nbytes: Optional[int] = None, *,
            commutative: bool = False, elementwise: bool = False,
-           shm: bool = False, numeric: bool = True) -> str:
+           shm: bool = False, numeric: bool = True,
+           domains: int = 0) -> str:
     """THE algorithm decision for one collective signature.
 
     Resolution: force-override → online hot-swap table (the in-memory
@@ -381,14 +465,18 @@ def select(coll: str, nranks: int, nbytes: Optional[int] = None, *,
     result is cached inside the CollectivePlan); must stay deterministic
     across ranks for fixed rank-uniform inputs + uniform config — the
     online table satisfies this because every rank derives it from the
-    SAME merged cross-rank stats in a lockstep swap round.
+    SAME merged cross-rank stats in a lockstep swap round. The fleet DB
+    layer resolves per-topology: only rows recorded under THIS world's
+    ``topology_key`` are consulted, so a foreign fabric's crossovers are
+    never applied here.
     """
     if nranks < 2:
         return "star"
 
     def ok(algo: str) -> bool:
         return eligible(coll, algo, nranks, nbytes, commutative=commutative,
-                        elementwise=elementwise, shm=shm, numeric=numeric)
+                        elementwise=elementwise, shm=shm, numeric=numeric,
+                        domains=domains)
 
     cfg = config.load()
     forced = parse_override(cfg.coll_algo).get(coll)
@@ -406,11 +494,14 @@ def select(coll: str, nranks: int, nbytes: Optional[int] = None, *,
         if algo is not None and ok(algo):
             return algo
     if cfg.tune_db:
-        algo = _table_lookup(load_table(cfg.tune_db), coll, nranks, nbytes)
+        algo = _table_lookup(
+            load_db_table(cfg.tune_db, topology_key(domains, nranks)),
+            coll, nranks, nbytes)
         if algo is not None and ok(algo):
             return algo
     return heuristic(coll, nranks, nbytes, commutative=commutative,
-                     elementwise=elementwise, shm=shm, numeric=numeric)
+                     elementwise=elementwise, shm=shm, numeric=numeric,
+                     domains=domains)
 
 
 # ---------------------------------------------------------------------------
@@ -522,14 +613,24 @@ MPI.Finalize()
 '''
 
 
+def _active_domains(nranks: int) -> int:
+    """The hierarchy domain count ``TPU_MPI_DOMAINS`` implies for a world
+    of ``nranks`` (0 when unset or the world doesn't split evenly) — the
+    sweep-side mirror of ``topology.domain_count``, which needs a live
+    communicator the tune CLI doesn't have."""
+    k = int(config.load().domains)
+    return k if (k >= 2 and nranks % k == 0 and nranks // k >= 2) else 0
+
+
 def _sweep_spec(nranks: int, sizes: Sequence[int],
                 colls: Sequence[str]) -> list:
     """The lockstep (coll, nbytes, algos) schedule for one world size.
     Algorithms are the deployment-eligible set per point (shm capped by the
-    configured slot size etc.), so the emitted table never selects
-    something the runtime would clamp away."""
+    configured slot size etc., hier only on a multi-domain world), so the
+    emitted table never selects something the runtime would clamp away."""
     points = []
     shm_ok = os.path.isdir("/dev/shm")   # single-host sweep by construction
+    dom = _active_domains(nranks)
     for coll in colls:
         ladder: Sequence[int] = ((0,) if coll == "barrier"
                                  else sizes if coll == "allreduce"
@@ -537,7 +638,8 @@ def _sweep_spec(nranks: int, sizes: Sequence[int],
                                        if s <= max(sizes)])
         for nbytes in ladder:
             algos = candidates(coll, nranks, nbytes, commutative=True,
-                               elementwise=True, shm=shm_ok, numeric=True)
+                               elementwise=True, shm=shm_ok, numeric=True,
+                               domains=dom)
             points.append((coll, int(nbytes), algos))
     return points
 
@@ -655,22 +757,32 @@ def table_from_pvars(paths: Sequence[str],
 #   kind = "pvars"
 #   [samples.allreduce.n4.shm]
 #   "1024" = "32:41.5"              # observation count : mean latency (us)
+#   [topo."2d4r/x86_64".allreduce.n8]
+#   "0" = "hier"
+#   [topo."2d4r/x86_64".samples.allreduce.n8.hier]
+#   "65536" = "32:120.5"
 #
 # Keeping raw (count, mean) cells makes re-merges sample-count-weighted by
 # construction: a node contributing 1000 observations of a cell outweighs
 # one contributing 10, and folding the same DB again is idempotent on the
-# ladders. The [meta] topology string is the database's fleet key — merge
-# refuses nothing, but stamps what substrate the numbers describe so a DB
-# measured on TCP loopback is not silently trusted on a real fabric.
+# ladders. The [meta] topology string is the database's DEFAULT fleet key:
+# its ladders and samples sit at the top level (byte-compatible with the
+# pre-topology schema), while every other fabric's rows live under
+# [topo."<key>"...] — so one DB can hold the whole fleet's evidence and
+# ``load_db_table`` serves each world only its own fabric's crossovers.
 
 
-def _db_read(path: str) -> Tuple[Dict[Tuple[str, int, int, str], List[float]],
+def _db_read(path: str) -> Tuple[Dict[Tuple[str, str, int, int, str],
+                                      List[float]],
                                  List[dict], Dict]:
     """(samples, provenance, meta) from an existing fleet DB, for
     incremental re-merges; all-empty when the file is absent or predates
     schema 2 (plain tables contribute ladders via the overlay path, not
-    samples)."""
-    samples: Dict[Tuple[str, int, int, str], List[float]] = {}
+    samples). Sample keys are ``(topology, coll, nranks, bytes, algo)``;
+    top-level sample sections belong to the DB's meta topology (``""``
+    when the DB predates the field — the caller re-keys that to its
+    default)."""
+    samples: Dict[Tuple[str, str, int, int, str], List[float]] = {}
     prov: List[dict] = []
     meta: Dict = {}
     try:
@@ -682,47 +794,68 @@ def _db_read(path: str) -> Tuple[Dict[Tuple[str, int, int, str], List[float]],
     for skey in sorted(pv, key=str):
         if isinstance(pv[skey], dict):
             prov.append(dict(pv[skey]))
-    for coll, per_n in (raw.get("samples") or {}).items():
-        if coll not in PORTFOLIO or not isinstance(per_n, dict):
-            continue
-        for nkey, per_algo in per_n.items():
-            if not (isinstance(per_algo, dict) and str(nkey).startswith("n")):
+
+    def read_samples(tree: dict, topo: str) -> None:
+        for coll, per_n in (tree.get("samples") or {}).items():
+            if coll not in PORTFOLIO or not isinstance(per_n, dict):
                 continue
-            n = int(str(nkey)[1:])
-            for algo, cells in per_algo.items():
-                if algo not in PORTFOLIO[coll] or not isinstance(cells, dict):
+            for nkey, per_algo in per_n.items():
+                if not (isinstance(per_algo, dict)
+                        and str(nkey).startswith("n")):
                     continue
-                for bkey, val in cells.items():
-                    cnt_s, _, mean_s = str(val).partition(":")
-                    try:
-                        cnt, mean = int(cnt_s), float(mean_s)
-                    except ValueError:
+                n = int(str(nkey)[1:])
+                for algo, cells in per_algo.items():
+                    if (algo not in PORTFOLIO[coll]
+                            or not isinstance(cells, dict)):
                         continue
-                    ent = samples.setdefault((coll, n, int(bkey), algo),
-                                             [0, 0.0])
-                    ent[0] += cnt
-                    ent[1] += cnt * mean
+                    for bkey, val in cells.items():
+                        cnt_s, _, mean_s = str(val).partition(":")
+                        try:
+                            cnt, mean = int(cnt_s), float(mean_s)
+                        except ValueError:
+                            continue
+                        ent = samples.setdefault(
+                            (topo, coll, n, int(bkey), algo), [0, 0.0])
+                        ent[0] += cnt
+                        ent[1] += cnt * mean
+
+    read_samples(raw, str(meta.get("topology") or ""))
+    topo_tree = raw.get("topo")
+    if isinstance(topo_tree, dict):
+        for tkey, sub in topo_tree.items():
+            if isinstance(sub, dict):
+                read_samples(sub, str(tkey))
     return samples, prov, meta
 
 
 def _write_db(path: str,
-              samples: Dict[Tuple[str, int, int, str], List[float]],
+              samples: Dict[Tuple[str, str, int, int, str], List[float]],
               overlay: Dict[Tuple[str, int], List[Tuple[int, str]]],
               provenance: List[dict], meta: Dict,
               min_samples: int) -> dict:
-    """Derive the ladders from the merged samples (min-samples guard
-    applied per cell), overlay sample-less measured-table ladders for
-    (coll, nranks) keys the samples don't cover, and persist the schema-2
-    DB atomically. Returns the merge record."""
+    """Derive per-topology ladders from the merged samples (min-samples
+    guard applied per cell), overlay sample-less measured-table ladders
+    for default-topology (coll, nranks) keys the samples don't cover, and
+    persist the schema-2 DB atomically. The meta topology's ladders and
+    samples keep the legacy top-level layout; every other topology's go
+    under ``[topo."<key>"...]``. Returns the merge record."""
+    default_topo = str(meta.get("topology") or "")
     rows: List[dict] = []
     skipped: List[Tuple] = []
-    for (c, n, b, a), (cnt, tot_us) in sorted(samples.items()):
+    by_topo_rows: Dict[str, List[dict]] = {}
+    for (topo, c, n, b, a), (cnt, tot_us) in sorted(samples.items()):
         if cnt < min_samples:
             skipped.append((c, n, b, a, int(cnt)))
             continue
-        rows.append({"coll": c, "nranks": n, "bytes": b, "algo": a,
-                     "count": int(cnt), "lat_us": round(tot_us / cnt, 3)})
-    table = _crossovers(rows)
+        row = {"topology": topo, "coll": c, "nranks": n, "bytes": b,
+               "algo": a, "count": int(cnt),
+               "lat_us": round(tot_us / cnt, 3)}
+        rows.append(row)
+        by_topo_rows.setdefault(topo, []).append(row)
+
+    tables: Dict[str, Dict[Tuple[str, int], List[Tuple[int, str]]]] = {
+        topo: _crossovers(trows) for topo, trows in by_topo_rows.items()}
+    table = tables.setdefault(default_topo, {})
     overlaid = []
     for k, ent in sorted(overlay.items()):
         if k not in table:
@@ -733,10 +866,26 @@ def _write_db(path: str,
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     lines = ["# tpu_mpi fleet tuning database (python -m tpu_mpi.tune merge)",
              "schema = 2"]
-    for (coll, n) in sorted(table):
-        lines.append(f"\n[{coll}.n{n}]")
-        for th, algo in sorted(table[(coll, n)]):
-            lines.append(f'"{th}" = "{algo}"')
+
+    def emit_ladders(tab: Dict, prefix: str) -> None:
+        for (coll, n) in sorted(tab):
+            lines.append(f"\n[{prefix}{coll}.n{n}]")
+            for th, algo in sorted(tab[(coll, n)]):
+                lines.append(f'"{th}" = "{algo}"')
+
+    def emit_samples(topo: str, prefix: str) -> None:
+        by_sec: Dict[Tuple[str, int, str],
+                     List[Tuple[int, int, float]]] = {}
+        for (t, c, n, b, a), (cnt, tot_us) in samples.items():
+            if t == topo:
+                by_sec.setdefault((c, n, a), []).append(
+                    (b, int(cnt), tot_us / cnt))
+        for (c, n, a) in sorted(by_sec):
+            lines.append(f"\n[{prefix}samples.{c}.n{n}.{a}]")
+            for b, cnt, mean in sorted(by_sec[(c, n, a)]):
+                lines.append(f'"{b}" = "{cnt}:{round(mean, 3)}"')
+
+    emit_ladders(table, "")
     lines.append("\n[meta]")
     for k in sorted(meta):
         v = meta[k]
@@ -748,18 +897,18 @@ def _write_db(path: str,
             v = ent[k]
             lines.append(f"{k} = {v}" if isinstance(v, int)
                          else f'{k} = "{v}"')
-    by_sec: Dict[Tuple[str, int, str], List[Tuple[int, int, float]]] = {}
-    for (c, n, b, a), (cnt, tot_us) in samples.items():
-        by_sec.setdefault((c, n, a), []).append((b, int(cnt), tot_us / cnt))
-    for (c, n, a) in sorted(by_sec):
-        lines.append(f"\n[samples.{c}.n{n}.{a}]")
-        for b, cnt, mean in sorted(by_sec[(c, n, a)]):
-            lines.append(f'"{b}" = "{cnt}:{round(mean, 3)}"')
+    emit_samples(default_topo, "")
+    for topo in sorted(tables):
+        if topo == default_topo:
+            continue
+        emit_ladders(tables[topo], f'topo."{topo}".')
+        emit_samples(topo, f'topo."{topo}".')
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         f.write("\n".join(lines) + "\n")
     os.replace(tmp, path)
 
+    all_topos = sorted({t for (t, *_rest) in samples} | {default_topo})
     return {"bench": "tune_merge", "db_path": path,
             "schema": 2, "meta": dict(meta),
             "min_samples": min_samples,
@@ -768,8 +917,13 @@ def _write_db(path: str,
             "skipped": [{"coll": c, "nranks": n, "bytes": b, "algo": a,
                          "count": cnt} for c, n, b, a, cnt in skipped],
             "overlaid": overlaid,
+            "topologies": all_topos,
             "table": {f"{c}.n{n}": {str(th): algo for th, algo in ent}
                       for (c, n), ent in table.items()},
+            "tables": {topo: {f"{c}.n{n}": {str(th): algo
+                                            for th, algo in ent}
+                              for (c, n), ent in tab.items()}
+                       for topo, tab in tables.items()},
             "provenance": provenance}
 
 
@@ -793,11 +947,24 @@ def merge_db(out_path: str, pvar_paths: Sequence[str] = (),
     if topology is not None:
         meta["topology"] = topology
     elif not meta.get("topology"):
-        meta["topology"] = f"single-host/{os.uname().machine}"
+        # the shared key helper — the same spelling the runtime stamps
+        # into pvar dump records, so merge and runtime can never disagree
+        meta["topology"] = topology_key()
     meta["merged_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    default_topo = str(meta["topology"])
+    # pre-topology DBs carry "" sample keys: they are the DB's own rows
+    for key in [k for k in samples if k[0] == ""]:
+        ent = samples.setdefault((default_topo,) + key[1:], [0, 0.0])
+        old = samples.pop(key)
+        ent[0] += old[0]
+        ent[1] += old[1]
 
     records = perfvars.load_dumps(pvar_paths) if pvar_paths else []
     for rec in records:
+        # dump records are stamped with the topology key of the world
+        # that produced them (perfvars.snapshot); unstamped legacy dumps
+        # fold into the DB's default fabric
+        rtopo = str(rec.get("topology") or "") or default_topo
         ncomms = 0
         for comm in rec.get("comms", ()):
             n = int(comm.get("size") or 0)
@@ -808,12 +975,12 @@ def merge_db(out_path: str, pvar_paths: Sequence[str] = (),
                 coll, algo = t["coll"], t["algo"]
                 if coll not in PORTFOLIO or algo not in PORTFOLIO[coll]:
                     continue
-                key = (coll, n, max(0, int(t["nbytes"])), algo)
+                key = (rtopo, coll, n, max(0, int(t["nbytes"])), algo)
                 ent = samples.setdefault(key, [0, 0.0])
                 ent[0] += int(t["count"])
                 ent[1] += float(t["total_s"]) * 1e6
         prov.append({"source": os.path.basename(rec["_path"]),
-                     "kind": "pvars", "comms": ncomms})
+                     "kind": "pvars", "comms": ncomms, "topology": rtopo})
     overlay: Dict[Tuple[str, int], List[Tuple[int, str]]] = {}
     for tp in table_paths:
         t = load_table(tp)
@@ -925,6 +1092,12 @@ def sentinel_main(argv: Optional[Sequence[str]] = None) -> int:
     for r in rec.get("rows", []):
         n = int(r["nranks"])
         if (want_n and n not in want_n) or r["coll"] not in SWEEP_COLLS:
+            continue
+        # topology-keyed records: a row measured on a foreign fabric (a
+        # different domain shape than this runner reproduces) is not
+        # replayable here and must not be judged here
+        rtopo = r.get("topology")
+        if rtopo and rtopo != topology_key(_active_domains(n), n):
             continue
         algos = pts.setdefault(n, {}).setdefault(
             (r["coll"], int(r["bytes"])), [])
@@ -1086,7 +1259,10 @@ def autotune(nranks_list: Sequence[int] = (2, 4, 8),
             npts = sum(len(p[2]) for p in points)
             print(f"tune: sweeping {npts} (coll, size, algo) points "
                   f"on {n} ranks ...", file=sys.stderr)
-        rows.extend(_run_sweep(n, points, scale))
+        tkey = topology_key(_active_domains(n), n)
+        for r in _run_sweep(n, points, scale):
+            r.setdefault("topology", tkey)
+            rows.append(r)
 
     table = _crossovers(rows)
     # selection audit: what the freshly-written table picks at every

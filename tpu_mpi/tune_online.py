@@ -147,7 +147,8 @@ class Online:
 
     def decide(self, comm, coll: str, nbytes: Optional[int], steady: str, *,
                commutative: bool = False, elementwise: bool = False,
-               numeric: bool = True, shm: bool = False) -> str:
+               numeric: bool = True, shm: bool = False,
+               domains: int = 0) -> str:
         """One algorithm decision on the live path: returns ``steady`` or,
         on this key's deterministic exploration slots, the seeded eligible
         alternate. Ticks the lockstep counters and runs the table-swap
@@ -179,7 +180,8 @@ class Online:
         if ei > int((c - 1) * self.eps):
             alts = [a for a in tune.candidates(
                         coll, nranks, nbytes, commutative=commutative,
-                        elementwise=elementwise, shm=shm, numeric=numeric)
+                        elementwise=elementwise, shm=shm, numeric=numeric,
+                        domains=domains)
                     if a != steady]
             if alts:
                 h = zlib.crc32(
