@@ -1,0 +1,182 @@
+"""Bounded chaos run for the elastic serve tier.
+
+One warm elastic broker with per-rank sidecar processes
+(``tpu_mpi.elastic.sidecar``); tenant traffic flows while the driver
+SIGKILLs a victim rank's sidecar. The sidecar watcher delivers the
+failure-detector verdict, the pool serves DEGRADED (survivor tenants keep
+streaming; ops spanning the dead rank surface the typed retriable
+``PoolDegradedError``), and the elastic controller then shrinks, spawns a
+replacement, Intercomm_merges it back, and rebinds the affected leases.
+
+Asserted end to end, with a bounded wall clock:
+
+- the kill is observed (failure counted, degraded flag raised);
+- the pool is restored to full size and leaves degraded mode;
+- ZERO dropped tenants: every traffic worker finishes its op budget with
+  only retriable typed errors along the way, and every lease survives;
+- the recorded resize trace passes ``analyze.verify_trace`` AND
+  ``analyze explore`` (the rebind rounds are real barriers the schedule
+  explorer models) with no diagnostics.
+
+Exit codes (the launcher's elastic vocabulary, tpu_mpi/launcher.py):
+``EXIT_RESIZED_OK`` (67) — ranks were lost and fully restored;
+``EXIT_DEGRADED`` (68) — ranks were lost and the pool is still degraded;
+``1`` — any other failed assertion.
+
+Run:
+    python benchmarks/elastic_chaos.py [--nranks 3] [--tenants 3]
+        [--budget 120]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# fast detector + controller for a bounded run; sidecars give the thread
+# tier a kill-able per-rank process to SIGKILL
+os.environ.setdefault("TPU_MPI_ELASTIC_INTERVAL_MS", "50")
+os.environ.setdefault("TPU_MPI_ELASTIC_COOLDOWN_MS", "0")
+os.environ["TPU_MPI_ELASTIC_SIDECARS"] = "1"
+os.environ["TPU_MPI_TRACE"] = "1"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--nranks", type=int, default=3)
+    ap.add_argument("--tenants", type=int, default=3)
+    ap.add_argument("--ops", type=int, default=40,
+                    help="allreduces per tenant")
+    ap.add_argument("--budget", type=float, default=120.0,
+                    help="wall-clock bound for the whole run (s)")
+    args = ap.parse_args()
+    deadline = time.monotonic() + args.budget
+
+    import numpy as np
+
+    from tpu_mpi import analyze, config, serve
+    from tpu_mpi.analyze.explore import explore
+    from tpu_mpi.error import PoolDegradedError, ServeBusyError
+    from tpu_mpi.launcher import EXIT_DEGRADED, EXIT_RESIZED_OK
+
+    config.load(refresh=True)
+    broker = serve.Broker(nranks=args.nranks, token="chaos", elastic=True)
+    broker.run_in_thread()
+    victim = args.nranks - 1
+    lock = threading.Lock()
+    stats = {"ops": 0, "retriable": 0, "dropped": 0}
+    stop = threading.Event()
+
+    def tenant(i: int) -> None:
+        part = np.arange(64, dtype=np.float64) + i
+        try:
+            s = serve.attach(broker.address, token="chaos", tenant=f"t{i}")
+        except Exception:
+            with lock:
+                stats["dropped"] += 1
+            return
+        try:
+            done = 0
+            while done < args.ops and time.monotonic() < deadline:
+                try:
+                    out = s.allreduce(part)
+                    assert np.allclose(out, part * len(s.ranks))
+                    done += 1
+                    with lock:
+                        stats["ops"] += 1
+                except (PoolDegradedError, ServeBusyError):
+                    with lock:
+                        stats["retriable"] += 1
+                    time.sleep(0.05)    # typed + retriable: ride it out
+                time.sleep(0.01)
+            if done < args.ops:
+                with lock:
+                    stats["dropped"] += 1
+        except Exception as e:          # noqa: BLE001 - non-retriable = drop
+            print(f"tenant t{i} dropped: {e!r}", file=sys.stderr)
+            with lock:
+                stats["dropped"] += 1
+        finally:
+            s.detach()
+
+    threads = [threading.Thread(target=tenant, args=(i,))
+               for i in range(args.tenants)]
+    for t in threads:
+        t.start()
+
+    ok = True
+    try:
+        time.sleep(0.4)                 # traffic in full swing
+        pid = broker.sidecars.pid_of(victim)
+        print(f"SIGKILL rank {victim}'s sidecar (pid {pid}) mid-traffic")
+        os.kill(pid, signal.SIGKILL)
+
+        # 1) the kill is observed: failure counted, degraded raised
+        while time.monotonic() < deadline:
+            if broker.elastic_state["failures"] >= 1:
+                break
+            time.sleep(0.01)
+        if broker.elastic_state["failures"] < 1:
+            print("FAIL: sidecar death never became a failure verdict")
+            ok = False
+
+        # 2) restore: resize ran, pool back at full size, degraded cleared
+        while ok and time.monotonic() < deadline:
+            if (broker.elastic_state["resizes"] >= 1
+                    and not (broker.pool.failed - broker.pool.retired)
+                    and len(broker.pool.healthy()) == args.nranks):
+                break
+            time.sleep(0.01)
+        restored = (broker.elastic_state["resizes"] >= 1
+                    and not (broker.pool.failed - broker.pool.retired)
+                    and len(broker.pool.healthy()) == args.nranks)
+        last = broker.elastic_state.get("last_resize") or {}
+        print(f"resize: {last.get('reason')} in "
+              f"{last.get('duration_ms', 0):.0f} ms, grew "
+              f"{last.get('grew', 0)}, rebinds {last.get('rebinds', 0)}")
+
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        stop.set()
+        alive = [t for t in threads if t.is_alive()]
+        if alive:
+            print(f"FAIL: {len(alive)} tenant worker(s) hung past budget")
+            ok = False
+        if stats["dropped"]:
+            print(f"FAIL: {stats['dropped']} dropped tenant(s)")
+            ok = False
+        print(f"traffic: {stats['ops']} ops, {stats['retriable']} retriable "
+              f"errors, {stats['dropped']} dropped tenants")
+
+        # 3) the recorded resize trace is schedule-clean
+        tr = analyze.last_trace()
+        diags = analyze.verify_trace(tr)
+        res = explore(tr, max_schedules=200)
+        for d in list(diags) + list(res.diagnostics):
+            print(f"TRACE: {d}")
+            ok = False
+        print(f"trace: {len(diags)} verifier + {len(res.diagnostics)} "
+              f"explore diagnostics over {res.schedules} schedule(s)")
+    finally:
+        broker.close()
+
+    if not ok:
+        return 1
+    if restored:
+        print(f"fully restored: exit {EXIT_RESIZED_OK}")
+        return EXIT_RESIZED_OK
+    print(f"still degraded at budget: exit {EXIT_DEGRADED}")
+    return EXIT_DEGRADED
+
+
+if __name__ == "__main__":
+    sys.exit(main())

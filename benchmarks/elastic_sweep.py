@@ -1,0 +1,229 @@
+"""Availability sweep through a scripted elastic shrink+grow.
+
+Open-loop offered load against one warm serve broker (same load-gen shape
+as infer_sweep.py: thread per arrival, own tenant lease, arrivals never
+wait on service). Mid-run the script delivers a failure-detector verdict
+for the highest pool rank; the elastic controller shrinks the dead rank
+out, GROWs a replacement, and rebinds leases while traffic keeps flowing.
+Reported:
+
+- **attach availability**: attaches attempted vs landed (attaches during
+  the resize park on the broker's resize gate — they must land late, not
+  fail) and attach p50/p99;
+- **op p50/p99 latency**, split into steady-state vs during-resize (an op
+  whose interval overlaps the failure→restored window), plus the
+  during/steady p99 ratio the CI ``elastic`` job gates on;
+- degraded-window behaviour: retriable typed errors seen
+  (:class:`~tpu_mpi.error.PoolDegradedError` / ServeBusyError), retries
+  spent, and **dropped tenants** (a worker whose session failed
+  non-retriably) — which must be zero;
+- the broker's own resize record (reason, duration, rebinds).
+
+Run:
+    python benchmarks/elastic_sweep.py [--rps 30] [--duration 6]
+        [--nranks 4] [--json benchmarks/results/elastic-resize-cpusim.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def pctl(xs: list, q: float):
+    if not xs:
+        return None
+    ys = sorted(xs)
+    return ys[min(len(ys) - 1, int(q * len(ys)))]
+
+
+def run_sweep(broker, rps: float, duration_s: float, nbytes: int,
+              ops_per_tenant: int, max_clients: int, kill_rank: int,
+              kill_at_s: float, op_interval_s: float = 0.005) -> dict:
+    import numpy as np
+
+    from tpu_mpi import serve
+    from tpu_mpi.error import PoolDegradedError, ServeBusyError
+
+    n = max(1, int(round(rps * duration_s)))
+    gate = threading.Semaphore(max_clients)
+    lock = threading.Lock()
+    attach_ms, op_spans, retriable, dropped = [], [], [0], [0]
+    window = {"start": None, "end": None}
+    part = __import__("numpy").arange(nbytes // 8, dtype="float64")
+
+    def worker(i: int) -> None:
+        t_start = run_sweep._t0
+        delay = i / rps - (time.perf_counter() - t_start)
+        if delay > 0:
+            time.sleep(delay)
+        with gate:
+            try:
+                ta = time.perf_counter()
+                s = serve.attach(broker.address, token=broker.token,
+                                 tenant=f"el{i}")
+                with lock:
+                    attach_ms.append((time.perf_counter() - ta) * 1e3)
+            except Exception:
+                with lock:
+                    dropped[0] += 1     # an attach that never lands = drop
+                return
+            try:
+                done = 0
+                deadline = time.perf_counter() + 30
+                while done < ops_per_tenant and time.perf_counter() < deadline:
+                    t0 = time.perf_counter()
+                    try:
+                        out = s.allreduce(part)
+                        assert np.array_equal(out, part * len(s.ranks))
+                        with lock:
+                            op_spans.append((t0, time.perf_counter()))
+                        done += 1
+                    except (PoolDegradedError, ServeBusyError):
+                        # the degraded window's typed retriable errors:
+                        # back off and ride through the resize
+                        with lock:
+                            retriable[0] += 1
+                        time.sleep(0.05)
+                    # pacing keeps the lease alive across the resize so
+                    # rebinds (not just fresh attaches) are exercised
+                    time.sleep(op_interval_s)
+                if done < ops_per_tenant:
+                    with lock:
+                        dropped[0] += 1
+            except Exception:
+                with lock:
+                    dropped[0] += 1
+            finally:
+                s.detach()
+
+    def chaos() -> None:
+        time.sleep(kill_at_s)
+        window["start"] = time.perf_counter()
+        broker.on_rank_failure(kill_rank)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if (broker.elastic_state["resizes"] >= 1
+                    and not (broker.pool.failed - broker.pool.retired)):
+                break
+            time.sleep(0.01)
+        window["end"] = time.perf_counter()
+
+    run_sweep._t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    killer = threading.Thread(target=chaos)
+    for t in threads:
+        t.start()
+    killer.start()
+    for t in threads:
+        t.join(timeout=300)
+    killer.join(timeout=120)
+
+    w0, w1 = window["start"], window["end"]
+    if w0 is not None and w1 is not None:
+        w0 -= op_interval_s                 # pad: ops straddling the edges
+        w1 += op_interval_s
+    steady, during = [], []
+    for t0, t1 in op_spans:
+        lat = (t1 - t0) * 1e3
+        if w0 is not None and w1 is not None and t1 >= w0 and t0 <= w1:
+            during.append(lat)
+        else:
+            steady.append(lat)
+    p99_steady = pctl(steady, 0.99)
+    p99_during = pctl(during, 0.99)
+    return {
+        "offered_load_rps": rps, "tenants": n,
+        "attaches_ok": len(attach_ms),
+        "attach_availability": round(len(attach_ms) / n, 4),
+        "attach_p50_ms": pctl(attach_ms, 0.50),
+        "attach_p99_ms": pctl(attach_ms, 0.99),
+        "ops_steady": len(steady), "ops_during_resize": len(during),
+        "p50_steady_ms": pctl(steady, 0.50),
+        "p99_steady_ms": p99_steady,
+        "p50_during_resize_ms": pctl(during, 0.50),
+        "p99_during_resize_ms": p99_during,
+        "p99_during_over_steady": (round(p99_during / p99_steady, 3)
+                                   if p99_during and p99_steady else None),
+        "retriable_errors": retriable[0],
+        "dropped_tenants": dropped[0],
+        "resize_window_s": (round(w1 - w0, 3)
+                            if w0 is not None and w1 is not None else None),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--nranks", type=int, default=4)
+    ap.add_argument("--rps", type=float, default=30.0)
+    ap.add_argument("--duration", type=float, default=6.0)
+    ap.add_argument("--nbytes", type=int, default=1 << 12)
+    ap.add_argument("--ops-per-tenant", type=int, default=10)
+    ap.add_argument("--op-interval", type=float, default=0.005)
+    ap.add_argument("--max-clients", type=int, default=32)
+    ap.add_argument("--json", default=None,
+                    help="write results JSON here (e.g. "
+                         "benchmarks/results/elastic-resize-cpusim.json)")
+    args = ap.parse_args()
+
+    os.environ.setdefault("TPU_MPI_ELASTIC_INTERVAL_MS", "50")
+    os.environ.setdefault("TPU_MPI_ELASTIC_COOLDOWN_MS", "0")
+    from tpu_mpi import config, serve
+    config.load(refresh=True)
+    broker = serve.Broker(nranks=args.nranks, token="bench",
+                          max_tenants=args.max_clients + 8, elastic=True)
+    broker.run_in_thread()
+    try:
+        # one warmup attach absorbs client/pool one-offs
+        s = serve.attach(broker.address, token="bench", tenant="warm")
+        s.allreduce(__import__("numpy").ones(8))
+        s.detach()
+        point = run_sweep(broker, args.rps, args.duration, args.nbytes,
+                          args.ops_per_tenant, args.max_clients,
+                          kill_rank=args.nranks - 1,
+                          kill_at_s=args.duration / 3.0,
+                          op_interval_s=args.op_interval)
+        resize = dict(broker.elastic_state.get("last_resize") or {})
+        state = {k: broker.elastic_state[k]
+                 for k in ("resizes", "rebinds", "failures")}
+    finally:
+        broker.close()
+
+    print(f"attach availability {point['attach_availability']:.2%} "
+          f"({point['attaches_ok']}/{point['tenants']}), "
+          f"dropped tenants {point['dropped_tenants']}")
+    print(f"op p99 steady {point['p99_steady_ms'] or 0:.1f} ms, "
+          f"during resize {point['p99_during_resize_ms'] or 0:.1f} ms "
+          f"(ratio {point['p99_during_over_steady']}), "
+          f"{point['retriable_errors']} retriable errors")
+    if resize:
+        print(f"resize: {resize.get('reason')} in "
+              f"{resize.get('duration_ms', 0):.0f} ms, "
+              f"{resize.get('rebinds', 0)} lease rebind(s)")
+    record = {
+        "benchmark": "elastic-resize", "substrate": "cpu-sim",
+        "nranks": args.nranks, "nbytes": args.nbytes,
+        "duration_s": args.duration, "point": point,
+        "resize": resize, "elastic": state,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    if args.json:
+        os.makedirs(os.path.dirname(args.json), exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(record, f, indent=1)
+        print(f"wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,24 @@
+# nprocs: 4
+#
+# Clean fixture: a well-formed two-phase elastic rebind window. Ranks
+# {0,1,2} are the post-shrink survivor pool — every one of them records
+# BOTH the quiesce and the resume round, declaring exactly the ranks
+# that rendezvous. Rank 3 is outside the pool (think: a retired spare);
+# it appears in the trace via the closing world barrier but is not
+# declared, so T214 has nothing to hold it to. Must produce zero
+# diagnostics.
+import tpu_mpi as MPI
+from tpu_mpi.elastic import rebind_round
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+
+pool = MPI.Comm_split(comm, 0 if rank < 3 else 1, rank)
+
+if rank < 3:
+    declared = (0, 1, 2)
+    rebind_round(pool, "quiesce", epoch=1, declared=declared)
+    # ... the controller remaps leases here ...
+    rebind_round(pool, "resume", epoch=1, declared=declared)
+
+MPI.Barrier(comm)

@@ -1,0 +1,22 @@
+# nprocs: 4
+#
+# Defect class: a rank skips the elastic quiesce round. Ranks {0,1,2}
+# record the quiesce barrier declaring ranks (0,1,2,3), but rank 3 —
+# alive and visible in the trace via the closing world barrier — never
+# records it. In a real resize that rank can still be executing (or
+# about to execute) ops against the OLD rank map while the controller
+# remaps leases: the exact race the two-phase protocol exists to
+# exclude. The run itself completes (the barrier comm spans only
+# {0,1,2}), so only the T214 trace check catches it.
+import tpu_mpi as MPI
+from tpu_mpi.elastic import rebind_round
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+
+pool = MPI.Comm_split(comm, 0 if rank < 3 else 1, rank)
+
+if rank < 3:
+    rebind_round(pool, "quiesce", epoch=1, declared=(0, 1, 2, 3))  # trace: T214
+
+MPI.Barrier(comm)

@@ -1,0 +1,375 @@
+"""Elastic capacity (tpu_mpi.elastic, docs/fault-tolerance.md "Elastic
+recovery"): autoscaling pool resize with degraded-pool serving.
+
+Layout mirrors the subsystem:
+
+- **Primitives**: FairQueue pause/resume holds dispatch without dropping
+  ops; PoolDegradedError survives the wire round trip typed + retriable.
+- **Degraded serving**: after a failure-detector verdict the broker keeps
+  surviving tenants streaming bitwise-correct results while ops spanning
+  the dead rank get the typed retriable error, and STATS re-advertises
+  the reduced headroom.
+- **Restore (GROW)**: the controller shrinks out the dead rank, spawns a
+  replacement, Intercomm_merges it in, and rebinds the affected lease —
+  same session, same cids, books intact, zero dropped tenants.
+- **Rebind edges**: attach during a resize parks on the gate and lands
+  after; revocation racing the rebind is skipped cleanly; an SLO'd
+  request straddling a resize window is evicted typed, and the session
+  retries fine.
+- **Controller**: the pressure/idle signal machinery — hysteresis grows
+  the pool under sustained depth, the idle path drains-and-retires a
+  spare rank, and both land in the stats elastic section.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_mpi import config, serve
+from tpu_mpi.elastic import ElasticController
+from tpu_mpi.error import (PoolDegradedError, SessionError, SLOExpiredError)
+from tpu_mpi.serve import protocol
+from tpu_mpi.serve.queueing import FairQueue
+
+
+class FakeOp:
+    def __init__(self, tenant, nbytes, tag=None):
+        self.tenant = tenant
+        self.nbytes = nbytes
+        self.tag = tag
+
+
+def _attach(broker, **kw):
+    kw.setdefault("token", "hunter2")
+    return serve.attach(broker.address, **kw)
+
+
+def _elastic_env(monkeypatch, **kw):
+    """Set TPU_MPI_ELASTIC_* knobs and refresh the config snapshot."""
+    defaults = {"INTERVAL_MS": "3600000",   # loop idles; tests drive ticks
+                "COOLDOWN_MS": "0"}
+    defaults.update(kw)
+    for k, v in defaults.items():
+        monkeypatch.setenv(f"TPU_MPI_ELASTIC_{k}", str(v))
+    config.load(refresh=True)
+
+
+@pytest.fixture
+def cfg_reset():
+    yield
+    config.load(refresh=True)
+
+
+# ---------------------------------------------------------------------------
+# Primitives: queue pause/resume, typed error over the wire
+# ---------------------------------------------------------------------------
+
+def test_fairqueue_pause_holds_dispatch_without_dropping():
+    fq = FairQueue(quantum=1 << 16, max_depth=8, max_inflight=8)
+    fq.add_tenant("t")
+    fq.submit(FakeOp("t", 8, "a"))
+    fq.pause()
+    assert fq.stats()["paused"] is True
+    assert fq.submit(FakeOp("t", 8, "b")) is None   # submit still lands
+    assert fq.pop(timeout=0.05) is None             # but nothing dispatches
+    assert fq.stats()["tenants"]["t"]["queued"] == 2
+    fq.resume()
+    assert fq.stats()["paused"] is False
+    got = {fq.pop(timeout=1.0).tag for _ in range(2)}
+    assert got == {"a", "b"}                        # nothing dropped
+
+
+def test_fairqueue_inflight_total_counts_undrained_ops():
+    fq = FairQueue(quantum=1 << 16, max_depth=8, max_inflight=8)
+    fq.add_tenant("t")
+    fq.submit(FakeOp("t", 8))
+    op = fq.pop(timeout=1.0)
+    assert fq.inflight_total() == 1
+    fq.complete(op)
+    assert fq.inflight_total() == 0
+
+
+def test_pool_degraded_error_round_trips_typed_and_retriable():
+    e = PoolDegradedError("pool lost ranks", tenant="t", dead=(2, 5),
+                          headroom=6)
+    meta = protocol.error_meta(e)
+    with pytest.raises(PoolDegradedError) as ei:
+        protocol.raise_for_error(meta)
+    got = ei.value
+    assert got.retriable is True
+    assert got.tenant == "t"
+    assert got.dead == (2, 5)
+    assert got.headroom == 6
+
+
+# ---------------------------------------------------------------------------
+# Degraded-pool serving: survivors stream, spanning ops get typed errors
+# ---------------------------------------------------------------------------
+
+def test_degraded_pool_survivors_stream_spanning_ops_typed():
+    b = serve.Broker(nranks=4, token="hunter2")
+    b.run_in_thread()
+    try:
+        wide = _attach(b, tenant="wide", nranks=4)
+        narrow = _attach(b, tenant="narrow", nranks=2)
+        try:
+            assert np.array_equal(wide.allreduce(np.ones(8)),
+                                  np.full(8, 4.0))
+            # failure-detector verdict: rank 3 died
+            b.on_rank_failure(3)
+            # an op spanning the dead rank: typed, retriable, names the
+            # dead ranks and the remaining headroom
+            with pytest.raises(PoolDegradedError) as ei:
+                wide.allreduce(np.ones(8))
+            assert ei.value.retriable is True
+            assert 3 in ei.value.dead
+            assert ei.value.headroom == 3
+            # the survivor tenant keeps streaming, bitwise correct
+            for _ in range(4):
+                assert np.array_equal(narrow.allreduce(np.ones(4)),
+                                      np.full(4, 2.0))
+            # a new attach cannot get more ranks than the headroom...
+            with pytest.raises(PoolDegradedError):
+                _attach(b, tenant="greedy", nranks=4)
+            # ...but an attach inside the headroom lands and works
+            fit = _attach(b, tenant="fit", nranks=3)
+            try:
+                assert np.array_equal(fit.allreduce(np.ones(4)),
+                                      np.full(4, 3.0))
+            finally:
+                fit.detach()
+            # STATS re-advertises the degraded pool
+            ela = b.stats()["elastic"]
+            assert ela["degraded"] is True
+            assert ela["failed"] == [3]
+            assert ela["headroom"] == 3
+            assert b.elastic_state["failures"] == 1
+        finally:
+            narrow.detach()
+            wide.detach()
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Restore: shrink + GROW + rebind, zero dropped tenants
+# ---------------------------------------------------------------------------
+
+def test_restore_resize_rebinds_lease_zero_drop(monkeypatch, cfg_reset):
+    """The tentpole loop minus the autoscaler timer: a rank dies under an
+    attached tenant; the controller (kicked by the failure) shrinks,
+    spawns a replacement, merges it in, and rebinds the lease. The SAME
+    session keeps working on the SAME cids; books and rebind counters
+    show the ride-through."""
+    _elastic_env(monkeypatch)
+    b = serve.Broker(nranks=3, token="hunter2", elastic=True)
+    b.run_in_thread()
+    try:
+        s = _attach(b, tenant="rider", nranks=3)
+        try:
+            cid = s.comm.cid
+            assert np.array_equal(s.allreduce(np.ones(8)), np.full(8, 3.0))
+            b.on_rank_failure(2)          # kicks the controller
+            deadline = time.monotonic() + 60
+            while (b.elastic_state["resizes"] < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert b.elastic_state["resizes"] == 1, b.elastic_state
+            last = b.elastic_state["last_resize"]
+            assert last["reason"] == "rank failure"
+            assert last["shrunk"] == 1 and last["grew"] == 1
+            assert last["rebinds"] == 1 and last["duration_ms"] > 0
+            # pool restored: no longer degraded, full headroom again
+            ela = b.stats()["elastic"]
+            assert ela["degraded"] is False
+            assert ela["pool_size"] == 3
+            # the lease moved onto the replacement rank, same cid
+            lease = b._leases["rider"]
+            assert 2 not in lease.group
+            assert len(lease.group) == 3
+            assert s.comm.cid == cid
+            # the SAME session keeps computing, bitwise correct
+            assert np.array_equal(s.allreduce(np.ones(8)), np.full(8, 3.0))
+            # books rode through: rebind counted, nothing dropped
+            rep = b.ledger.report()["tenants"]["rider"]
+            assert rep["rebinds"] == 1
+            assert rep["revoked"] is False and rep["detached"] is False
+            assert rep["admitted_ops"] == 2
+        finally:
+            s.detach()
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Rebind edges
+# ---------------------------------------------------------------------------
+
+def test_attach_during_resize_parks_on_gate_then_lands():
+    b = serve.Broker(nranks=2, token="hunter2")
+    b.run_in_thread()
+    try:
+        b._resize_gate.clear()            # a resize is in flight
+        out = {}
+
+        def attacher():
+            try:
+                out["s"] = _attach(b, tenant="late")
+            except BaseException as e:    # noqa: BLE001
+                out["err"] = e
+
+        th = threading.Thread(target=attacher)
+        th.start()
+        time.sleep(0.3)
+        assert th.is_alive() and not out  # parked, not rejected
+        b._resize_gate.set()              # resize finished
+        th.join(timeout=30)
+        assert "err" not in out, out
+        s = out["s"]
+        try:
+            assert np.array_equal(s.allreduce(np.ones(4)), np.full(4, 2.0))
+        finally:
+            s.detach()
+    finally:
+        b.close()
+
+
+def test_revocation_racing_rebind_is_skipped(monkeypatch, cfg_reset):
+    _elastic_env(monkeypatch)
+    b = serve.Broker(nranks=2, token="hunter2")
+    b.run_in_thread()
+    ctrl = ElasticController(b)           # not started: driven by hand
+    try:
+        s = _attach(b, tenant="gone", nranks=2)
+        lease = b._leases["gone"]
+        with b._lease_lock:
+            lease.revoked = True          # revocation won the race
+        assert ctrl._rebind_leases({1: 7}) == 0
+        assert lease.group == (0, 1)      # untouched: revocation settled it
+        with b._lease_lock:
+            lease.revoked = False
+        s.detach()
+        # a detached lease is gone from the table entirely: also skipped
+        assert ctrl._rebind_leases({1: 7}) == 0
+    finally:
+        b.close()
+
+
+def test_slo_eviction_across_resize_boundary(monkeypatch, cfg_reset):
+    """A generate admitted just before a resize window straddles it: the
+    scheduler parks at the step boundary for the quiesce, the SLO expires
+    inside the window, and after resume the request is evicted TYPED —
+    the session retries successfully on the resized pool."""
+    monkeypatch.setenv("TPU_MPI_INFER_SLO_MS", "200")
+    _elastic_env(monkeypatch)
+    b = serve.Broker(nranks=2, token="hunter2", infer={"max_batch": 1})
+    b.run_in_thread()
+    ctrl = ElasticController(b)
+
+    def slow_round(op, epoch, _orig=ctrl._round):
+        if op == "resume":
+            time.sleep(0.3)               # the SLO (200 ms) expires in here
+        _orig(op, epoch)
+
+    ctrl._round = slow_round
+    try:
+        hog_out = {}
+
+        def hog():
+            with _attach(b, tenant="hog") as hs:
+                hog_out["toks"] = hs.generate(list(range(1, 8)),
+                                              max_new=120)
+
+        hog_th = threading.Thread(target=hog)
+        hog_th.start()
+        time.sleep(0.05)                  # hog occupies the only batch slot
+        with _attach(b, tenant="straddler") as s:
+            out = {}
+
+            def victim():
+                try:
+                    out["toks"] = s.generate([1, 2, 3], max_new=10)
+                except BaseException as e:          # noqa: BLE001
+                    out["err"] = e
+
+            th = threading.Thread(target=victim)
+            th.start()
+            time.sleep(0.02)              # victim queued behind the hog
+            ctrl.resize("queue pressure")  # no-op grow: pure pause window
+            th.join(timeout=60)
+            hog_th.join(timeout=120)
+            assert isinstance(out.get("err"), SLOExpiredError), out
+            assert out["err"].retriable is True
+            # same session, post-resize pool: retry completes
+            assert len(s.generate([1, 2, 3], max_new=3)) == 3
+        assert len(hog_out["toks"]) == 120   # the hog rode through the resize
+        assert b.stats()["infer"]["slo_evictions"] >= 1
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Controller: pressure grow, idle retire, signals
+# ---------------------------------------------------------------------------
+
+def test_controller_pressure_hysteresis_grows_then_idle_retires(
+        monkeypatch, cfg_reset):
+    _elastic_env(monkeypatch, HYSTERESIS="2", DEPTH_HIGH="2",
+                 MAX_RANKS="3", IDLE_TICKS="2", MIN_RANKS="2")
+    b = serve.Broker(nranks=2, token="hunter2")
+    b.run_in_thread()
+    # starve the dispatcher so fake queue pressure stays queued
+    b.fq.pop = lambda timeout=0.2: time.sleep(0.01)
+    ctrl = ElasticController(b)
+    try:
+        b.fq.add_tenant("x")
+        b.fq.submit(FakeOp("x", 8))
+        b.fq.submit(FakeOp("x", 8))
+        ctrl._tick()                      # 1st pressured tick: hysteresis
+        sig = b.elastic_state["signals"]
+        assert sig["depth"] == 2 and sig["pressure_ticks"] == 1
+        assert b.elastic_state["resizes"] == 0
+        ctrl._tick()                      # 2nd: grow
+        assert b.elastic_state["resizes"] == 1
+        assert b.elastic_state["last_resize"]["reason"] == "queue pressure"
+        assert b.elastic_state["last_resize"]["grew"] == 1
+        assert b.pool.healthy() == [0, 1, 2]
+        assert ctrl.target == 3
+        # drain the fake pressure; two idle ticks retire the unleased spare
+        b.fq.remove_tenant("x")
+        ctrl._tick()
+        ctrl._tick()
+        assert b.elastic_state["resizes"] == 2
+        last = b.elastic_state["last_resize"]
+        assert last["reason"] == "idle retire"
+        assert last["shrunk"] == 1 and last["grew"] == 0
+        assert len(b.pool.healthy()) == 2
+        # administrative retire is NOT a degraded pool
+        assert b.stats()["elastic"]["degraded"] is False
+        # the resized pool still serves: a real tenant attaches and runs
+        with _attach(b, tenant="after", nranks=2) as s:
+            assert np.array_equal(s.allreduce(np.ones(4)), np.full(4, 2.0))
+    finally:
+        b.close()
+
+
+def test_stats_cli_payload_carries_elastic_section(monkeypatch, cfg_reset):
+    """Satellite: `tpurun --serve --stats` is the JSON from _stats_client —
+    it must carry the elastic section (pool size, target, degraded flag,
+    last resize, rebind counts)."""
+    _elastic_env(monkeypatch)
+    b = serve.Broker(nranks=2, token="hunter2", elastic=True)
+    b.run_in_thread()
+    try:
+        from tpu_mpi.serve.broker import _stats_client
+        stats = _stats_client(b.address, "hunter2")
+        ela = stats["elastic"]
+        assert ela["enabled"] is True
+        assert ela["pool_size"] == 2 and ela["target_size"] == 2
+        assert ela["degraded"] is False
+        assert ela["resizes"] == 0 and ela["rebinds"] == 0
+        assert ela["last_resize"] is None
+    finally:
+        b.close()

@@ -4,6 +4,7 @@ test/spawned_worker.jl, test/test_universe_size.jl)."""
 import os
 
 import numpy as np
+import pytest
 
 import tpu_mpi as MPI
 from tpu_mpi.testing import run_spmd
@@ -147,3 +148,90 @@ def test_universe_size(nprocs):
         assert usize is None or usize >= 1
 
     run_spmd(body, nprocs)
+
+
+# ---------------------------------------------------------------------------
+# GROW: spawn + merge into a SHRUNK world (tpu_mpi.elastic substrate)
+# ---------------------------------------------------------------------------
+
+def test_merge_into_shrunk_world_adopts_epochs():
+    """Elastic GROW substrate: a world that lost rank 2 shrinks to {0,1},
+    spawns one replacement, and Intercomm_merges with it. The replacement
+    must adopt the survivors' agreement-epoch space — a later agree/shrink
+    on a surviving communicator derives the same epoch (and so the same
+    shrink cid) on old and new ranks alike — and the merged pool must be
+    fully usable while ``failed_ranks`` is still non-empty."""
+    def worker():
+        MPI.Init()
+        parent = MPI.Comm_get_parent()
+        assert parent is not MPI.COMM_NULL
+        merged = MPI.Intercomm_merge(parent, True)
+        out = MPI.Allreduce(np.array([1.0]), MPI.SUM, merged)
+        assert out[0] == 3.0
+        MPI.Barrier(merged)
+        MPI.Finalize()
+
+    def body():
+        world = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(world)
+        ctx = world.ctx
+        MPI.Barrier(world)
+        if rank == 0:
+            ctx.peer_failed(2)          # failure-detector verdict: rank 2 died
+        # ALL three ranks join the shrink rendezvous: the thread tier's
+        # ft_agree spans the full group, so the declared-dead rank's (still
+        # alive) thread is conscripted one last time, then steps aside
+        shrunk = MPI.Comm_shrink(world)
+        if rank == 2:
+            assert shrunk.group == ()    # COMM_NULL: not a survivor
+            return
+        assert shrunk.group == (0, 1)
+        # establish a non-trivial epoch on the survivor comm pre-merge
+        assert MPI.Comm_agree(shrunk, 1) == 1
+        epoch = ctx._agree_seq[(shrunk.cid, 0)]
+        inter = MPI.Comm_spawn(worker, None, 1, shrunk)
+        merged = MPI.Intercomm_merge(inter, False)
+        assert MPI.Comm_size(merged) == 3
+        # survivors low, replacement high: comm-relative order preserved
+        assert merged.group[:2] == (0, 1)
+        new_wr = merged.group[-1]
+        assert new_wr not in (0, 1, 2)
+        # the joiner adopted the survivors' epoch for the shrunk comm
+        assert ctx._agree_seq[(shrunk.cid, new_wr)] == epoch
+        out = MPI.Allreduce(np.array([1.0]), MPI.SUM, merged)
+        assert out[0] == 3.0
+        MPI.Barrier(merged)
+
+    run_spmd(body, 3)
+
+
+def test_merge_epoch_mismatch_is_loud():
+    """Merging groups whose agree/shrink histories diverged would fork the
+    shrink-cid space — that must be a loud MPIError at the merge, never a
+    silent adoption of either side's epochs."""
+    def worker():
+        MPI.Init()
+        parent = MPI.Comm_get_parent()
+        MPI.Intercomm_merge(parent, True)    # parents' histories diverged
+        MPI.Finalize()
+
+    def body():
+        comm = MPI.COMM_WORLD
+        rank = MPI.Comm_rank(comm)
+        ctx = comm.ctx
+        if rank == 0:
+            seq = getattr(ctx, "_agree_seq", None)
+            if seq is None:
+                seq = ctx._agree_seq = {}
+            # manufactured divergence: two members at different epochs of
+            # the same communicator
+            seq[(4242, 0)] = 7
+            seq[(4242, 1)] = 9
+        MPI.Barrier(comm)
+        inter = MPI.Comm_spawn(worker, None, 1, comm)
+        MPI.Intercomm_merge(inter, False)
+
+    with pytest.raises((MPI.MPIError, MPI.AbortError)) as ei:
+        run_spmd(body, 2)
+    assert ("agreement-epoch mismatch" in str(ei.value)
+            or isinstance(ei.value, MPI.AbortError))

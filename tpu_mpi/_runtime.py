@@ -930,6 +930,13 @@ class SpmdContext:
                 f"communicator (cid={cid}) was revoked after a failure; "
                 f"only Comm_shrink/Comm_agree remain legal on it")
         if self.failed_ranks:
+            if isinstance(cid, tuple) and cid and cid[0] == "ftagree":
+                # the recovery protocol's own rendezvous: agreement must
+                # complete DESPITE declared failures, or Comm_shrink could
+                # never run. (The thread tier conscripts the declared-dead
+                # rank's still-live thread through it; the process tier
+                # replaces this channel with the coordinator protocol.)
+                return
             dead = sorted(self.failed_ranks)
             if cid is not None:
                 ch = self._channels.get(cid)

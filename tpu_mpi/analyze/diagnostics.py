@@ -43,6 +43,7 @@ CODES = {
     "T212": "wildcard receive observes schedule-dependent values",
     "T213": "algorithm selection disagrees across ranks in a collective "
             "round",
+    "T214": "a rank skipped an elastic rebind quiesce/resume barrier",
     "R301": "concurrent overlapping RMA accesses (vector-clock race)",
     "R302": "donated persistent-fold result used after a later Start "
             "invalidated it",

@@ -110,7 +110,7 @@ class Event:
 
     def __init__(self, kind: str, rank: int, **kw: Any):
         # "coll" | "send" | "recv" | "rma" | "sync" | "start" | "wait"
-        # | "ft" | "serve"
+        # | "ft" | "serve" | "elastic"
         self.kind = kind
         self.rank = rank          # world rank of the recording rank
         for name in self.__slots__[2:]:
@@ -131,7 +131,7 @@ class Event:
                     f"range=[{self.lo}, {self.hi}))")
         if self.kind in ("start", "wait"):
             return f"{self.op} [{self.kind} round {self.round}] on comm {self.cid}"
-        if self.kind == "ft":
+        if self.kind in ("ft", "elastic"):
             return f"{self.op} on comm {self.cid} ({self.extra})"
         return f"{self.op}"
 
@@ -597,6 +597,32 @@ def record_ft(comm: Any, op: str, epoch: Optional[int] = None,
     if value is not None:
         extra["value"] = value
     ev = Event("ft", wrank, op=op, cid=comm.cid, grp=tuple(comm.group),
+               extra=extra, file=f, line=ln)
+    return tr.record(ev)
+
+
+# ---------------------------------------------------------------------------
+# Elastic-rebind protocol records (T214 front end)
+# ---------------------------------------------------------------------------
+
+def record_elastic(comm: Any, op: str, epoch: Optional[int] = None,
+                   declared: Any = None) -> Optional[Event]:
+    """One elastic rebind step (``quiesce``/``resume``) as seen from a rank
+    thread. ``declared`` is the set of ranks the protocol *intends* to
+    rendezvous (normally the comm's group): the T214 check holds every
+    declared rank that appears in the trace to having recorded this round.
+    The barrier itself is a real traced collective — this event only carries
+    the protocol metadata (the explorer models the barrier, not this)."""
+    env = _env()
+    if env is None:
+        return None
+    ctx, wrank = env
+    tr = tracer_for(ctx, create=True)
+    f, ln = call_site()
+    extra = {"epoch": epoch,
+             "declared": tuple(sorted(declared if declared is not None
+                                      else comm.group))}
+    ev = Event("elastic", wrank, op=op, cid=comm.cid, grp=tuple(comm.group),
                extra=extra, file=f, line=ln)
     return tr.record(ev)
 

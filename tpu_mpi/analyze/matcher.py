@@ -19,6 +19,9 @@ checks what no single rank can check alone:
   the same protocol round;
 - **T208** — serve-tier accounting: a broker ``book`` event whose per-tenant
   measured rows fail to partition the pool totals;
+- **T214** — elastic rebind participation: a rank the quiesce/resume round
+  declares, and which appears in the trace, never recorded the round (it
+  skipped the rebind barrier and can race the remap);
 - plus any online findings the hooks queued (T206 Isend buffer mutation),
   the RMA race pass (:func:`tpu_mpi.analyze.races.detect_races`), and the
   donated-buffer invalidation pass
@@ -59,6 +62,7 @@ def verify_trace(obj: Any = None) -> List[Diagnostic]:
     out += _check_p2p(tr)
     out += _check_ft(tr)
     out += _check_serve(tr)
+    out += _check_elastic(tr)
     from .races import detect_donation_races, detect_races
     out += detect_races(tr)
     out += detect_donation_races(tr)
@@ -79,8 +83,10 @@ def _check_collectives(tr) -> List[Diagnostic]:
     for ev in tr.events():
         if ev.kind == "coll":
             rounds[(ev.cid, ev.grp, ev.seq)].append(ev)
+    # cids mix ints with recovery tuples (("shrink", cid, epoch)): str-keyed
     for (cid, grp, seq), evs in sorted(rounds.items(),
-                                       key=lambda kv: (kv[0][0], kv[0][2])):
+                                       key=lambda kv: (str(kv[0][0]),
+                                                       kv[0][2])):
         if len(evs) < 2:
             continue                 # size-1 groups have nothing to agree on
         ops = {ev.op for ev in evs}
@@ -256,7 +262,8 @@ def _check_ft(tr) -> List[Diagnostic]:
         rounds[(ev.cid, ev.op, ordinal[k])].append(ev)
         ordinal[k] += 1
     for (cid, op, rnd), evs in sorted(rounds.items(),
-                                      key=lambda kv: (kv[0][0], str(kv[0][1]),
+                                      key=lambda kv: (str(kv[0][0]),
+                                                      str(kv[0][1]),
                                                       kv[0][2])):
         if len(evs) < 2:
             continue        # dead or evicted peers: nothing to compare
@@ -278,6 +285,41 @@ def _check_ft(tr) -> List[Diagnostic]:
                     file=anchor.file, line=anchor.line, rank=anchor.rank,
                     context=f"ranks {sorted(vals)}"))
                 break       # one diagnostic per divergent round
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Elastic rebind quiesce/resume participation (T214)
+# ---------------------------------------------------------------------------
+
+def _check_elastic(tr) -> List[Diagnostic]:
+    """Every rank an elastic quiesce/resume round *declares* must have
+    recorded the round — a declared rank that shows up elsewhere in the
+    trace but skipped the rebind barrier would race the remap (the defect
+    the two-phase protocol exists to exclude). Ranks wholly absent from
+    the trace are not held to it (dead, or ring-evicted)."""
+    out: List[Diagnostic] = []
+    present = {r for r in tr.rings if r >= 0 and tr.rings[r]}
+    rounds: Dict[tuple, list] = defaultdict(list)
+    for ev in tr.events():
+        if ev.kind != "elastic":
+            continue
+        declared = _canon((ev.extra or {}).get("declared")) or ()
+        rounds[(ev.op, (ev.extra or {}).get("epoch"), declared)].append(ev)
+    for (op, epoch, declared), evs in sorted(
+            rounds.items(), key=lambda kv: (str(kv[0][1]), kv[0][0])):
+        seen = {ev.rank for ev in evs}
+        missing = [r for r in declared if r in present and r not in seen]
+        if missing:
+            anchor = min(evs, key=lambda ev: ev.rank)
+            out.append(Diagnostic(
+                "T214",
+                f"elastic {op} round (epoch {epoch}) declares ranks "
+                f"{list(declared)} but rank(s) {missing} never recorded "
+                f"it — a rank skipped the rebind barrier and can race "
+                f"the remap",
+                file=anchor.file, line=anchor.line, rank=anchor.rank,
+                context=f"participants {sorted(seen)}"))
     return out
 
 

@@ -641,10 +641,29 @@ def Comm_get_parent() -> Comm:
     return ctx.parent_comm.get(world_rank, COMM_NULL)
 
 
+def _epoch_view(ctx, world_rank) -> dict:
+    """This rank's agreement-epoch state, per communicator: the slice of
+    ``ctx._agree_seq`` keyed by ``world_rank``. Contributed to
+    Intercomm_merge so ranks joining an older (possibly shrunk) world can
+    adopt its epoch space instead of silently diverging from it."""
+    seq = getattr(ctx, "_agree_seq", None) or {}
+    return {cid: e for (cid, r), e in seq.items() if r == world_rank}
+
+
 def Intercomm_merge(intercomm: Intercomm, high: bool) -> Comm:
     """Collectively merge an intercomm's two groups into one intracomm
     (src/comm.jl:155-162). Groups whose members pass ``high=False`` are
-    ordered first."""
+    ordered first.
+
+    Merging into a *shrunk* world is supported: every rank contributes its
+    per-communicator agreement-epoch view, established members must agree
+    on theirs (a divergence is a loud ``MPIError``, never a silently forked
+    cid space), and joining ranks adopt the agreed epochs — so a later
+    ``Comm_agree``/``Comm_shrink`` on a pre-existing communicator derives
+    the same epoch (and thus the same shrink cid) on old and new ranks
+    alike. The merged channel is registered eagerly so the new comm is
+    usable while ``failed_ranks`` is non-empty (same contract as
+    ``Comm_shrink``)."""
     if not isinstance(intercomm, Intercomm):
         raise MPIError("Intercomm_merge requires an intercommunicator",
                        code=_ec.ERR_COMM)
@@ -657,13 +676,38 @@ def Intercomm_merge(intercomm: Intercomm, high: bool) -> Comm:
 
     def combine(cs):
         cid = ctx.alloc_cid()
-        lows = [(s, wr) for s, (wr, hi) in enumerate(cs) if not hi]
-        highs = [(s, wr) for s, (wr, hi) in enumerate(cs) if hi]
+        lows = [(s, wr) for s, (wr, hi, _v) in enumerate(cs) if not hi]
+        highs = [(s, wr) for s, (wr, hi, _v) in enumerate(cs) if hi]
         merged = tuple(wr for _, wr in lows) + tuple(wr for _, wr in highs)
-        return [(merged, cid)] * total
+        views: dict = {}
+        for wr, _hi, view in cs:
+            for vcid, e in view.items():
+                views.setdefault(vcid, {})[wr] = e
+        adopt = {}
+        for vcid, per in views.items():
+            if len(set(per.values())) > 1:
+                raise MPIError(
+                    f"Intercomm_merge: agreement-epoch mismatch on comm "
+                    f"{vcid}: " + ", ".join(
+                        f"world rank {r} at epoch {e}"
+                        for r, e in sorted(per.items(), key=lambda kv:
+                                           str(kv[0]))) +
+                    " — the merging groups ran divergent agree/shrink "
+                    "histories and would fork the shrink-cid space",
+                    code=_ec.ERR_SPAWN)
+            adopt[vcid] = next(iter(per.values()))
+        return [(merged, cid, adopt)] * total
 
-    merged, cid = chan.run(slot, (world_rank, bool(high)),
-                           combine, f"Intercomm_merge@{intercomm.cid}")
+    merged, cid, adopt = chan.run(
+        slot, (world_rank, bool(high), _epoch_view(ctx, world_rank)),
+        combine, f"Intercomm_merge@{intercomm.cid}")
+    seq = getattr(ctx, "_agree_seq", None)
+    if seq is None:
+        seq = ctx._agree_seq = {}
+    for vcid, e in adopt.items():
+        for wr in merged:
+            seq.setdefault((vcid, wr), e)
+    ctx.channel(cid, len(merged), tuple(merged))
     return Comm(merged, cid, name="merged")
 
 

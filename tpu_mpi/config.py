@@ -230,6 +230,31 @@ class Config:
     # byte floor for the heuristic to prefer the two-level "hier"
     # composite on multi-domain worlds (measured tables override).
     hier_min_bytes: int = 4096
+    # elastic capacity (docs/fault-tolerance.md "Elastic recovery"):
+    # enables the broker-side autoscaler loop that re-spawns ranks after a
+    # failure and grows/retires capacity from the load signals the broker
+    # already records (queue depth, busy-rejection rate, SLO hit rate).
+    elastic: bool = False
+    # pool-size floor the autoscaler will never retire below.
+    elastic_min_ranks: int = 1
+    # pool-size ceiling for pressure-driven growth; 0 = the starting size
+    # (failure replacement always restores to the pre-failure target).
+    elastic_max_ranks: int = 0
+    # autoscaler tick interval.
+    elastic_interval_ms: int = 200
+    # refractory period after any resize before the next one may start.
+    elastic_cooldown_ms: int = 2000
+    # consecutive over/under-threshold ticks before a resize fires
+    # (hysteresis — one noisy sample never resizes the pool).
+    elastic_hysteresis: int = 3
+    # queued-op depth across tenants that counts as growth pressure.
+    elastic_depth_high: int = 16
+    # consecutive idle ticks before a spare rank is retired; 0 = never.
+    elastic_idle_ticks: int = 0
+    # per-rank sidecar watchdog processes: SIGKILLing a sidecar declares
+    # its rank failed (the chaos hook for the thread-tier pool, where rank
+    # threads cannot be killed individually).
+    elastic_sidecars: bool = False
 
     def replace(self, **kw: Any) -> "Config":
         d = {f.name: getattr(self, f.name) for f in fields(self)}
@@ -288,6 +313,15 @@ _ENV_MAP = {
     "plan_cache_max": "TPU_MPI_PLAN_CACHE_MAX",
     "domains": "TPU_MPI_DOMAINS",
     "hier_min_bytes": "TPU_MPI_HIER_MIN_BYTES",
+    "elastic": "TPU_MPI_ELASTIC",
+    "elastic_min_ranks": "TPU_MPI_ELASTIC_MIN_RANKS",
+    "elastic_max_ranks": "TPU_MPI_ELASTIC_MAX_RANKS",
+    "elastic_interval_ms": "TPU_MPI_ELASTIC_INTERVAL_MS",
+    "elastic_cooldown_ms": "TPU_MPI_ELASTIC_COOLDOWN_MS",
+    "elastic_hysteresis": "TPU_MPI_ELASTIC_HYSTERESIS",
+    "elastic_depth_high": "TPU_MPI_ELASTIC_DEPTH_HIGH",
+    "elastic_idle_ticks": "TPU_MPI_ELASTIC_IDLE_TICKS",
+    "elastic_sidecars": "TPU_MPI_ELASTIC_SIDECARS",
 }
 
 _lock = threading.Lock()

@@ -63,6 +63,7 @@ ERR_QUOTA = 71
 ERR_SERVE_BUSY = 72
 ERR_SESSION = 73
 ERR_SLO_EXPIRED = 74
+ERR_POOL_DEGRADED = 75
 
 _ERROR_STRINGS = {
     SUCCESS: "MPI_SUCCESS: no error",
@@ -127,6 +128,10 @@ _ERROR_STRINGS = {
     ERR_SLO_EXPIRED: "TPU_ERR_SLO_EXPIRED: generation request evicted — its "
                      "latency-SLO deadline expired before completion; "
                      "retriable under lighter load",
+    ERR_POOL_DEGRADED: "TPU_ERR_POOL_DEGRADED: the serve pool lost ranks and "
+                       "is running degraded — this tenant's communicators "
+                       "span a dead rank; retriable once the autoscaler "
+                       "restores capacity and rebinds the lease",
 }
 
 # tpu_mpi.analyze diagnostic code -> MPI error class. The analyzer's own
@@ -156,6 +161,7 @@ DIAGNOSTIC_CODES = {
     "T211": ERR_PENDING,                # alternate-schedule orphaned message
     "T212": ERR_ARG,                    # schedule-dependent wildcard values
     "T213": ERR_COLLECTIVE_MISMATCH,    # per-rank algorithm selection split
+    "T214": ERR_COLLECTIVE_MISMATCH,    # rank skipped elastic rebind barrier
     "R301": ERR_RMA_RACE,               # vector-clock RMA race
     "R302": ERR_BUFFER,                 # donated fold result read after inval
 }
@@ -315,6 +321,28 @@ class SLOExpiredError(MPIError):
         self.tenant = tenant
         self.rid = rid
         self.slo_ms = int(slo_ms)
+
+
+class PoolDegradedError(MPIError):
+    """The serve pool lost ranks and this tenant's communicators span one
+    (docs/serving.md "Degraded mode"). Retriable backpressure like
+    :class:`ServeBusyError`: nothing was run or charged, surviving tenants
+    whose communicators avoid the dead ranks keep streaming, and the
+    autoscaler will re-spawn capacity and rebind the lease — resubmitting
+    after a backoff is always safe. ``dead`` lists the world ranks known
+    dead at rejection time; ``headroom`` is the healthy-rank count clients
+    can still attach against."""
+
+    CODE = ERR_POOL_DEGRADED
+    retriable = True
+
+    def __init__(self, msg: str = "serve pool degraded, retry later",
+                 code: "int | None" = None, tenant: "str | None" = None,
+                 dead: "tuple[int, ...] | None" = None, headroom: int = 0):
+        super().__init__(msg, code=code)
+        self.tenant = tenant
+        self.dead = tuple(dead) if dead else ()
+        self.headroom = int(headroom)
 
 
 class SessionError(MPIError):

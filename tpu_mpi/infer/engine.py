@@ -121,6 +121,10 @@ class InferEngine:
         knobs = config.load()
         self.pool = pool
         self.ep = nr // 2
+        # world ranks hosting the engine, comm order: stage 0 is ranks[:ep],
+        # stage 1 is ranks[ep:]. An elastic resize replaces entries in place
+        # (rebind), so all comm-relative addressing (slots, p2p peers) holds.
+        self.ranks = tuple(range(nr))
         self.cfg = cfg or TransformerConfig(vocab=64, d_model=32, n_heads=2,
                                             n_layers=2, d_ff=64, max_seq=128)
         if self.cfg.n_layers % N_STAGES:
@@ -152,18 +156,17 @@ class InferEngine:
         from ..models.transformer import (transformer_pp_moe_host_params,
                                           transformer_pp_moe_init)
         ctx = self.pool.ctx
-        nr = self.pool.nranks
-        self.wcomm = Comm(tuple(range(nr)), ctx.alloc_cid(), ctx=ctx,
+        self.wcomm = Comm(self.ranks, ctx.alloc_cid(), ctx=ctx,
                           name="infer-world")
         self.ep_comms = (
-            Comm(tuple(range(self.ep)), ctx.alloc_cid(), ctx=ctx,
+            Comm(self.ranks[:self.ep], ctx.alloc_cid(), ctx=ctx,
                  name="infer-ep0"),
-            Comm(tuple(range(self.ep, nr)), ctx.alloc_cid(), ctx=ctx,
+            Comm(self.ranks[self.ep:], ctx.alloc_cid(), ctx=ctx,
                  name="infer-ep1"))
         params = transformer_pp_moe_init(jax.random.PRNGKey(self.seed),
                                          self.cfg, self.ep)
-        for r in range(nr):
-            stage, slot = (0, r) if r < self.ep else (1, r - self.ep)
+        for i, r in enumerate(self.ranks):
+            stage, slot = (0, i) if i < self.ep else (1, i - self.ep)
             self._state[r] = {
                 "stage": stage, "slot": slot,
                 "sp": transformer_pp_moe_host_params(
@@ -171,6 +174,35 @@ class InferEngine:
                 "kv": PagedKVCache(self.kv_blocks, self.block_tokens,
                                    self.cfg.n_heads, self.cfg.head_dim),
             }
+
+    def rebind(self, mapping: dict) -> None:
+        """Point the engine at replacement world ranks after an elastic
+        resize (``mapping``: dead world rank -> replacement). Group ORDER is
+        preserved position-wise, so every comm-relative address — pipeline
+        slots, p2p peers, MoE expert indices — is unchanged; only the world
+        ranks behind them move. Fresh cids are allocated (the old channels
+        span retired ranks and would fault-check forever) and registered
+        eagerly so the first post-resize step is scoped to the new group.
+
+        The per-rank shard state moves with the slot: in the thread tier a
+        "dead" rank's memory is still addressable (death is a declaration),
+        so weights and KV chains survive the move; a process tier would
+        re-shard from checkpoint here instead."""
+        from ..comm import Comm
+        ctx = self.pool.ctx
+        self.ranks = tuple(mapping.get(r, r) for r in self.ranks)
+        self.wcomm = Comm(self.ranks, ctx.alloc_cid(), ctx=ctx,
+                          name="infer-world")
+        self.ep_comms = (
+            Comm(self.ranks[:self.ep], ctx.alloc_cid(), ctx=ctx,
+                 name="infer-ep0"),
+            Comm(self.ranks[self.ep:], ctx.alloc_cid(), ctx=ctx,
+                 name="infer-ep1"))
+        for c in (self.wcomm, *self.ep_comms):
+            ctx.channel(c.cid, len(c.group), c.group)
+        for old, new in mapping.items():
+            if old in self._state:
+                self._state[new] = self._state.pop(old)
 
     # -- admission accounting (scheduler side) -------------------------------
     def kv_demand(self, prompt_len: int, max_new: int) -> int:
@@ -209,7 +241,7 @@ class InferEngine:
         results: Dict[int, int] = {}
         errs: list = []
         done = threading.Event()
-        remaining = [self.pool.nranks]
+        remaining = [len(self.ranks)]
         lock = threading.Lock()
 
         def make(rank):
@@ -229,7 +261,7 @@ class InferEngine:
             return run
 
         with self.pool._dispatch_lock:
-            for r in range(self.pool.nranks):
+            for r in self.ranks:
                 self.pool._queues[r].put((None, make(r)))
         if not done.wait(timeout=300.0):
             raise MPIError(f"inference step {plan.seq} timed out on the "

@@ -96,6 +96,8 @@ class InferScheduler:
         self._stream = itertools.count(0)
         self._wake = threading.Event()
         self._stop = threading.Event()
+        self._paused = threading.Event()     # elastic quiesce requested
+        self._boundary = threading.Event()   # loop parked between steps
         self._dead: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
         self.counters = {"admitted": 0, "completed": 0, "cancelled": 0,
@@ -208,11 +210,30 @@ class InferScheduler:
                         [r.rid for r in releases])
         return plan, prefills, decodes, releases
 
+    def pause(self, timeout: float = 30.0) -> bool:
+        """Park the batching loop at a step boundary (the elastic rebind
+        quiesce): requests keep queueing and SLO deadlines keep ticking —
+        a request whose deadline passes while paused is evicted at resume —
+        but nothing touches the engine until :meth:`resume`. Returns True
+        once the loop is parked (no step mid-flight)."""
+        self._paused.set()
+        self._wake.set()
+        return self._boundary.wait(timeout)
+
+    def resume(self) -> None:
+        self._paused.clear()
+        self._boundary.clear()
+        self._wake.set()
+
     def _loop(self) -> None:
         while not self._stop.is_set():
             self._wake.wait(timeout=0.2)
             if self._stop.is_set():
                 return
+            if self._paused.is_set():
+                self._boundary.set()
+                time.sleep(0.01)
+                continue
             with self._lock:
                 built = self._build_plan()
             if built is None:
@@ -300,7 +321,8 @@ class InferScheduler:
         decode_s = c["step_ns"] / 1e9
         return {
             "max_batch": self.max_batch, "slo_ms": self.slo_ms,
-            "pending": pending, "active": active, **c,
+            "pending": pending, "active": active,
+            "paused": self._paused.is_set(), **c,
             "tokens_per_s": (round(c["tokens"] / decode_s, 3)
                              if decode_s > 0 else None),
             "batch_occupancy": (round(c["batch_slots"]

@@ -34,8 +34,17 @@ from .error import MPIError
 #                   cleanly (revoked + shrunk + completed).
 # EXIT_RANK_FAILED — a rank failed and the job did NOT recover (a survivor
 #                   also exited nonzero, or the failure wasn't a signal).
+# Elastic-resize outcomes (docs/fault-tolerance.md "Elastic recovery";
+# used by the serve-tier chaos driver, benchmarks/elastic_chaos.py):
+# EXIT_RESIZED_OK — ranks were lost AND the autoscaler restored full
+#                   capacity (degraded → re-spawn → rebind) with zero
+#                   dropped tenants.
+# EXIT_DEGRADED   — ranks were lost and the pool is still serving degraded
+#                   (capacity not yet restored when the run ended).
 EXIT_SHRUNK_OK = 66
 EXIT_RANK_FAILED = 65
+EXIT_RESIZED_OK = 67
+EXIT_DEGRADED = 68
 
 
 def _force_sim_devices(n: int) -> None:

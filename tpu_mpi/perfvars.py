@@ -503,6 +503,42 @@ def infer_snapshot() -> dict:
         return {**_infer, "gauges": dict(_infer_gauges)}
 
 
+# -- elastic-capacity block (tpu_mpi.elastic) ---------------------------------
+#
+# Process-global like the infer block: resizes span the whole pool, so
+# per-comm attribution is meaningless. Counters (resizes, rebinds, grown,
+# shrunk, failures) accumulate; gauges (pool_size, target_size, degraded)
+# overwrite.
+
+_elastic: Dict[str, int] = {}
+_elastic_gauges: Dict[str, int] = {}
+
+
+def note_elastic(**counts: int) -> None:
+    """Accumulate elastic-capacity counters (resizes, rebinds, grown,
+    shrunk, failures, ...)."""
+    with _store_lock:
+        for k, v in counts.items():
+            _elastic[k] = _elastic.get(k, 0) + int(v)
+
+
+def set_elastic_gauges(**vals: int) -> None:
+    """Overwrite elastic-capacity gauges (pool_size, target_size,
+    degraded)."""
+    with _store_lock:
+        for k, v in vals.items():
+            _elastic_gauges[k] = int(v)
+
+
+def elastic_snapshot() -> dict:
+    """The elastic block of :func:`snapshot` (may be empty): accumulated
+    counters plus the latest gauges under ``"gauges"``."""
+    with _store_lock:
+        if not _elastic and not _elastic_gauges:
+            return {}
+        return {**_elastic, "gauges": dict(_elastic_gauges)}
+
+
 def note_explore(comm: Any, explored: bool) -> None:
     """One online-autotuner decision on this comm (tpu_mpi.tune_online):
     ``explored`` when the call was routed to an alternate arm."""
@@ -576,7 +612,10 @@ def snapshot(rank: Optional[int] = None, reset: bool = False) -> dict:
     global _store_gen
     from .overlap import plans
     with _store_lock:
-        keys = [k for k in sorted(_store) if rank is None or k[0] == rank]
+        # cids mix ints and recovery tuples (("shrink", cid, epoch)) in one
+        # store — sort through str so the dump order is still deterministic
+        keys = [k for k in sorted(_store, key=lambda k: (k[0], str(k[1])))
+                if rank is None or k[0] == rank]
         comms = [_store[k].snapshot() for k in keys]
         if reset:
             for k in keys:
@@ -585,7 +624,7 @@ def snapshot(rank: Optional[int] = None, reset: bool = False) -> dict:
     return {"schema": 1, "kind": "tpu_mpi-pvars", "level": level(),
             "topology": _topology_stamp(),
             "comms": comms, "plan_cache": plans.stats(),
-            "infer": infer_snapshot()}
+            "infer": infer_snapshot(), "elastic": elastic_snapshot()}
 
 
 def comm_snapshot(comm: Any, reset: bool = False) -> dict:
@@ -611,6 +650,8 @@ def reset() -> None:
         _store.clear()
         _infer.clear()
         _infer_gauges.clear()
+        _elastic.clear()
+        _elastic_gauges.clear()
         _store_gen += 1
 
 

@@ -53,7 +53,7 @@ import numpy as np
 from .. import config
 from .. import error as _ec
 from ..analyze import events as _ev
-from ..error import MPIError, SessionError
+from ..error import MPIError, PoolDegradedError, ProcFailedError, SessionError
 from .._runtime import SpmdContext, set_current_tenant, set_env
 from . import protocol
 from .ledger import Ledger
@@ -104,10 +104,19 @@ class _ThreadPool:
     kind = "threads"
 
     def __init__(self, nranks: int):
-        self.nranks = int(nranks)
+        self.nranks = int(nranks)              # configured (restore-target) size
         self.ctx = SpmdContext(self.nranks)
+        # elastic membership (tpu_mpi.elastic): `active` is the pool-wide
+        # comm's group in merge order (survivors first, replacements after);
+        # `failed` holds declared-dead world ranks; `retired` the subset
+        # already shrunk out of the base comm.
+        self.active: List[int] = list(range(self.nranks))
+        self.failed: set = set()
+        self.retired: set = set()
+        self.base_comm: Any = None             # warm -> shrunk -> merged comm
         self._queues: List[queue.Queue] = [queue.Queue()
                                            for _ in range(self.nranks)]
+        self._queues_lock = threading.Lock()
         self._threads: List[threading.Thread] = []
         self._dispatch_lock = threading.Lock()
         self._comms: Dict[int, Any] = {}          # cid -> Comm (shared)
@@ -126,7 +135,13 @@ class _ThreadPool:
         set_env((self.ctx, rank))
         from .. import environment
         environment.Init()
-        q = self._queues[rank]
+        self._worker_loop(rank)
+
+    def _worker_loop(self, rank: int) -> None:
+        """Consume this rank's work queue until the None sentinel. Split
+        from :meth:`_worker` so a rank spawned mid-life by an elastic grow
+        (already Init'd by its spawn entry) can join the same loop."""
+        q = self.ensure_queue(rank)
         while True:
             item = q.get()
             if item is None:
@@ -137,6 +152,30 @@ class _ThreadPool:
                 fn(rank)
             finally:
                 set_current_tenant(None)
+
+    # -- elastic membership --------------------------------------------------
+    def healthy(self) -> List[int]:
+        """World ranks currently able to serve, in comm order."""
+        return [r for r in self.active if r not in self.failed]
+
+    def dead_in(self, group) -> tuple:
+        return tuple(sorted(set(group) & self.failed))
+
+    def mark_failed(self, rank: int) -> bool:
+        """Failure-detector verdict: declare a pool rank dead. Waiters on
+        comms spanning it raise ProcFailedError instead of hanging; the
+        rank stays in ``active`` (degraded) until a resize shrinks it out."""
+        if rank in self.failed or rank not in self.active:
+            return False
+        self.failed.add(rank)
+        self.ctx.peer_failed(rank)
+        return True
+
+    def ensure_queue(self, rank: int) -> queue.Queue:
+        with self._queues_lock:
+            while len(self._queues) <= rank:
+                self._queues.append(queue.Queue())
+            return self._queues[rank]
 
     def _warm(self) -> None:
         """Prime the pool before the first lease: a Barrier plus a tiny
@@ -149,6 +188,7 @@ class _ThreadPool:
                     name="serve-warm")
         with self._comms_lock:
             self._comms[cid] = comm
+        self.base_comm = comm
         self._run_on_all(None, lambda rank: self._warm_body(comm))
 
     @staticmethod
@@ -158,33 +198,47 @@ class _ThreadPool:
         collective.Allreduce(np.ones(8, np.float32), _reduce_op("sum"), comm)
 
     def _run_on_all(self, tenant: Optional[str], fn) -> None:
-        """Run ``fn(rank)`` on every rank worker and wait; exceptions
-        propagate to the caller (used for warm-up only)."""
+        """Run ``fn(rank)`` on every healthy rank worker and wait."""
+        self.run_on(self.healthy(), tenant, fn, timeout=None)
+
+    def run_on(self, ranks, tenant: Optional[str], fn,
+               timeout: Optional[float] = 120.0) -> list:
+        """Run ``fn(rank)`` on the given rank workers and wait; returns the
+        per-rank results in ``ranks`` order. The first exception propagates
+        (after every rank finished, so no closure is left running)."""
+        ranks = list(ranks)
         done = threading.Event()
         errs: list = []
-        remaining = [self.nranks]
+        results: list = [None] * len(ranks)
+        remaining = [len(ranks)]
         lock = threading.Lock()
 
-        def wrapped(rank):
-            try:
-                fn(rank)
-            except BaseException as e:          # noqa: BLE001 - reported below
-                errs.append(e)
-            finally:
-                with lock:
-                    remaining[0] -= 1
-                    if remaining[0] == 0:
-                        done.set()
+        def make(i):
+            def wrapped(rank):
+                try:
+                    results[i] = fn(rank)
+                except BaseException as e:      # noqa: BLE001 - reported below
+                    errs.append(e)
+                finally:
+                    with lock:
+                        remaining[0] -= 1
+                        if remaining[0] == 0:
+                            done.set()
+            return wrapped
 
         with self._dispatch_lock:
-            for q in self._queues:
-                q.put((tenant, wrapped))
-        done.wait()
+            for i, r in enumerate(ranks):
+                self.ensure_queue(r).put((tenant, make(i)))
+        if not done.wait(timeout):
+            raise SessionError(f"pool closure timed out on ranks {ranks}")
         if errs:
             raise errs[0]
+        return results
 
     def shutdown(self) -> None:
-        for q in self._queues:
+        with self._queues_lock:
+            queues = list(self._queues)
+        for q in queues:
             q.put(None)
         for t in self._threads:
             t.join(timeout=5)
@@ -194,6 +248,14 @@ class _ThreadPool:
         from ..comm import Comm
         comm = Comm(tuple(group), cid, ctx=self.ctx,
                     name=f"serve:{tenant}")
+        # eager channel registration: check_fault scopes a failure by the
+        # channel's GROUP, so a comm registered while the pool is degraded
+        # must not inherit the pessimistic no-group check on its first op
+        set_current_tenant(tenant)
+        try:
+            self.ctx.channel(cid, len(comm.group), comm.group)
+        finally:
+            set_current_tenant(None)
         with self._comms_lock:
             self._comms[cid] = comm
         return comm
@@ -205,6 +267,94 @@ class _ThreadPool:
     def drop_comm(self, cid: int) -> None:
         with self._comms_lock:
             self._comms.pop(cid, None)
+
+    def rebind_comm(self, cid, group, tenant: Optional[str]):
+        """Point an existing cid at a remapped group (elastic rebind): drop
+        the stale channel — its group spans a retired rank and would fault-
+        check forever — then register a fresh Comm and its channel. The cid
+        is UNCHANGED, so the tenant's lease, ledger books, and cid-range
+        ownership all survive the resize untouched."""
+        from ..comm import Comm
+        group = tuple(group)
+        with self.ctx._channels_lock:
+            self.ctx._channels.pop(cid, None)
+        set_current_tenant(tenant)
+        try:
+            comm = Comm(group, cid, ctx=self.ctx,
+                        name=f"serve:{tenant or 'pool'}")
+            self.ctx.channel(cid, len(group), group)
+        finally:
+            set_current_tenant(None)
+        with self._comms_lock:
+            self._comms[cid] = comm
+        from ..overlap import plans
+        plans.invalidate(cid)
+        return comm
+
+    # -- elastic resize primitives (driven by tpu_mpi.elastic) ----------------
+    def adopt_base(self, comm) -> None:
+        with self._comms_lock:
+            self._comms[comm.cid] = comm
+        self.base_comm = comm
+        self.active = list(comm.group)
+
+    def shrink_base(self) -> tuple:
+        """Collapse the pool-wide comm to its survivors via Comm_shrink.
+        EVERY member thread of the old base comm participates — including
+        threads whose world rank was declared dead. That conscription is a
+        thread-tier substrate honesty note: rank "death" here is a
+        declaration (the sidecar process died; the rank thread shares our
+        address space and cannot die independently), so the dead rank's
+        thread stands in for it one last time in the ftagree rendezvous,
+        exactly as ULFM's agreement excludes it from the outcome. The
+        conscripted workers are then permanently retired. Returns
+        ``(survivor_comm, dead_ranks)``."""
+        from ..comm import Comm_shrink
+        base = self.base_comm
+        group = list(base.group)
+        res = self.run_on(group, None, lambda rank: Comm_shrink(base))
+        shrunk = next(c for r, c in zip(group, res) if r not in self.failed)
+        dead = tuple(r for r in group if r in self.failed)
+        for r in dead:
+            self.retired.add(r)
+            self.ensure_queue(r).put(None)     # retire the conscripted worker
+        self.adopt_base(shrunk)
+        return shrunk, dead
+
+    def grow_base(self, n: int) -> tuple:
+        """Spawn ``n`` replacement rank threads and merge them into the
+        pool-wide comm (the GROW half of the elastic protocol): survivors
+        collectively Comm_spawn the children, both sides Intercomm_merge,
+        and merge ordering puts survivors first — so every pre-existing
+        comm-relative rank is preserved. The children Init, adopt the
+        merged world's epoch space (Intercomm_merge's epoch contribution),
+        and enter the ordinary worker loop. Returns ``(merged_comm,
+        new_world_ranks)``."""
+        from ..comm import Comm_spawn, Intercomm_merge
+        base = self.base_comm
+        pool = self
+
+        def child_entry():
+            from .. import environment
+            from ..comm import Comm_get_parent
+            from ..comm import Intercomm_merge as _merge
+            from .._runtime import require_env
+            environment.Init()
+            _, me = require_env()
+            _merge(Comm_get_parent(), True)
+            pool._worker_loop(me)
+
+        def body(rank):
+            inter = Comm_spawn(child_entry, None, n, base)
+            return Intercomm_merge(inter, False)
+
+        res = self.run_on(list(base.group), None, body)
+        merged = res[0]
+        new_ranks = tuple(r for r in merged.group if r not in base.group)
+        for r in new_ranks:
+            self.ensure_queue(r)
+        self.adopt_base(merged)
+        return merged, new_ranks
 
     # -- op execution --------------------------------------------------------
     def run_op(self, op: PoolOp, on_done) -> None:
@@ -277,7 +427,9 @@ class _ThreadPool:
 
     def info(self) -> dict:
         return {"kind": self.kind, "nranks": self.nranks,
-            "comms": len(self._comms)}
+                "active": list(self.active), "failed": sorted(self.failed),
+                "capacity": len(self.healthy()),
+                "comms": len(self._comms)}
 
 
 class Lease:
@@ -310,7 +462,7 @@ class Broker:
                  quota_bytes: Optional[int] = None,
                  quantum: int = 1 << 16, max_depth: int = 64,
                  max_inflight: int = 2, ns_span: int = 256,
-                 infer=None):
+                 infer=None, elastic=None):
         cfg = config.load()
         self.token = cfg.session_token if token is None else token
         self.max_tenants = (cfg.serve_max_tenants if max_tenants is None
@@ -340,6 +492,16 @@ class Broker:
         self._infer_spec = infer
         self.infer_engine = None
         self._infer_sched = None
+        # elastic capacity (tpu_mpi.elastic): None = TPU_MPI_ELASTIC config
+        self._elastic_spec = cfg.elastic if elastic is None else bool(elastic)
+        self._resize_gate = threading.Event()  # set = attaches may proceed
+        self._resize_gate.set()
+        self.elastic = None                    # ElasticController when on
+        self.sidecars = None
+        self._elastic_lock = threading.Lock()
+        self.elastic_state = {"enabled": bool(self._elastic_spec),
+                              "resizes": 0, "rebinds": 0, "failures": 0,
+                              "last_resize": None}
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
@@ -353,6 +515,15 @@ class Broker:
             self.infer_engine.start()
             self._infer_sched = InferScheduler(self.infer_engine)
             self._infer_sched.start()
+        if self._elastic_spec:
+            from ..elastic import ElasticController
+            self.elastic = ElasticController(self)
+            if config.load().elastic_sidecars:
+                from ..elastic.sidecar import RankSidecars
+                self.sidecars = RankSidecars(self.pool.active,
+                                             on_death=self.on_rank_failure)
+                self.sidecars.start()
+            self.elastic.start()
         self._listener, self.address = protocol.listen(self._socket_spec)
         self._listener.settimeout(0.2)
         d = threading.Thread(target=self._dispatch_loop,
@@ -385,6 +556,10 @@ class Broker:
 
     def close(self) -> None:
         self._stop.set()
+        if self.elastic is not None:
+            self.elastic.close()
+        if self.sidecars is not None:
+            self.sidecars.close()
         with self._lease_lock:
             leases = list(self._leases.values())
         for lease in leases:
@@ -423,6 +598,52 @@ class Broker:
         self.fq.complete(op)
         op.done.set()
 
+    # -- degraded-pool serving (tpu_mpi.elastic) ------------------------------
+    def on_rank_failure(self, rank: int) -> None:
+        """Failure-detector verdict (sidecar death, or a test's injection):
+        declare the rank dead and KEEP SERVING — tenants whose comms avoid
+        the dead rank stream on, ops that span it get the retriable
+        :class:`PoolDegradedError`, and the elastic controller (when on)
+        schedules the restore resize."""
+        if not self.pool.mark_failed(rank):
+            return
+        with self._elastic_lock:
+            self.elastic_state["failures"] += 1
+        from .. import perfvars
+        if perfvars.enabled():
+            perfvars.note_elastic(failures=1)
+            perfvars.set_elastic_gauges(degraded=1,
+                                        pool_size=len(self.pool.healthy()))
+        _ev.record_serve(self.pool.ctx, "rank_failed", rank=rank,
+                         capacity=len(self.pool.healthy()))
+        if self.elastic is not None:
+            self.elastic.kick()
+
+    def _degraded_error(self, tenant: Optional[str],
+                        group=None) -> PoolDegradedError:
+        dead = (self.pool.dead_in(group) if group is not None
+                else tuple(sorted(self.pool.failed)))
+        headroom = len(self.pool.healthy())
+        return PoolDegradedError(
+            f"serve pool degraded: rank(s) {list(dead)} failed and are not "
+            f"yet replaced ({headroom} healthy ranks remain) — retry once "
+            f"the autoscaler restores capacity and rebinds the lease",
+            tenant=tenant, dead=dead, headroom=headroom)
+
+    def _elastic_section(self) -> dict:
+        with self._elastic_lock:
+            st = dict(self.elastic_state)
+        healthy = len(self.pool.healthy())
+        st.update({
+            "pool_size": healthy,
+            "target_size": (self.elastic.target if self.elastic is not None
+                            else self.pool.nranks),
+            "degraded": bool(self.pool.failed - self.pool.retired),
+            "failed": sorted(self.pool.failed),
+            # re-advertised capacity: ranks a NEW lease can span right now
+            "headroom": healthy})
+        return st
+
     # -- attach / leases -----------------------------------------------------
     def _check_token(self, supplied: Optional[str]) -> None:
         if not self.token:
@@ -433,6 +654,11 @@ class Broker:
 
     def attach_tenant(self, conn, meta: dict) -> Lease:
         self._check_token(meta.get("token"))
+        # a resize holds the gate while the rank map is in flux: attaches
+        # queue here and land on the post-resize pool (tests drive this)
+        if not self._resize_gate.wait(timeout=30.0):
+            raise SessionError("attach timed out waiting for an elastic "
+                               "resize to finish")
         with self._lease_lock:
             if len(self._leases) >= self.max_tenants:
                 raise SessionError(
@@ -441,17 +667,22 @@ class Broker:
             tenant = meta.get("tenant") or f"t{next(self._tenant_seq)}"
             if tenant in self._leases:
                 raise SessionError(f"tenant id {tenant!r} already attached")
-            nranks = int(meta.get("nranks") or self.pool.nranks)
-            if not 1 <= nranks <= self.pool.nranks:
+            healthy = self.pool.healthy()
+            nranks = int(meta.get("nranks") or len(healthy))
+            if not 1 <= nranks <= max(self.pool.nranks, len(healthy)):
                 raise SessionError(
                     f"requested nranks={nranks} outside pool size "
-                    f"{self.pool.nranks}")
+                    f"{max(self.pool.nranks, len(healthy))}")
+            if nranks > len(healthy):
+                # the pool COULD host this lease, just not until the
+                # autoscaler restores the dead ranks: typed + retriable
+                raise self._degraded_error(tenant)
             ns = self.pool.lease_ns(tenant, self.ns_span)
             self._cid_ranges.append((ns.base, ns.limit, tenant))
             # nothing collective below: root cid is a broker-side alloc, so
             # attach stays on the <1 ms budget
             root_cid = ns.alloc()
-            group = tuple(range(nranks))
+            group = tuple(healthy[:nranks])
             self.pool.register_comm(group, root_cid, tenant)
             lease = Lease(tenant, ns, group, root_cid, conn)
             self._leases[tenant] = lease
@@ -602,6 +833,13 @@ class Broker:
             level = int(meta.get("level", 1))
             totals = self.flush_ledger() if level >= 2 else None
             return {"op": opname, "level": level, "totals": totals}, []
+        # degraded-pool guard: an op whose communicator spans a declared-
+        # dead rank is rejected typed-and-retriable at admission — it would
+        # only raise ProcFailedError from the rank workers (reject, don't
+        # burn a pool slot). Comms on surviving ranks pass untouched.
+        comm = self.pool.comm_for(cid)
+        if comm is not None and self.pool.dead_in(comm.group):
+            raise self._degraded_error(lease.tenant, comm.group)
         if opname in ("allreduce", "bcast"):
             self._validate_arrays(lease, opname, arrays, meta)
             if opname == "allreduce":
@@ -631,6 +869,10 @@ class Broker:
                                f"the pool")
         if op.error is not None:
             err = op.error
+            if isinstance(err, ProcFailedError):
+                # a rank died while the op was in flight: same contract as
+                # the admission guard — typed, retriable, lease intact
+                raise self._degraded_error(lease.tenant) from err
             if isinstance(err, MPIError):
                 raise err
             raise MPIError(f"pool execution failed: {err}",
@@ -683,6 +925,11 @@ class Broker:
                 "this broker has no inference engine (start it with "
                 "tpurun --serve --infer, or Broker(infer=True))",
                 code=_ec.ERR_UNSUPPORTED_OPERATION)
+        if self.infer_engine is not None \
+                and self.pool.dead_in(self.infer_engine.ranks):
+            # the engine's pipeline spans the dead rank; generation resumes
+            # once the resize rebinds the engine onto the replacements
+            raise self._degraded_error(lease.tenant, self.infer_engine.ranks)
         if len(arrays) != 1:
             raise MPIError("generate takes exactly one prompt token array",
                            code=_ec.ERR_ARG)
@@ -811,7 +1058,8 @@ class Broker:
                 "ledger": self.ledger.report(), "queue": self.fq.stats(),
                 "plan_cache": plans.stats(),
                 "infer": (self._infer_sched.stats()
-                          if self._infer_sched is not None else None)}
+                          if self._infer_sched is not None else None),
+                "elastic": self._elastic_section()}
 
 
 # -- tpurun --serve CLI -------------------------------------------------------
@@ -848,6 +1096,11 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--infer", action="store_true",
                    help="serve token generation (tpu_mpi.infer): a "
                         "2-stage x N-expert MoE engine on the warm pool")
+    p.add_argument("--elastic", action="store_true",
+                   help="run the elastic autoscaler (tpu_mpi.elastic): "
+                        "dead ranks are respawned and merged back, tenant "
+                        "leases rebound, and the pool serves degraded in "
+                        "between (docs/fault-tolerance.md)")
     p.add_argument("--stats", action="store_true",
                    help="report per-tenant usage of a running broker and "
                         "exit")
@@ -866,11 +1119,13 @@ def main(argv: Optional[list] = None) -> int:
     broker = Broker(nranks=args.nranks, socket_spec=args.socket,
                     token=args.token, max_tenants=args.max_tenants,
                     quota_bytes=args.quota_bytes,
-                    infer=True if args.infer else None)
+                    infer=True if args.infer else None,
+                    elastic=True if args.elastic else None)
     broker.start()
     print(f"tpu_mpi serve: broker up — pool={args.nranks} ranks, "
           f"socket={broker.address}"
           + (", inference engine on" if args.infer else "")
+          + (", elastic autoscaler on" if args.elastic else "")
           + f" (pid {os.getpid()})", flush=True)
     try:
         broker.serve_forever()

@@ -43,6 +43,7 @@ class Ledger:
             e = self._tenants[tenant] = {
                 "admitted_bytes": 0, "admitted_ops": 0,
                 "rejected_quota": 0, "rejected_busy": 0,
+                "rebinds": 0,
                 "measured": {}, "attached_at": time.time(),
                 "revoked": False, "detached": False,
             }
@@ -82,6 +83,13 @@ class Ledger:
     def note_busy(self, tenant: str) -> None:
         with self._lock:
             self._entry(tenant)["rejected_busy"] += 1
+
+    def note_rebind(self, tenant: str) -> None:
+        """An elastic resize moved this tenant's lease onto replacement
+        ranks (tpu_mpi.elastic): same cids, same books, new group. Counted
+        so --stats can show how often a tenant rode through a resize."""
+        with self._lock:
+            self._entry(tenant)["rebinds"] += 1
 
     # -- measured book (pvar attribution) -------------------------------------
     def flush_from_pvars(self, snapshot: dict,

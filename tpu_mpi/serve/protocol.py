@@ -40,8 +40,8 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from .. import error as _ec
-from ..error import (MPIError, QuotaExceededError, ServeBusyError,
-                     SessionError, SLOExpiredError)
+from ..error import (MPIError, PoolDegradedError, QuotaExceededError,
+                     ServeBusyError, SessionError, SLOExpiredError)
 
 # frame kinds
 HELLO = 1
@@ -147,7 +147,8 @@ def error_meta(exc: BaseException) -> dict:
             "message": str(getattr(exc, "args", [exc])[0]) if exc.args
                        else str(exc),
             "retriable": bool(getattr(exc, "retriable", False))}
-    for attr in ("tenant", "used", "quota", "depth", "rid", "slo_ms"):
+    for attr in ("tenant", "used", "quota", "depth", "rid", "slo_ms",
+                 "dead", "headroom"):
         v = getattr(exc, attr, None)
         if v is not None:
             meta[attr] = v
@@ -169,6 +170,10 @@ def raise_for_error(meta: dict) -> None:
         raise SLOExpiredError(msg, tenant=meta.get("tenant"),
                               rid=meta.get("rid"),
                               slo_ms=int(meta.get("slo_ms", 0)))
+    if code == _ec.ERR_POOL_DEGRADED:
+        raise PoolDegradedError(msg, tenant=meta.get("tenant"),
+                                dead=tuple(meta.get("dead") or ()),
+                                headroom=int(meta.get("headroom", 0)))
     if code == _ec.ERR_SESSION:
         raise SessionError(msg)
     raise MPIError(msg, code=code)

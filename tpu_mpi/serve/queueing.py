@@ -47,6 +47,7 @@ class FairQueue:
         self._ring: List[str] = []                 # visit order
         self._cursor = 0
         self._closed = False
+        self._paused = False
         # counters for --stats
         self.submitted = 0
         self.rejected_busy = 0
@@ -112,6 +113,8 @@ class FairQueue:
         the caller must resweep rather than wait for a notify."""
         n = len(self._ring)
         blocked = False
+        if self._paused:
+            return None, False
         for _ in range(n):
             tenant = self._ring[self._cursor]
             self._cursor = (self._cursor + 1) % n
@@ -154,6 +157,24 @@ class FairQueue:
                 self._inflight[op.tenant] -= 1
             self._cond.notify_all()
 
+    def pause(self) -> None:
+        """Stop dispatching (submits still queue; nothing pops) — the
+        quiesce half of the elastic rebind protocol. In-flight ops are the
+        caller's to drain via :meth:`inflight_total`."""
+        with self._lock:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._lock:
+            self._paused = False
+            self._cond.notify_all()
+
+    def inflight_total(self) -> int:
+        """Ops dispatched to the pool and not yet completed, across all
+        tenants (0 = the pool is drained and safe to remap)."""
+        with self._lock:
+            return sum(self._inflight.values())
+
     def close(self) -> None:
         with self._lock:
             self._closed = True
@@ -172,4 +193,5 @@ class FairQueue:
                 "quantum": self.quantum,
                 "max_depth": self.max_depth,
                 "max_inflight": self.max_inflight,
+                "paused": self._paused,
             }

@@ -37,6 +37,7 @@ def aggregate(records: Sequence[dict]) -> dict:
     auto = {"tracked": 0, "armed": 0, "arms": 0, "demotions": 0, "hits": 0,
             "evictions": 0, "signatures": {}}
     infer: Dict[str, Any] = {"gauges": {}}
+    elastic: Dict[str, Any] = {"gauges": {}}
     batch = {"flushes": 0, "ops": 0}
     explore = {"calls": 0, "explored": 0, "table_swaps": 0,
                "last_swap_gen": 0}
@@ -58,6 +59,13 @@ def aggregate(records: Sequence[dict]) -> dict:
                                              int(gv))
             else:
                 infer[k] = int(infer.get(k, 0)) + int(v)
+        for k, v in (rec.get("elastic") or {}).items():
+            if k == "gauges":
+                for g, gv in (v or {}).items():
+                    elastic["gauges"][g] = max(
+                        int(elastic["gauges"].get(g, 0)), int(gv))
+            else:
+                elastic[k] = int(elastic.get(k, 0)) + int(v)
         for label, sig in (au.get("signatures") or {}).items():
             ent = auto["signatures"].setdefault(
                 label, {"calls": 0, "hits": 0, "demotions": 0,
@@ -122,6 +130,7 @@ def aggregate(records: Sequence[dict]) -> dict:
                              if explore["calls"] else None),
         "arm_counts": arm_counts,
         "infer": infer,
+        "elastic": elastic,
     }
 
 
@@ -251,6 +260,17 @@ def render(agg: dict, out=None) -> None:
               f"{ser / 1e6:.2f}ms stage-0 produce time "
               f"({1 - pw / ser:.0%} overlapped)\n")
 
+    ela = agg.get("elastic") or {}
+    if ela.get("resizes") or ela.get("failures"):
+        g = ela.get("gauges") or {}
+        w(f"\nelastic capacity: {ela.get('resizes', 0)} resizes "
+          f"({ela.get('grown', 0)} ranks grown, {ela.get('shrunk', 0)} "
+          f"shrunk), {ela.get('failures', 0)} rank failures, "
+          f"{ela.get('rebinds', 0)} lease rebinds\n")
+        if g.get("pool_size"):
+            w(f"  pool {g['pool_size']}/{g.get('target_size', '?')} ranks"
+              + (" (DEGRADED)" if g.get("degraded") else "") + "\n")
+
 
 def _launch_and_collect(launch_args: List[str]) -> List[dict]:
     """Run a ``tpurun`` launch with pvar dumping into a temp dir and load
@@ -313,6 +333,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                "explore": agg["explore"],
                "explore_fraction": agg["explore_fraction"],
                "infer": agg["infer"],
+               "elastic": agg["elastic"],
                "arm_counts": {f"{c}|{a}": n
                               for (c, a), n in sorted(
                                   agg["arm_counts"].items())},
