@@ -204,6 +204,33 @@ class Config:
     # shared secret a client must present in the session handshake; "" (the
     # default) means the broker accepts any token — loopback/dev mode.
     session_token: str = ""
+    # serve pool backend (docs/serving.md "Scale-out"): "threads" = rank
+    # threads inside the broker process on one warm thread-tier world;
+    # "procs" = OS-process ranks over the framed native transport, spawned
+    # through the launcher rendezvous and driven by per-rank control
+    # sockets (the production backend — survives rank SIGKILL, no shared
+    # GIL with the broker loop).
+    serve_backend: str = "threads"
+    # multi-broker scale-out: comma list of broker sockets the router
+    # shards tenants across (and `tpurun --serve --stats` merges).
+    serve_brokers: str = ""
+    # this broker's disjoint cid-range shard as "index/count" (e.g. "0/2");
+    # "" = the whole namespace range (single-broker). Each shard carves
+    # tenant cid namespaces from a disjoint base so N brokers can front
+    # one fleet without cid collisions (serve/ledger.py CidShard).
+    serve_shard: str = ""
+    # zero-copy frame path: OP payload views are scatter-gather written
+    # (socket sendmsg) straight from the session recv buffer to the rank
+    # mailbox — no intermediate marshal; off = the legacy join+copy path
+    # (the before/after comparison lane in benchmarks/serve_scale_sweep.py).
+    serve_zerocopy: bool = True
+    # socket the scale-out router (`tpurun --serve --router`) listens on;
+    # same spec grammar as serve_socket, "" = pick a loopback TCP port.
+    serve_router_socket: str = ""
+    # router session handling: "splice" proxies every byte through the
+    # router (clients need only its address); "redirect" answers HELLO
+    # with the tenant's home broker so the data path goes direct.
+    serve_router_mode: str = "splice"
     # inference engine (docs/serving.md "Inference engine"): per-request
     # latency SLO in milliseconds — a generation request whose deadline
     # expires before it finishes is EVICTED with a typed retriable
@@ -307,6 +334,12 @@ _ENV_MAP = {
     "serve_max_tenants": "TPU_MPI_SERVE_MAX_TENANTS",
     "serve_quota_bytes": "TPU_MPI_SERVE_QUOTA_BYTES",
     "session_token": "TPU_MPI_SESSION_TOKEN",
+    "serve_backend": "TPU_MPI_SERVE_BACKEND",
+    "serve_brokers": "TPU_MPI_SERVE_BROKERS",
+    "serve_shard": "TPU_MPI_SERVE_SHARD",
+    "serve_zerocopy": "TPU_MPI_SERVE_ZEROCOPY",
+    "serve_router_socket": "TPU_MPI_SERVE_ROUTER_SOCKET",
+    "serve_router_mode": "TPU_MPI_SERVE_ROUTER_MODE",
     "infer_slo_ms": "TPU_MPI_INFER_SLO_MS",
     "infer_max_batch": "TPU_MPI_INFER_MAX_BATCH",
     "kv_block_tokens": "TPU_MPI_KV_BLOCK_TOKENS",

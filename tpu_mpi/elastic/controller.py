@@ -37,7 +37,6 @@ from typing import Optional
 
 from .. import config
 from ..analyze import events as _ev
-from .protocol import rebind_round
 
 
 class ElasticController:
@@ -240,15 +239,13 @@ class ElasticController:
             return record
 
     def _round(self, op: str, epoch: int) -> None:
-        """One rebind round on every rank of the pool-wide comm (the rank
-        threads themselves rendezvous — a REAL Barrier, so explore models
-        it and T214 audits the participant set)."""
-        pool = self.broker.pool
-        comm = pool.base_comm
-        declared = tuple(comm.group)
-        pool.run_on(list(declared), None,
-                    lambda rank: rebind_round(comm, op, epoch=epoch,
-                                              declared=declared))
+        """One rebind round on every rank of the pool-wide comm (the ranks
+        themselves rendezvous — a REAL Barrier, so explore models it and
+        T214 audits the participant set). Delegated to the pool because the
+        two backends reach their ranks differently: thread workers take a
+        closure, procs workers take a framed 'round' op — the protocol
+        (record + Barrier, elastic.protocol.rebind_round) is the same."""
+        self.broker.pool.elastic_round(op, epoch)
 
     def _rebind_leases(self, mapping: dict) -> int:
         """Move every lease that spans a dead rank onto its replacement:

@@ -539,6 +539,33 @@ def elastic_snapshot() -> dict:
         return {**_elastic, "gauges": dict(_elastic_gauges)}
 
 
+# -- serve frame-path block (tpu_mpi.serve) ----------------------------------
+#
+# Process-global like the infer block: the session/mailbox frame path spans
+# every tenant connection, so per-comm attribution would smear one wire hop
+# over many comms. ``ops`` counts OP/RESULT frames carrying array payloads,
+# ``copies`` counts payload materializations (ascontiguousarray / tobytes /
+# non-view marshalling) on that path — the zero-copy acceptance gate is
+# copies/ops <= 1 — ``sg_writes`` counts scatter-gather sendmsg calls and
+# ``zc_bytes`` the payload bytes that travelled as views.
+
+_serve_frame: Dict[str, int] = {}
+
+
+def note_serve_frame(**counts: int) -> None:
+    """Accumulate serve frame-path counters (ops, copies, sg_writes,
+    zc_bytes, ...)."""
+    with _store_lock:
+        for k, v in counts.items():
+            _serve_frame[k] = _serve_frame.get(k, 0) + int(v)
+
+
+def serve_frame_snapshot() -> dict:
+    """The serve_frame block of :func:`snapshot` (may be empty)."""
+    with _store_lock:
+        return dict(_serve_frame)
+
+
 def note_explore(comm: Any, explored: bool) -> None:
     """One online-autotuner decision on this comm (tpu_mpi.tune_online):
     ``explored`` when the call was routed to an alternate arm."""
@@ -624,7 +651,8 @@ def snapshot(rank: Optional[int] = None, reset: bool = False) -> dict:
     return {"schema": 1, "kind": "tpu_mpi-pvars", "level": level(),
             "topology": _topology_stamp(),
             "comms": comms, "plan_cache": plans.stats(),
-            "infer": infer_snapshot(), "elastic": elastic_snapshot()}
+            "infer": infer_snapshot(), "elastic": elastic_snapshot(),
+            "serve_frame": serve_frame_snapshot()}
 
 
 def comm_snapshot(comm: Any, reset: bool = False) -> dict:
@@ -652,6 +680,7 @@ def reset() -> None:
         _infer_gauges.clear()
         _elastic.clear()
         _elastic_gauges.clear()
+        _serve_frame.clear()
         _store_gen += 1
 
 

@@ -43,7 +43,10 @@ import itertools
 import json
 import os
 import queue
+import secrets
 import socket
+import subprocess
+import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -54,10 +57,11 @@ from .. import config
 from .. import error as _ec
 from ..analyze import events as _ev
 from ..error import MPIError, PoolDegradedError, ProcFailedError, SessionError
-from .._runtime import SpmdContext, set_current_tenant, set_env
+from .._runtime import CidNamespace, SpmdContext, set_current_tenant, set_env
 from . import protocol
-from .ledger import Ledger
+from .ledger import CidShard, Ledger
 from .queueing import FairQueue
+from .worker import _cidify
 
 _OPS = None                       # lazy operator table (imports jax)
 
@@ -103,9 +107,13 @@ class _ThreadPool:
 
     kind = "threads"
 
-    def __init__(self, nranks: int):
+    def __init__(self, nranks: int, shard: Optional[CidShard] = None):
         self.nranks = int(nranks)              # configured (restore-target) size
         self.ctx = SpmdContext(self.nranks)
+        # multi-broker scale-out: this broker carves tenant namespaces from
+        # its own disjoint cid shard (serve.ledger.CidShard)
+        self.shard = shard or CidShard()
+        self.ctx._ns_next_base = self.shard.base
         # elastic membership (tpu_mpi.elastic): `active` is the pool-wide
         # comm's group in merge order (survivors first, replacements after);
         # `failed` holds declared-dead world ranks; `retired` the subset
@@ -414,8 +422,25 @@ class _ThreadPool:
             return None
         raise MPIError(f"unknown serve op kind {op.kind!r}", code=_ec.ERR_ARG)
 
+    # -- elastic rounds (driven by ElasticController._round) ------------------
+    def elastic_round(self, op: str, epoch: int) -> None:
+        """One rebind round on every rank of the pool-wide comm: the rank
+        workers themselves rendezvous — a REAL Barrier, so explore models
+        it and T214 audits the participant set."""
+        from ..elastic.protocol import rebind_round
+        comm = self.base_comm
+        declared = tuple(comm.group)
+        self.run_on(list(declared), None,
+                    lambda rank: rebind_round(comm, op, epoch=epoch,
+                                              declared=declared))
+
     # -- namespace plumbing (delegates to the warm context) -------------------
     def lease_ns(self, tenant: str, span: int):
+        if self.ctx._ns_next_base + span > self.shard.limit:
+            raise SessionError(
+                f"broker cid shard {self.shard!r} exhausted — no room for a "
+                f"{span}-cid namespace (shard the fleet wider or raise the "
+                f"span)")
         return self.ctx.lease_cid_namespace(tenant, span=span)
 
     def release_ns(self, tenant: str) -> list:
@@ -429,7 +454,601 @@ class _ThreadPool:
         return {"kind": self.kind, "nranks": self.nranks,
                 "active": list(self.active), "failed": sorted(self.failed),
                 "capacity": len(self.healthy()),
-                "comms": len(self._comms)}
+                "comms": len(self._comms),
+                "shard": [self.shard.base, self.shard.limit]}
+
+
+class _PoolComm:
+    """Broker-side stand-in for a procs-pool communicator. The broker only
+    tracks (group, cid) — the real Comm objects, channels, and payloads
+    live in the worker processes; everything the Broker/elastic layers read
+    off a comm (``.group``, ``.cid``) is here."""
+
+    __slots__ = ("group", "cid", "name")
+
+    def __init__(self, group, cid, name: str = "pool-comm"):
+        self.group = tuple(group)
+        self.cid = cid
+        self.name = name
+
+
+class _BrokerCtx:
+    """Context shim for the procs backend: the broker process holds no warm
+    SpmdContext, but the serve layers still need a tracer anchor
+    (``events.tracer_for``) and the tenant cid-namespace books — which on
+    this tier are pure broker-side bookkeeping (workers learn cids from
+    explicit register/rebind frames, so no shared allocator is needed)."""
+
+    def __init__(self, size: int, shard: CidShard):
+        self.size = size
+        self.cid_namespaces: Dict[str, CidNamespace] = {}
+        self._ns_lock = threading.Lock()
+        self._ns_next_base = shard.base
+        self._ns_limit = shard.limit
+        self.revoked_cids: set = set()
+
+
+class _WorkerLink:
+    """One pool worker process as the broker sees it: its control socket
+    plus liveness state. ``closing`` marks a deliberate broker-side close
+    (shutdown, retire) so the reader's EOF isn't booked as a failure."""
+
+    __slots__ = ("rank", "sock", "pid", "closing")
+
+    def __init__(self, rank: int, sock, pid: int):
+        self.rank = rank
+        self.sock = sock
+        self.pid = pid
+        self.closing = False
+
+
+class _Pending:
+    """An in-flight pool request fanned out to a set of worker ranks; fires
+    (event + optional callback) once every rank replied or died."""
+
+    __slots__ = ("oid", "want", "replies", "error", "event", "cb")
+
+    def __init__(self, oid: int, ranks, cb=None):
+        self.oid = oid
+        self.want = set(ranks)
+        self.replies: Dict[int, tuple] = {}
+        self.error: Optional[BaseException] = None
+        self.event = threading.Event()
+        self.cb = cb
+
+
+class _ProcsPool:
+    """The procs-backend warm world: one OS process per pool rank on the
+    native framed transport (serve/worker.py), driven over per-worker
+    control sockets. The broker process never joins the world — it owns the
+    rendezvous (launcher.Rendezvous, shared with classic ``tpurun --procs``)
+    and speaks the session frame protocol to each worker.
+
+    Ordering invariant: every frame to every worker is sent under ONE
+    dispatch lock and each worker executes its frames serially, so all
+    ranks initiate collectives in the same global order — the same
+    invariant the thread backend's atomic queue fan-out provides.
+
+    Failure detection is two-plane: the broker sees a worker's control-
+    socket EOF immediately (→ ``on_failure``), and the workers run the
+    transport heartbeat detector so in-flight collectives spanning the dead
+    rank raise typed ``ProcFailedError`` instead of hanging."""
+
+    kind = "procs"
+
+    #: seconds to wait for first-generation workers (cold jax import + Init)
+    START_TIMEOUT = 300.0
+
+    def __init__(self, nranks: int, shard: Optional[CidShard] = None,
+                 on_failure=None, sim: Optional[int] = 1):
+        self.nranks = int(nranks)
+        self.shard = shard or CidShard()
+        self.ctx = _BrokerCtx(self.nranks, self.shard)
+        self.active: List[int] = list(range(self.nranks))
+        self.failed: set = set()
+        self.retired: set = set()
+        self.base_comm: Any = None
+        self.sim = sim                       # CPU-sim chips per worker; None = real
+        self._on_failure = on_failure
+        self._dispatch_lock = threading.Lock()
+        self._comms: Dict[Any, Any] = {}
+        self._comms_lock = threading.Lock()
+        self._links: Dict[int, _WorkerLink] = {}
+        self._links_lock = threading.Lock()
+        self._link_cond = threading.Condition(self._links_lock)
+        self._pending: Dict[int, _Pending] = {}
+        self._pending_lock = threading.Lock()
+        self._wire_oid = itertools.count(1)
+        self._pool_cid = itertools.count(101)  # pool-internal cids < NS_FLOOR
+        self._token = secrets.token_hex(16)
+        self._rdv = None
+        self._listener = None
+        self.pool_addr: Optional[str] = None
+        self._procs: List[subprocess.Popen] = []
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        from ..launcher import Rendezvous
+        self._listener, self.pool_addr = protocol.listen(None)
+        self._listener.settimeout(0.2)
+        t = threading.Thread(target=self._accept_loop,
+                             name="serve-pool-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+        self._rdv = Rendezvous(self.nranks)
+        extra = {"TPU_MPI_SERVE_POOL_ADDR": self.pool_addr,
+                 "TPU_MPI_SERVE_POOL_TOKEN": self._token}
+        # failure detection must be ON in the workers: a SIGKILL'd sibling
+        # has to surface as a typed ProcFailedError from the in-flight
+        # collective, not a hang (operator-set values win)
+        if "TPU_MPI_HEARTBEAT_MS" not in os.environ:
+            extra["TPU_MPI_HEARTBEAT_MS"] = "500"
+        if "TPU_MPI_FAILURE_TIMEOUT_MS" not in os.environ:
+            extra["TPU_MPI_FAILURE_TIMEOUT_MS"] = "2000"
+        for r in range(self.nranks):
+            env = self._rdv.child_env(r, sim=self.sim, extra=extra)
+            # -c (not -m): serve/__init__ imports the worker module, so
+            # runpy would warn about re-executing it as __main__
+            self._procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 "import tpu_mpi.serve.worker as w; raise SystemExit(w.main())"],
+                env=env))
+        self._wait_links(range(self.nranks), self.START_TIMEOUT)
+        self._warm()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                kind, meta, _ = protocol.recv_frame(conn)
+            except (protocol.Disconnect, SessionError):
+                conn.close()
+                continue
+            if (kind != protocol.HELLO or meta.get("role") != "worker"
+                    or not hmac.compare_digest(str(meta.get("token") or ""),
+                                               self._token)):
+                conn.close()
+                continue
+            link = _WorkerLink(int(meta["rank"]), conn,
+                               int(meta.get("pid") or 0))
+            with self._links_lock:
+                self._links[link.rank] = link
+                self._link_cond.notify_all()
+            t = threading.Thread(target=self._reader, args=(link,),
+                                 name=f"serve-pool-r{link.rank}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _wait_links(self, ranks, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        ranks = list(ranks)
+        with self._links_lock:
+            while not all(r in self._links for r in ranks):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    missing = [r for r in ranks if r not in self._links]
+                    raise SessionError(
+                        f"pool worker(s) {missing} never dialed the broker "
+                        f"within {timeout:.0f}s")
+                self._link_cond.wait(left)
+
+    def _reader(self, link: _WorkerLink) -> None:
+        while True:
+            try:
+                kind, meta, arrays = protocol.recv_frame(link.sock)
+            except (protocol.Disconnect, SessionError, OSError):
+                break
+            oid = meta.get("oid")
+            if oid is None:
+                continue
+            err = None
+            if kind == protocol.ERROR:
+                try:
+                    protocol.raise_for_error(meta)
+                except MPIError as e:
+                    err = e
+            self._resolve(oid, link.rank, meta, arrays, err)
+        self._link_down(link)
+
+    def _link_down(self, link: _WorkerLink) -> None:
+        if self._stop.is_set() or link.closing:
+            return
+        with self._links_lock:
+            if self._links.get(link.rank) is link:
+                del self._links[link.rank]
+        err = ProcFailedError(f"pool worker rank {link.rank} died "
+                              f"(control socket EOF)")
+        fire = []
+        with self._pending_lock:
+            for oid, p in list(self._pending.items()):
+                if link.rank in p.want:
+                    p.want.discard(link.rank)
+                    if p.error is None:
+                        p.error = err
+                    if not p.want:
+                        del self._pending[oid]
+                        fire.append(p)
+        for p in fire:
+            p.event.set()
+            if p.cb is not None:
+                p.cb(p)
+        if self._on_failure is not None:
+            self._on_failure(link.rank)
+
+    def _resolve(self, oid: int, rank: int, meta: dict, arrays: list,
+                 err: Optional[BaseException]) -> None:
+        with self._pending_lock:
+            p = self._pending.get(oid)
+            if p is None or rank not in p.want:
+                return
+            p.want.discard(rank)
+            p.replies[rank] = (meta, arrays)
+            if err is not None and p.error is None:
+                p.error = err
+            done = not p.want
+            if done:
+                del self._pending[oid]
+        if done:
+            p.event.set()
+            if p.cb is not None:
+                p.cb(p)
+
+    # -- frame plumbing ------------------------------------------------------
+    def _request(self, ranks, metas, arrays=None, cb=None) -> _Pending:
+        """Fan one OP frame per rank out under the dispatch lock (the
+        global-initiation-order invariant) and register the pending entry
+        BEFORE sending. ``metas`` is one dict for all ranks or a per-rank
+        list; a missing/dead link resolves that rank as a failure."""
+        ranks = list(ranks)
+        oid = next(self._wire_oid)
+        p = _Pending(oid, ranks, cb)
+        with self._pending_lock:
+            self._pending[oid] = p
+        dead = []
+        with self._dispatch_lock:
+            for i, r in enumerate(ranks):
+                with self._links_lock:
+                    link = self._links.get(r)
+                if link is None:
+                    dead.append(r)
+                    continue
+                m = dict(metas[i] if isinstance(metas, list) else metas)
+                m["oid"] = oid
+                try:
+                    protocol.send_frame(link.sock, protocol.OP, m,
+                                        arrays[i] if arrays else ())
+                except protocol.Disconnect:
+                    dead.append(r)
+        for r in dead:
+            self._resolve(oid, r, {}, [],
+                          ProcFailedError(f"pool worker rank {r} is gone"))
+        return p
+
+    def _cast(self, ranks, meta: dict) -> None:
+        """Fire-and-forget control frame (register/rebind/revoke_ns):
+        ordering with later ops on the same worker is the socket's FIFO."""
+        with self._dispatch_lock:
+            for r in ranks:
+                with self._links_lock:
+                    link = self._links.get(r)
+                if link is None:
+                    continue
+                try:
+                    protocol.send_frame(link.sock, protocol.OP, dict(meta))
+                except protocol.Disconnect:
+                    pass
+
+    @staticmethod
+    def _await(p: _Pending, timeout: float, what: str):
+        if not p.event.wait(timeout):
+            raise SessionError(f"{what} timed out on the procs pool "
+                               f"after {timeout:.0f}s")
+        if p.error is not None:
+            raise p.error
+        return p
+
+    def _warm(self) -> None:
+        cid = next(self._pool_cid)
+        group = tuple(range(self.nranks))
+        comm = _PoolComm(group, cid, name="serve-warm")
+        with self._comms_lock:
+            self._comms[cid] = comm
+        self.base_comm = comm
+        p = self._request(list(group), {"wop": "warm", "cid": cid,
+                                        "group": list(group)})
+        self._await(p, self.START_TIMEOUT, "pool warm-up")
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._links_lock:
+            links = list(self._links.values())
+            self._links.clear()
+        for link in links:
+            link.closing = True
+            try:
+                protocol.send_frame(link.sock, protocol.OP,
+                                    {"wop": "shutdown"})
+            except (protocol.Disconnect, OSError):
+                pass
+        for link in links:
+            try:
+                link.sock.close()
+            except OSError:
+                pass
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        deadline = time.monotonic() + 20
+        for pr in self._procs:
+            try:
+                pr.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                pr.kill()
+                try:
+                    pr.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
+        if self._rdv is not None:
+            try:
+                self._rdv.close(sweep=True)
+            except Exception:      # noqa: BLE001 - teardown best-effort
+                pass
+
+    # -- elastic membership --------------------------------------------------
+    def healthy(self) -> List[int]:
+        return [r for r in self.active if r not in self.failed]
+
+    def dead_in(self, group) -> tuple:
+        return tuple(sorted(set(group) & self.failed))
+
+    def mark_failed(self, rank: int) -> bool:
+        """Failure verdict (control-socket EOF, or an idle retire): the
+        workers' own heartbeat plane unblocks their in-flight collectives;
+        broker-side there is nothing to poke — just the membership books."""
+        if rank in self.failed or rank not in self.active:
+            return False
+        self.failed.add(rank)
+        return True
+
+    # -- comm registry -------------------------------------------------------
+    def register_comm(self, group, cid, tenant: str):
+        group = tuple(group)
+        comm = _PoolComm(group, cid, name=f"serve:{tenant}")
+        with self._comms_lock:
+            self._comms[cid] = comm
+        self._cast(group, {"wop": "register", "cid": cid,
+                           "group": list(group)})
+        return comm
+
+    def comm_for(self, cid):
+        with self._comms_lock:
+            return self._comms.get(cid)
+
+    def drop_comm(self, cid) -> None:
+        with self._comms_lock:
+            self._comms.pop(cid, None)
+
+    def rebind_comm(self, cid, group, tenant: Optional[str]):
+        """Elastic rebind, procs flavor: the broker-side (group, cid) pair
+        is swapped and every member worker re-registers the SAME cid on the
+        remapped group (stale channel dropped worker-side)."""
+        group = tuple(group)
+        comm = _PoolComm(group, cid, name=f"serve:{tenant or 'pool'}")
+        with self._comms_lock:
+            self._comms[cid] = comm
+        self._cast(group, {"wop": "rebind", "cid": cid,
+                           "group": list(group)})
+        return comm
+
+    # -- elastic resize primitives (driven by tpu_mpi.elastic) ----------------
+    def adopt_base(self, comm) -> None:
+        with self._comms_lock:
+            self._comms[comm.cid] = comm
+        self.base_comm = comm
+        self.active = list(comm.group)
+
+    def shrink_base(self) -> tuple:
+        """Collapse the pool-wide comm to its survivors. The broker is the
+        failure authority here: it ships the declared-dead set with the
+        shrink frame, so a drain-and-retire (worker alive, just idle) walks
+        the same ULFM path a SIGKILL does; the retiree is then told to shut
+        down instead of being conscripted (it is a real process — unlike
+        the thread tier, it CAN die independently)."""
+        base = self.base_comm
+        group = list(base.group)
+        survivors = [r for r in group if r not in self.failed]
+        dead = tuple(r for r in group if r in self.failed)
+        p = self._request(survivors, {"wop": "shrink", "cid": base.cid,
+                                      "dead": list(dead)})
+        self._await(p, 120.0, "pool shrink")
+        meta, _ = p.replies[survivors[0]]
+        shrunk = _PoolComm(tuple(meta["group"]), _cidify(meta["cid"]),
+                           name=f"{base.name}.shrink")
+        for r in dead:
+            self.retired.add(r)
+            self._close_link(r)
+        self.adopt_base(shrunk)
+        return shrunk, dead
+
+    def _close_link(self, rank: int) -> None:
+        with self._links_lock:
+            link = self._links.pop(rank, None)
+        if link is None:
+            return
+        link.closing = True
+        try:
+            protocol.send_frame(link.sock, protocol.OP, {"wop": "shutdown"})
+        except (protocol.Disconnect, OSError):
+            pass
+        try:
+            link.sock.close()
+        except OSError:
+            pass
+
+    def grow_base(self, n: int) -> tuple:
+        """GROW on real processes: survivors Comm_spawn n replacement
+        worker processes (serve.worker._pool_child_entry) and merge; each
+        child dials the broker's pool socket itself — the address rides the
+        spawn environment. Completion = survivor replies AND every new
+        rank's HELLO."""
+        base = self.base_comm
+        survivors = [r for r in base.group if r not in self.failed]
+        p = self._request(survivors, {"wop": "grow", "cid": base.cid,
+                                      "n": int(n)})
+        self._await(p, self.START_TIMEOUT, "pool grow")
+        meta, _ = p.replies[survivors[0]]
+        merged = _PoolComm(tuple(meta["group"]), _cidify(meta["cid"]),
+                           name=f"{base.name}.merge")
+        new_ranks = tuple(r for r in merged.group if r not in base.group)
+        self._wait_links(new_ranks, self.START_TIMEOUT)
+        self.adopt_base(merged)
+        return merged, new_ranks
+
+    def elastic_round(self, op: str, epoch: int) -> None:
+        comm = self.base_comm
+        declared = tuple(comm.group)
+        p = self._request(list(declared),
+                          {"wop": "round", "cid": comm.cid, "op": op,
+                           "epoch": epoch, "declared": list(declared)})
+        self._await(p, 120.0, f"elastic {op} round")
+
+    # -- op execution --------------------------------------------------------
+    def run_op(self, op: PoolOp, on_done) -> None:
+        comm = self.comm_for(op.cid)
+        if comm is None:
+            op.error = SessionError(f"cid {op.cid} has no live communicator")
+            on_done(op)
+            return
+        group = comm.group
+        if op.kind == "dup":
+            # broker-side on this tier: cid allocation is pure broker
+            # bookkeeping, workers just register the fresh cid (FIFO keeps
+            # it ahead of any op the tenant issues on it)
+            try:
+                ns = self.ctx.cid_namespaces.get(op.tenant)
+                if ns is None:
+                    raise SessionError(f"tenant {op.tenant!r} has no leased "
+                                       f"cid namespace on this broker")
+                new_cid = ns.alloc()
+            except MPIError as e:
+                op.error = e
+                on_done(op)
+                return
+            self._cast(group, {"wop": "register", "cid": new_cid,
+                               "group": list(group)})
+            op.results = [_PoolComm(group, new_cid,
+                                    name=f"serve:{op.tenant}.dup")]
+            on_done(op)
+            return
+        metas: list = []
+        arrays: list = []
+        if op.kind in ("allreduce", "bcast", "barrier"):
+            for i in range(len(group)):
+                m = {"wop": "coll", "cid": op.cid, "kind": op.kind, "i": i,
+                     "reduce": op.reduce, "root": op.root, "ret": i == 0}
+                if op.kind == "allreduce":
+                    # per-rank scatter: each worker receives only ITS part,
+                    # forwarded as a view of the client's frame (zero-copy)
+                    a = [op.parts[i] if len(op.parts) > 1 else op.parts[0]]
+                elif op.kind == "bcast" and i == op.root:
+                    a = [op.parts[0]]
+                else:
+                    if op.kind == "bcast":
+                        m["desc"] = {"dtype": op.parts[0].dtype.str,
+                                     "shape": list(op.parts[0].shape)}
+                    a = []
+                metas.append(m)
+                arrays.append(a)
+        elif op.kind == "free":
+            metas = [{"wop": "free", "cid": op.cid}] * len(group)
+            arrays = [()] * len(group)
+        else:
+            op.error = MPIError(f"unknown serve op kind {op.kind!r}",
+                                code=_ec.ERR_ARG)
+            on_done(op)
+            return
+
+        def cb(p: _Pending) -> None:
+            if p.error is not None:
+                op.error = p.error
+            else:
+                _, arr0 = p.replies.get(group[0], ({}, []))
+                op.results = [np.asarray(arr0[0]) if arr0 else None]
+            on_done(op)
+
+        self._request(list(group), metas, arrays, cb=cb)
+
+    # -- namespace plumbing (broker-local books on this tier) -----------------
+    def lease_ns(self, tenant: str, span: int):
+        with self.ctx._ns_lock:
+            if tenant in self.ctx.cid_namespaces:
+                raise SessionError(f"tenant {tenant!r} already holds a lease "
+                                   f"on this broker")
+            base = self.ctx._ns_next_base
+            if base + span > self.ctx._ns_limit:
+                raise SessionError(
+                    f"broker cid shard {self.shard!r} exhausted — no room "
+                    f"for a {span}-cid namespace")
+            self.ctx._ns_next_base += span
+            ns = CidNamespace(tenant, base, base + span)
+            self.ctx.cid_namespaces[tenant] = ns
+            return ns
+
+    def release_ns(self, tenant: str) -> list:
+        with self.ctx._ns_lock:
+            ns = self.ctx.cid_namespaces.pop(tenant, None)
+        if ns is None:
+            return []
+        self.ctx.revoked_cids.update(range(ns.base, ns._next))
+        self._cast(tuple(self.healthy()),
+                   {"wop": "revoke_ns", "base": ns.base, "limit": ns._next})
+        return []
+
+    def snapshot_pvars(self) -> dict:
+        """Fleet pvar snapshot: the broker-local blocks (serve_frame lives
+        here) merged with every healthy worker's — comm records concatenate
+        (attribution folds them by cid), serve_frame counters sum."""
+        from .. import perfvars
+        snap = perfvars.snapshot()
+        comms = list(snap.get("comms") or [])
+        frame = dict(snap.get("serve_frame") or {})
+        ranks = self.healthy()
+        if ranks:
+            p = self._request(list(ranks), {"wop": "pvars"})
+            try:
+                self._await(p, 30.0, "pool pvar snapshot")
+            except MPIError:
+                pass                       # degrade: report what arrived
+            for r in ranks:
+                rep = p.replies.get(r)
+                if rep is None:
+                    continue
+                ws = rep[0].get("snapshot") or {}
+                comms.extend(ws.get("comms") or [])
+                for k, v in (ws.get("serve_frame") or {}).items():
+                    frame[k] = frame.get(k, 0) + int(v)
+        snap["comms"] = comms
+        snap["serve_frame"] = frame
+        return snap
+
+    def info(self) -> dict:
+        with self._links_lock:
+            workers = {r: link.pid for r, link in sorted(self._links.items())}
+        return {"kind": self.kind, "nranks": self.nranks,
+                "active": list(self.active), "failed": sorted(self.failed),
+                "capacity": len(self.healthy()),
+                "comms": len(self._comms),
+                "shard": [self.shard.base, self.shard.limit],
+                "pool_addr": self.pool_addr, "workers": workers}
 
 
 class Lease:
@@ -462,12 +1081,30 @@ class Broker:
                  quota_bytes: Optional[int] = None,
                  quantum: int = 1 << 16, max_depth: int = 64,
                  max_inflight: int = 2, ns_span: int = 256,
-                 infer=None, elastic=None):
+                 infer=None, elastic=None,
+                 backend: Optional[str] = None,
+                 shard=None):
         cfg = config.load()
         self.token = cfg.session_token if token is None else token
         self.max_tenants = (cfg.serve_max_tenants if max_tenants is None
                             else int(max_tenants))
-        self.pool = _ThreadPool(nranks)
+        backend = (cfg.serve_backend if backend is None else backend) \
+            or "threads"
+        self.backend = backend
+        if not isinstance(shard, CidShard):
+            shard = CidShard.parse(cfg.serve_shard if shard is None
+                                   else shard)
+        self.shard = shard
+        if backend == "procs":
+            self.pool = _ProcsPool(nranks, shard=shard,
+                                   on_failure=self.on_rank_failure)
+        elif backend == "threads":
+            self.pool = _ThreadPool(nranks, shard=shard)
+        else:
+            raise MPIError(
+                f"unknown serve backend {backend!r} "
+                f"(TPU_MPI_SERVE_BACKEND: 'threads' or 'procs')",
+                code=_ec.ERR_ARG)
         self.fq = FairQueue(quantum=quantum, max_depth=max_depth,
                             max_inflight=max_inflight)
         self.ledger = Ledger(cfg.serve_quota_bytes if quota_bytes is None
@@ -506,6 +1143,12 @@ class Broker:
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
         """Warm the pool, bind the socket, start dispatcher + acceptor."""
+        if self._infer_spec and self.pool.kind != "threads":
+            raise MPIError(
+                "tpu_mpi.infer runs on the thread backend only — start the "
+                "broker with TPU_MPI_SERVE_BACKEND=threads (or shard infer "
+                "tenants onto a threads broker behind the router)",
+                code=_ec.ERR_UNSUPPORTED_OPERATION)
         self.pool.start()
         if self._infer_spec:
             from ..infer import InferEngine, InferScheduler
@@ -518,7 +1161,9 @@ class Broker:
         if self._elastic_spec:
             from ..elastic import ElasticController
             self.elastic = ElasticController(self)
-            if config.load().elastic_sidecars:
+            # sidecars model per-rank process death for THREAD ranks; procs
+            # workers are real processes — control-socket EOF is the detector
+            if config.load().elastic_sidecars and self.pool.kind == "threads":
                 from ..elastic.sidecar import RankSidecars
                 self.sidecars = RankSidecars(self.pool.active,
                                              on_death=self.on_rank_failure)
@@ -1022,7 +1667,7 @@ class Broker:
 
     # -- accounting ----------------------------------------------------------
     def _owner_of_cid(self, cid) -> Optional[str]:
-        if isinstance(cid, tuple):
+        if isinstance(cid, (tuple, list)):   # wire-decoded tuple cids: list
             cid = next((c for c in cid if isinstance(c, int)), None)
         if not isinstance(cid, int):
             return None
@@ -1031,35 +1676,58 @@ class Broker:
                 return tenant
         return None
 
-    def flush_ledger(self) -> dict:
-        """Rebuild the measured books from a fresh pvar snapshot; the
-        returned pool totals equal the sum over tenants by construction."""
-        totals = self.ledger.flush_from_pvars(self.pool.snapshot_pvars(),
-                                              self._owner_of_cid)
+    def _flush_and_report(self) -> tuple:
+        """Measured-book flush + report in ONE ledger-lock acquisition
+        (Ledger.flush_and_report); the attribution pass runs lock-free."""
+        totals, rep = self.ledger.flush_and_report(self.pool.snapshot_pvars(),
+                                                   self._owner_of_cid)
         if _ev.enabled():
             # T208 front end: the flushed per-tenant measured rows plus the
             # pool totals and the live cid-ownership map, in one event the
             # trace verifier can re-add and cross-check
-            rep = self.ledger.report()
             measured = {t: dict(e.get("measured") or {})
                         for t, e in rep["tenants"].items()}
             _ev.record_serve(self.pool.ctx, "book", totals=dict(totals),
                              measured=measured,
                              ranges=[list(r) for r in self._cid_ranges])
+        return totals, rep
+
+    def flush_ledger(self) -> dict:
+        """Rebuild the measured books from a fresh pvar snapshot; the
+        returned pool totals equal the sum over tenants by construction."""
+        totals, _ = self._flush_and_report()
         return totals
 
     def stats(self) -> dict:
-        totals = self.flush_ledger()
+        """One STATS snapshot, batched: one ledger-lock acquisition (flush
+        + report fused), one queue-stats call, one lease-lock grab — a
+        1k-tenant fleet polling stats must not serialize the op path on
+        observability (ISSUE 15 satellite)."""
+        totals, report = self._flush_and_report()
         with self._lease_lock:
             live = sorted(self._leases)
         from ..overlap import plans
         return {"address": self.address, "pool": self.pool.info(),
+                "backend": self.pool.kind,
+                "shard": {"index": self.shard.index,
+                          "count": self.shard.count,
+                          "base": self.shard.base, "limit": self.shard.limit},
                 "tenants_attached": live, "totals": totals,
-                "ledger": self.ledger.report(), "queue": self.fq.stats(),
+                "ledger": report, "queue": self.fq.stats(),
                 "plan_cache": plans.stats(),
+                "serve_frame": self._serve_frame_block(),
                 "infer": (self._infer_sched.stats()
                           if self._infer_sched is not None else None),
                 "elastic": self._elastic_section()}
+
+    def _serve_frame_block(self) -> dict:
+        """The zero-copy frame pvars + the derived copies/op ratio the CI
+        gate reads (ISSUE 15: copies per op <= 1 on the zero-copy path)."""
+        from .. import perfvars
+        frame = dict(perfvars.serve_frame_snapshot())
+        ops = int(frame.get("ops", 0))
+        frame["copies_per_op"] = (frame.get("copies", 0) / ops) if ops else 0.0
+        return frame
 
 
 # -- tpurun --serve CLI -------------------------------------------------------
@@ -1093,6 +1761,28 @@ def main(argv: Optional[list] = None) -> int:
                    help="session token (default: TPU_MPI_SESSION_TOKEN)")
     p.add_argument("--max-tenants", type=int, default=None)
     p.add_argument("--quota-bytes", type=int, default=None)
+    p.add_argument("--backend", default=None, choices=["threads", "procs"],
+                   help="pool backend (default: TPU_MPI_SERVE_BACKEND, else "
+                        "threads): 'procs' runs one OS process per rank on "
+                        "the native framed transport")
+    p.add_argument("--shard", default=None,
+                   help="cid shard 'index/count' for multi-broker scale-out "
+                        "(default: TPU_MPI_SERVE_SHARD, else the whole "
+                        "range) — brokers of one fleet MUST use distinct "
+                        "indices of the same count")
+    p.add_argument("--router", action="store_true",
+                   help="run the tenant router instead of a broker: shards "
+                        "sessions across --brokers by tenant key "
+                        "(docs/serving.md 'Scale-out')")
+    p.add_argument("--brokers", default=None,
+                   help="comma-separated broker sockets (router upstreams, "
+                        "or multi-broker --stats; default: "
+                        "TPU_MPI_SERVE_BROKERS)")
+    p.add_argument("--router-mode", default=None,
+                   choices=("splice", "redirect"),
+                   help="router session handling: proxy every byte "
+                        "(splice) or answer HELLO with the home broker "
+                        "(redirect; default: TPU_MPI_SERVE_ROUTER_MODE)")
     p.add_argument("--infer", action="store_true",
                    help="serve token generation (tpu_mpi.infer): a "
                         "2-stage x N-expert MoE engine on the warm pool")
@@ -1108,22 +1798,56 @@ def main(argv: Optional[list] = None) -> int:
 
     cfg = config.load()
     if args.stats:
-        address = args.socket or cfg.serve_socket
-        if not address:
-            p.error("--stats needs --socket or TPU_MPI_SERVE_SOCKET")
+        # fleet view: --stats accepts one socket, a comma list, --brokers,
+        # or TPU_MPI_SERVE_BROKERS; multiple reports merge into one
+        # (per-tenant measured books still partition the summed totals)
+        spec = (args.brokers or args.socket or cfg.serve_brokers
+                or cfg.serve_socket)
+        sockets = [s.strip() for s in (spec or "").split(",") if s.strip()]
+        if not sockets:
+            p.error("--stats needs --socket/--brokers or "
+                    "TPU_MPI_SERVE_SOCKET/TPU_MPI_SERVE_BROKERS")
         token = cfg.session_token if args.token is None else args.token
-        print(json.dumps(_stats_client(address, token), indent=2,
-                         default=str))
+        reports = [_stats_client(s, token) for s in sockets]
+        if len(reports) == 1:
+            print(json.dumps(reports[0], indent=2, default=str))
+        else:
+            from .router import merge_stats
+            print(json.dumps(merge_stats(reports), indent=2, default=str))
+        return 0
+
+    if args.router:
+        from .router import Router
+        spec = args.brokers or cfg.serve_brokers
+        brokers = [s.strip() for s in (spec or "").split(",") if s.strip()]
+        if not brokers:
+            p.error("--router needs --brokers or TPU_MPI_SERVE_BROKERS")
+        router = Router(brokers,
+                        socket_spec=(args.socket or cfg.serve_router_socket
+                                     or None),
+                        token=args.token, mode=args.router_mode)
+        router.start()
+        print(f"tpu_mpi serve: router up — {len(brokers)} broker(s), "
+              f"mode={router.mode}, socket={router.address} "
+              f"(pid {os.getpid()})", flush=True)
+        try:
+            router.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            router.close()
         return 0
 
     broker = Broker(nranks=args.nranks, socket_spec=args.socket,
                     token=args.token, max_tenants=args.max_tenants,
                     quota_bytes=args.quota_bytes,
                     infer=True if args.infer else None,
-                    elastic=True if args.elastic else None)
+                    elastic=True if args.elastic else None,
+                    backend=args.backend, shard=args.shard)
     broker.start()
-    print(f"tpu_mpi serve: broker up — pool={args.nranks} ranks, "
-          f"socket={broker.address}"
+    print(f"tpu_mpi serve: broker up — pool={args.nranks} ranks "
+          f"({broker.pool.kind}), socket={broker.address}, "
+          f"shard={broker.shard.index}/{broker.shard.count}"
           + (", inference engine on" if args.infer else "")
           + (", elastic autoscaler on" if args.elastic else "")
           + f" (pid {os.getpid()})", flush=True)

@@ -24,9 +24,61 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
-from ..error import QuotaExceededError
+from .. import error as _ec
+from ..error import MPIError, QuotaExceededError
 
 POOL_TENANT = "_pool"     # pseudo-tenant for pre-lease / shared-cid traffic
+
+# Tenant cid namespaces start above this floor (the thread tier's
+# ``SpmdContext._ns_next_base``); shard bases are carved above it.
+NS_FLOOR = 1 << 20
+
+
+class CidShard:
+    """One broker's disjoint slice of the tenant cid space.
+
+    Multi-broker scale-out (docs/serving.md "Scale-out") gives broker
+    ``index`` of ``count`` the half-open cid range
+    ``[NS_FLOOR + index*span, NS_FLOOR + (index+1)*span)`` to carve tenant
+    namespaces from — ranges of distinct shards are disjoint BY
+    CONSTRUCTION (property-tested in tests/test_serve_scale.py), which is
+    what lets the measured books of N brokers be summed without a cid ever
+    landing in two tenants' rows (the cross-broker T208 invariant)."""
+
+    SPAN = 1 << 24          # cids per broker: room for ~16k default leases
+
+    def __init__(self, index: int = 0, count: int = 1,
+                 span: Optional[int] = None):
+        index, count = int(index), int(count)
+        if count < 1 or not (0 <= index < count):
+            raise MPIError(f"cid shard index {index}/{count} out of range",
+                           code=_ec.ERR_ARG)
+        self.index, self.count = index, count
+        self.span = int(span or self.SPAN)
+        self.base = NS_FLOOR + self.index * self.span
+        self.limit = self.base + self.span
+
+    @classmethod
+    def parse(cls, spec: str) -> "CidShard":
+        """``"index/count"`` (the TPU_MPI_SERVE_SHARD / --shard grammar);
+        ""/None means the single-broker whole-range shard."""
+        if not spec:
+            return cls()
+        idx, sep, cnt = str(spec).partition("/")
+        try:
+            if not sep:
+                raise ValueError(spec)
+            return cls(int(idx), int(cnt))
+        except ValueError:
+            raise MPIError(f"cid shard spec {spec!r} is not 'index/count'",
+                           code=_ec.ERR_ARG) from None
+
+    def owns(self, cid: Any) -> bool:
+        return isinstance(cid, int) and self.base <= cid < self.limit
+
+    def __repr__(self) -> str:
+        return (f"CidShard({self.index}/{self.count}, "
+                f"[{self.base}, {self.limit}))")
 
 
 class Ledger:
@@ -99,6 +151,52 @@ class Ledger:
         the owning tenant (None -> pool). Returns the pool-total row; the
         invariant ``sum(tenant rows) == pool totals`` holds by
         construction because every comm record lands in exactly one row."""
+        books, totals = self._attribute(snapshot, owner_of_cid)
+        with self._lock:
+            for t in self._tenants:
+                self._tenants[t]["measured"] = books.pop(t, {})
+            for t, row in books.items():
+                self._entry(t)["measured"] = row
+            self._flushes += 1
+            self._last_flush = time.time()
+        return totals
+
+    # -- reporting -----------------------------------------------------------
+    def report(self) -> dict:
+        with self._lock:
+            return self._report_locked()
+
+    def _report_locked(self) -> dict:
+        tenants = {}
+        for t, e in self._tenants.items():
+            tenants[t] = {k: v for k, v in e.items()}
+        return {"quota_bytes": self.quota_bytes, "tenants": tenants,
+                "flushes": self._flushes, "last_flush": self._last_flush}
+
+    def flush_and_report(self, snapshot: dict,
+                         owner_of_cid: Callable[[Any], Optional[str]]
+                         ) -> tuple[dict, dict]:
+        """Measured-book flush + report under ONE lock acquisition — the
+        STATS fast path (a 1k-tenant fleet polling stats must not take the
+        ledger lock three times per request; ISSUE 15 satellite).
+        Returns ``(pool_totals, report)``."""
+        books, totals = self._attribute(snapshot, owner_of_cid)
+        with self._lock:
+            for t in self._tenants:
+                self._tenants[t]["measured"] = books.pop(t, {})
+            for t, row in books.items():
+                self._entry(t)["measured"] = row
+            self._flushes += 1
+            self._last_flush = time.time()
+            return totals, self._report_locked()
+
+    @staticmethod
+    def _attribute(snapshot: dict,
+                   owner_of_cid: Callable[[Any], Optional[str]]
+                   ) -> tuple[Dict[str, dict], dict]:
+        """Lock-free attribution pass shared by :meth:`flush_from_pvars`
+        and :meth:`flush_and_report`: fold the snapshot's comm records into
+        per-tenant measured rows + the pool-total row."""
         fields = ("bytes_sent", "bytes_recv", "sends", "recvs")
         totals = {f: 0 for f in fields}
         totals["coll_ops"] = 0
@@ -114,20 +212,4 @@ class Ledger:
             nops = sum(int(v) for v in (rec.get("ops") or {}).values())
             row["coll_ops"] += nops
             totals["coll_ops"] += nops
-        with self._lock:
-            for t in self._tenants:
-                self._tenants[t]["measured"] = books.pop(t, {})
-            for t, row in books.items():
-                self._entry(t)["measured"] = row
-            self._flushes += 1
-            self._last_flush = time.time()
-        return totals
-
-    # -- reporting -----------------------------------------------------------
-    def report(self) -> dict:
-        with self._lock:
-            tenants = {}
-            for t, e in self._tenants.items():
-                tenants[t] = {k: v for k, v in e.items()}
-            return {"quota_bytes": self.quota_bytes, "tenants": tenants,
-                    "flushes": self._flushes, "last_flush": self._last_flush}
+        return books, totals

@@ -54,10 +54,11 @@ DETACH = 7
 BYE = 8
 PING = 9
 PONG = 10
+REDIRECT = 11      # router -> client: re-dial your home broker directly
 
 KIND_NAMES = {HELLO: "HELLO", LEASE: "LEASE", OP: "OP", RESULT: "RESULT",
               ERROR: "ERROR", STATS: "STATS", DETACH: "DETACH", BYE: "BYE",
-              PING: "PING", PONG: "PONG"}
+              PING: "PING", PONG: "PONG", REDIRECT: "REDIRECT"}
 
 _HDR = struct.Struct("!BIH")
 _BLOB = struct.Struct("!I")
@@ -72,55 +73,123 @@ class Disconnect(Exception):
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
+    """Read exactly ``n`` bytes into ONE preallocated buffer (no chunk-list
+    join copy — the receive side of the zero-copy frame path; the returned
+    bytearray is what ``np.frombuffer`` views directly)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
     got = 0
     while got < n:
         try:
-            chunk = sock.recv(min(n - got, 1 << 20))
+            r = sock.recv_into(view[got:], n - got)
         except (ConnectionResetError, BrokenPipeError, OSError) as e:
             raise Disconnect(f"connection lost mid-frame: {e}") from None
-        if not chunk:
-            if got == 0 and not chunks:
+        if r == 0:
+            if got == 0:
                 raise Disconnect("peer closed")
             raise Disconnect("peer closed mid-frame")
-        chunks.append(chunk)
-        got += len(chunk)
-    return b"".join(chunks)
+        got += r
+    return bytes(buf) if n < 64 else buf  # headers: hashable bytes is fine
+
+
+# Linux IOV_MAX is 1024; stay well under it per sendmsg call.
+_IOV_MAX = 512
+
+
+def _sendmsg_all(sock: socket.socket, parts: list) -> int:
+    """Scatter-gather send of a list of bytes-like parts with partial-send
+    resumption — the frame path's writev. Returns the number of sendmsg
+    syscalls issued (the ``sg_writes`` pvar)."""
+    bufs = [p if isinstance(p, memoryview) else memoryview(p)
+            for p in parts]
+    bufs = [b.cast("B") if b.ndim != 1 or b.format != "B" else b
+            for b in bufs if b.nbytes]
+    calls = 0
+    while bufs:
+        try:
+            n = sock.sendmsg(bufs[:_IOV_MAX])
+        except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            raise Disconnect(f"send failed: {e}") from None
+        calls += 1
+        while n:
+            if n >= len(bufs[0]):
+                n -= len(bufs.pop(0))
+            else:
+                bufs[0] = bufs[0][n:]
+                n = 0
+    return calls
 
 
 def send_frame(sock: socket.socket, kind: int,
                meta: Optional[dict] = None,
                arrays: Sequence[Any] = ()) -> None:
     """Serialize and send one frame (thread-safety is the caller's: wrap in
-    a per-connection send lock when several threads share the socket)."""
+    a per-connection send lock when several threads share the socket).
+
+    Zero-copy path (``TPU_MPI_SERVE_ZEROCOPY``, default on): array payloads
+    are scatter-gather written straight from their backing buffers via
+    ``sendmsg`` — a C-contiguous array (including the ``np.frombuffer``
+    views ``recv_frame`` hands the broker) crosses this hop with ZERO
+    marshalling copies; only a non-contiguous input pays one
+    ``ascontiguousarray`` materialization, counted in the ``serve_frame``
+    pvar block (gate: copies/op <= 1)."""
+    from .. import config, perfvars
     meta = dict(meta or {})
-    blobs = []
+    views: list = []
+    copies = 0
+    zc_bytes = 0
     if arrays:
         meta["blobs"] = []
         for a in arrays:
-            a = np.ascontiguousarray(np.asarray(a))
-            meta["blobs"].append({"dtype": a.dtype.str, "shape": list(a.shape)})
-            blobs.append(a.tobytes())
+            arr = np.asarray(a)
+            if not arr.flags.c_contiguous:
+                arr = np.ascontiguousarray(arr)
+                copies += 1
+            else:
+                zc_bytes += arr.nbytes
+            meta["blobs"].append({"dtype": arr.dtype.str,
+                                  "shape": list(arr.shape)})
+            views.append(arr)
     payload = json.dumps(meta, separators=(",", ":")).encode()
-    parts = [_HDR.pack(kind, len(payload), len(blobs)), payload]
-    for b in blobs:
-        parts.append(_BLOB.pack(len(b)))
-        parts.append(b)
-    try:
-        sock.sendall(b"".join(parts))
-    except (ConnectionResetError, BrokenPipeError, OSError) as e:
-        raise Disconnect(f"send failed: {e}") from None
+    if arrays and not config.load().serve_zerocopy:
+        # legacy marshal path (the before/after benchmark lane): every
+        # payload is flattened to bytes and joined into one send buffer
+        parts = [_HDR.pack(kind, len(payload), len(views)), payload]
+        for arr in views:
+            b = arr.tobytes()
+            parts.append(_BLOB.pack(len(b)))
+            parts.append(b)
+        try:
+            sock.sendall(b"".join(parts))
+        except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            raise Disconnect(f"send failed: {e}") from None
+        perfvars.note_serve_frame(ops=1, copies=copies + len(views),
+                                  sg_writes=0, zc_bytes=0)
+        return
+    parts = [_HDR.pack(kind, len(payload), len(views)), payload]
+    for arr in views:
+        parts.append(_BLOB.pack(arr.nbytes))
+        parts.append(memoryview(arr).cast("B") if arr.ndim else
+                     memoryview(arr.reshape(1)).cast("B"))
+    calls = _sendmsg_all(sock, parts)
+    if arrays:
+        perfvars.note_serve_frame(ops=1, copies=copies, sg_writes=calls,
+                                  zc_bytes=zc_bytes)
 
 
 def recv_frame(sock: socket.socket) -> tuple[int, dict, list]:
     """Receive one frame: (kind, meta, arrays). Raises Disconnect on EOF,
-    SessionError on a corrupt stream."""
+    SessionError on a corrupt stream. Each array is a ``np.frombuffer``
+    VIEW over the single receive buffer (no join/marshal copy), so
+    forwarding it through :func:`send_frame` keeps the whole
+    session-socket -> rank-mailbox hop copy-free."""
     from .. import config
     kind, json_len, nblobs = _HDR.unpack(_recv_exact(sock, _HDR.size))
     if kind not in KIND_NAMES or json_len > _MAX_JSON:
         raise SessionError(f"corrupt session frame (kind={kind}, "
                            f"json_len={json_len})")
-    meta = json.loads(_recv_exact(sock, json_len).decode()) if json_len else {}
+    meta = json.loads(bytes(_recv_exact(sock, json_len)).decode()) \
+        if json_len else {}
     max_blob = config.load().max_frame_bytes
     arrays = []
     descs = meta.get("blobs") or []

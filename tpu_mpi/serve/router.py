@@ -1,0 +1,267 @@
+"""Multi-broker scale-out: the tenant router + fleet stats merge.
+
+``tpurun --serve --router --brokers a,b,...`` runs a thin session-level
+proxy in front of N brokers (docs/serving.md "Scale-out"):
+
+    client ──HELLO──▶ router ──HELLO──▶ home broker (HRW by tenant key)
+    client ◀═════════ raw byte splice ═════════▶ home broker
+
+- **Assignment** is rendezvous (highest-random-weight) hashing over the
+  tenant key: deterministic, and STABLE under broker-list changes — removing
+  a broker remaps only the tenants it hosted; every other tenant keeps its
+  home (tests/test_serve_scale.py asserts both properties).
+- After forwarding the (possibly tenant-injected) HELLO, the router splices
+  raw bytes both ways until either side closes — no reframing, no payload
+  copies beyond the kernel's, and ``generate`` streams pin to the home
+  broker by construction (the whole connection lives there, so infer
+  engines shard across brokers with their tenants).
+- A STATS probe to the router fans out to every broker and merges the
+  reports with :func:`merge_stats`.
+
+Each broker behind a router MUST own a distinct cid shard
+(``--shard i/N`` / ``TPU_MPI_SERVE_SHARD``): the shards' cid ranges are
+disjoint by construction (serve.ledger.CidShard), which is what lets N
+brokers' measured books be summed without a cid ever landing in two
+tenants' rows — the cross-broker T208 invariant.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import socket
+import threading
+from typing import Dict, List, Optional
+
+from .. import config
+from ..error import MPIError, SessionError
+from . import protocol
+
+
+def assign_broker(tenant: str, brokers: List[str]) -> str:
+    """Rendezvous (HRW) hash: the broker maximizing sha1(tenant|broker).
+    Deterministic for a fixed list; removing a broker remaps ONLY its own
+    tenants (the defining HRW property); ties break on the broker string."""
+    if not brokers:
+        raise MPIError("assign_broker needs at least one broker")
+    return max(brokers,
+               key=lambda b: (hashlib.sha1(f"{tenant}|{b}".encode())
+                              .digest(), b))
+
+
+def _sum_into(dst: dict, src: dict) -> None:
+    """Recursively add numeric leaves of ``src`` into ``dst`` (fleet-total
+    merge for counter blocks)."""
+    for k, v in (src or {}).items():
+        if isinstance(v, bool):
+            dst[k] = bool(dst.get(k)) or v
+        elif isinstance(v, (int, float)):
+            dst[k] = dst.get(k, 0) + v
+        elif isinstance(v, dict):
+            _sum_into(dst.setdefault(k, {}), v)
+
+
+def merge_stats(reports: List[dict]) -> dict:
+    """Merge N per-broker STATS reports into one fleet view. Counter blocks
+    (totals, queue, serve_frame) sum; ledger tenants union — their measured
+    books still partition the summed pool totals because each broker
+    attributes only cids in its OWN disjoint shard (T208 across brokers).
+    A tenant name reused on two brokers keeps both rows, disambiguated as
+    ``name@b<i>``."""
+    merged: dict = {"brokers": [], "totals": {}, "queue": {},
+                    "serve_frame": {},
+                    "ledger": {"quota_bytes": 0, "tenants": {},
+                               "flushes": 0, "last_flush": None},
+                    "tenants_attached": []}
+    for i, rep in enumerate(reports):
+        merged["brokers"].append({
+            "address": rep.get("address"), "backend": rep.get("backend"),
+            "shard": rep.get("shard"), "pool": rep.get("pool"),
+            "infer": rep.get("infer"), "elastic": rep.get("elastic"),
+            "plan_cache": rep.get("plan_cache")})
+        _sum_into(merged["totals"], rep.get("totals") or {})
+        _sum_into(merged["serve_frame"], rep.get("serve_frame") or {})
+        led = rep.get("ledger") or {}
+        merged["ledger"]["quota_bytes"] += int(led.get("quota_bytes") or 0)
+        merged["ledger"]["flushes"] += int(led.get("flushes") or 0)
+        lf = led.get("last_flush")
+        if lf is not None and (merged["ledger"]["last_flush"] is None
+                               or lf > merged["ledger"]["last_flush"]):
+            merged["ledger"]["last_flush"] = lf
+        for t, row in (led.get("tenants") or {}).items():
+            key = t if t not in merged["ledger"]["tenants"] else f"{t}@b{i}"
+            merged["ledger"]["tenants"][key] = row
+        merged["tenants_attached"].extend(rep.get("tenants_attached") or [])
+        q = rep.get("queue") or {}
+        for k, v in q.items():
+            if k == "tenants":
+                tq = merged["queue"].setdefault("tenants", {})
+                for t, row in (v or {}).items():
+                    key = t if t not in tq else f"{t}@b{i}"
+                    tq[key] = row
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                merged["queue"][k] = merged["queue"].get(k, 0) + v
+    merged["tenants_attached"].sort()
+    merged["broker_count"] = len(reports)
+    return merged
+
+
+class Router:
+    """The session router daemon. Construct with the broker list, then
+    :meth:`start` + :meth:`serve_forever` (or drive :meth:`handle` from
+    tests)."""
+
+    def __init__(self, brokers: List[str], socket_spec: Optional[str] = None,
+                 *, token: Optional[str] = None, mode: Optional[str] = None):
+        if not brokers:
+            raise MPIError("Router needs at least one broker socket")
+        cfg = config.load()
+        mode = mode or cfg.serve_router_mode
+        if mode not in ("splice", "redirect"):
+            raise MPIError(f"router mode {mode!r} is not 'splice' or "
+                           f"'redirect' (TPU_MPI_SERVE_ROUTER_MODE)")
+        # splice: transparent byte proxy (clients only ever see the router).
+        # redirect: answer HELLO with the home broker and let the client
+        # re-dial it — the data path skips the router entirely (the
+        # serve_scale_sweep headline lane).
+        self.mode = mode
+        self.brokers = list(brokers)
+        self.token = cfg.session_token if token is None else token
+        self._socket_spec = socket_spec
+        self._listener: Optional[socket.socket] = None
+        self.address: Optional[str] = None
+        self._tenant_seq = itertools.count(1)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        # observability: tenant -> home broker of every live splice
+        self.routes: Dict[str, str] = {}
+        self._routes_lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        self._listener, self.address = protocol.listen(self._socket_spec)
+        self._listener.settimeout(0.2)
+
+    def serve_forever(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self.handle, args=(conn,),
+                                 name="serve-route", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def run_in_thread(self) -> threading.Thread:
+        self.start()
+        t = threading.Thread(target=self.serve_forever, name="serve-router",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        return t
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    # -- per-connection ------------------------------------------------------
+    def handle(self, conn: socket.socket) -> None:
+        try:
+            kind, meta, arrays = protocol.recv_frame(conn)
+        except (protocol.Disconnect, SessionError):
+            conn.close()
+            return
+        try:
+            if kind == protocol.STATS:
+                self._handle_stats(conn, meta)
+                return
+            if kind != protocol.HELLO:
+                protocol.send_frame(conn, protocol.ERROR, protocol.error_meta(
+                    SessionError(f"router expects HELLO or STATS, got "
+                                 f"{protocol.KIND_NAMES.get(kind, kind)}")))
+                return
+            self._handle_hello(conn, meta, arrays)
+        except (protocol.Disconnect, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_stats(self, conn, meta: dict) -> None:
+        from .broker import _stats_client
+        token = meta.get("token")
+        reports = []
+        for b in self.brokers:
+            try:
+                reports.append(_stats_client(b, token))
+            except (MPIError, OSError) as e:
+                reports.append({"address": b, "error": str(e)})
+        protocol.send_frame(conn, protocol.STATS, merge_stats(reports))
+
+    def _handle_hello(self, conn, meta: dict, arrays: list) -> None:
+        # the session key IS the tenant id; a keyless HELLO gets a router-
+        # generated one so its home is stable for the connection's lifetime
+        meta = dict(meta)
+        tenant = meta.get("tenant") or f"rt{next(self._tenant_seq)}"
+        meta["tenant"] = tenant
+        home = assign_broker(tenant, self.brokers)
+        if self.mode == "redirect":
+            protocol.send_frame(conn, protocol.REDIRECT,
+                                {"home": home, "tenant": tenant})
+            return
+        try:
+            upstream = protocol.connect(home)
+        except (OSError, MPIError) as e:
+            protocol.send_frame(conn, protocol.ERROR, protocol.error_meta(
+                SessionError(f"home broker {home} for tenant {tenant!r} "
+                             f"unreachable: {e}")))
+            return
+        with self._routes_lock:
+            self.routes[tenant] = home
+        try:
+            protocol.send_frame(upstream, protocol.HELLO, meta, arrays)
+            self._splice(conn, upstream)
+        finally:
+            with self._routes_lock:
+                self.routes.pop(tenant, None)
+            try:
+                upstream.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _splice(a: socket.socket, b: socket.socket) -> None:
+        """Pump raw bytes both ways until either side closes: past the
+        HELLO the router adds no framing, no copies beyond the kernel's,
+        and no per-op latency — the session runs at broker speed."""
+        def pump(src, dst, done):
+            try:
+                while True:
+                    chunk = src.recv(1 << 16)
+                    if not chunk:
+                        break
+                    dst.sendall(chunk)
+            except OSError:
+                pass
+            finally:
+                done.set()
+                for s in (src, dst):
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+        done = threading.Event()
+        t = threading.Thread(target=pump, args=(b, a, done),
+                             name="serve-splice", daemon=True)
+        t.start()
+        pump(a, b, done)
+        done.wait(timeout=5.0)

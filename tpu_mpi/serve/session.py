@@ -209,24 +209,35 @@ def attach(address: Optional[str] = None, *, token: Optional[str] = None,
         raise SessionError("no broker address: pass attach(address=...) or "
                            "set TPU_MPI_SERVE_SOCKET")
     token = cfg.session_token if token is None else token
-    sock = protocol.connect(address, timeout=timeout)
     hello: dict = {"token": token}
     if tenant is not None:
         hello["tenant"] = tenant
     if nranks is not None:
         hello["nranks"] = int(nranks)
-    try:
-        protocol.send_frame(sock, protocol.HELLO, hello)
-        kind, meta, _ = protocol.recv_frame(sock)
-    except protocol.Disconnect as e:
-        sock.close()
-        raise SessionError(f"broker at {address} hung up during attach: "
-                           f"{e}") from None
-    if kind == protocol.ERROR:
-        sock.close()
-        protocol.raise_for_error(meta)
-    if kind != protocol.LEASE:
-        sock.close()
-        raise SessionError(f"expected LEASE, got "
-                           f"{protocol.KIND_NAMES.get(kind, kind)}")
-    return ClientSession(sock, meta, address)
+    # one REDIRECT hop allowed: a router in redirect mode answers HELLO
+    # with the tenant's home broker and the data path goes direct
+    for _hop in range(2):
+        sock = protocol.connect(address, timeout=timeout)
+        try:
+            protocol.send_frame(sock, protocol.HELLO, hello)
+            kind, meta, _ = protocol.recv_frame(sock)
+        except protocol.Disconnect as e:
+            sock.close()
+            raise SessionError(f"broker at {address} hung up during attach: "
+                               f"{e}") from None
+        if kind == protocol.REDIRECT:
+            sock.close()
+            address = meta["home"]
+            if meta.get("tenant"):       # router-minted id: keep the HRW pin
+                hello["tenant"] = meta["tenant"]
+            continue
+        if kind == protocol.ERROR:
+            sock.close()
+            protocol.raise_for_error(meta)
+        if kind != protocol.LEASE:
+            sock.close()
+            raise SessionError(f"expected LEASE, got "
+                               f"{protocol.KIND_NAMES.get(kind, kind)}")
+        return ClientSession(sock, meta, address)
+    raise SessionError(f"attach followed a REDIRECT to {address} and was "
+                       f"redirected again — router loop?")
