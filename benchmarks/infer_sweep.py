@@ -8,19 +8,32 @@ for ``max_new`` greedy tokens. Reported per point:
 - **p50/p99 latency** and **p50/p99 per-request tokens/s** (tokens over
   the request's own wall time, queueing included — the number a tenant
   actually experiences);
-- aggregate delivered tokens/s;
+- aggregate delivered tokens/s, **collective rounds per emitted token**
+  (the pvar the decode fast path is measured by), and the **KV prefix
+  hit rate** on lanes with sharing enabled;
 - the broker's own SLO bookkeeping: hits, misses, evictions (typed retriable
   :class:`~tpu_mpi.error.SLOExpiredError` rejections of requests that
   waited past ``TPU_MPI_INFER_SLO_MS`` without being scheduled).
 
+The sweep runs one lane per **decode mode** (``--modes``): ``row_loop``
+(the pre-fast-path baseline: one dispatch round per request per layer),
+``vectorized`` (all co-batched rows in one Alltoallv round), ``spec_k``
+(+ speculative multi-token decode), and ``prefix_share`` (+ cross-tenant
+KV prefix sharing, driven with a shared system prompt so the hit rate is
+meaningful). Every lane emits bitwise-identical streams — the modes only
+move the knee.
+
 The **knee** is the first offered load where the engine visibly saturates:
-SLO evictions appear, or p99 latency crosses the SLO. The CI ``infer`` job
-gates the committed JSON on schema: p50 tokens/s finite at the lowest
-load, and the knee field recorded.
+SLO evictions appear, or p99 latency crosses the SLO. The headline
+``points``/``knee`` record is the full fast-path lane. The CI ``infer``
+job gates the committed JSON on schema: p50 tokens/s finite at the lowest
+load, the knee recorded past 100 req/s, and the shared-system-prompt
+lane's KV prefix hit rate at >=50%.
 
 Run:
     python benchmarks/infer_sweep.py [--loads 2,10,50] [--duration 3]
-        [--slo-ms 1500] [--json benchmarks/results/infer-slo-cpusim.json]
+        [--slo-ms 1500] [--modes row_loop,prefix_share]
+        [--json benchmarks/results/infer-slo-cpusim.json]
 """
 
 from __future__ import annotations
@@ -46,8 +59,28 @@ def pctl(xs: list, q: float):
     return ys[min(len(ys) - 1, int(q * len(ys)))]
 
 
-def run_point(broker, rps: float, duration_s: float, prompt_len: int,
-              max_new: int, max_clients: int) -> dict:
+# decode-mode lanes: engine spec per lane; later lanes subsume earlier
+# ones so the sweep reads as a cumulative speedup story
+MODES = {
+    "row_loop": {"vectorized": False, "spec_k": 1, "prefix_share": False},
+    "vectorized": {"vectorized": True, "spec_k": 1, "prefix_share": False},
+    "spec_k": {"vectorized": True, "spec_k": 8, "prefix_share": False},
+    "prefix_share": {"vectorized": True, "spec_k": 8, "prefix_share": True},
+}
+
+# lanes with sharing on serve a common system prompt (the cross-tenant
+# workload prefix sharing exists for); the others get disjoint prompts
+_SYS_PROMPT = [(11 * j + 5) % 64 for j in range(24)]
+
+
+def _prompt(mode: str, i: int, prompt_len: int) -> list:
+    if MODES[mode]["prefix_share"]:
+        return _SYS_PROMPT + [(7 * i + j) % 64 for j in range(4)]
+    return [(7 * i + j) % 64 for j in range(prompt_len)]
+
+
+def run_point(broker, mode: str, rps: float, duration_s: float,
+              prompt_len: int, max_new: int, max_clients: int) -> dict:
     from tpu_mpi import serve
     from tpu_mpi.error import SLOExpiredError
 
@@ -62,7 +95,7 @@ def run_point(broker, rps: float, duration_s: float, prompt_len: int,
         delay = i / rps - (time.perf_counter() - t_start)
         if delay > 0:
             time.sleep(delay)
-        prompt = [(7 * i + j) % 64 for j in range(prompt_len)]
+        prompt = _prompt(mode, i, prompt_len)
         with gate:
             try:
                 s = serve.attach(broker.address, token=broker.token,
@@ -96,6 +129,16 @@ def run_point(broker, rps: float, duration_s: float, prompt_len: int,
     after = dict(broker.stats().get("infer") or {})
     delta = {k: after.get(k, 0) - before.get(k, 0)
              for k in ("slo_hits", "slo_misses", "slo_evictions", "tokens")}
+
+    def nested(rec, blk, key):
+        return (rec.get(blk) or {}).get(key, 0) or 0
+    d_rounds = (nested(after, "decode", "moe_rounds")
+                - nested(before, "decode", "moe_rounds"))
+    d_tokens = delta["tokens"]
+    d_hit = (nested(after, "kv", "prefix_hit_tokens")
+             - nested(before, "kv", "prefix_hit_tokens"))
+    d_miss = (nested(after, "kv", "prefix_miss_tokens")
+              - nested(before, "kv", "prefix_miss_tokens"))
     completed = len(lat_ms)
     return {
         "offered_load_rps": rps, "requests": n, "completed": completed,
@@ -104,6 +147,10 @@ def run_point(broker, rps: float, duration_s: float, prompt_len: int,
         "p50_latency_ms": pctl(lat_ms, 0.50), "p99_latency_ms": pctl(lat_ms, 0.99),
         "p50_tokens_per_s": pctl(tps, 0.50), "p99_tokens_per_s": pctl(tps, 0.99),
         "delivered_tokens_per_s": round(completed * max_new / wall_s, 3),
+        "rounds_per_token": (round(d_rounds / d_tokens, 4)
+                             if d_tokens else None),
+        "kv_prefix_hit_rate": (round(d_hit / (d_hit + d_miss), 4)
+                               if d_hit + d_miss else None),
         "broker_slo": delta,
     }
 
@@ -129,51 +176,76 @@ def main() -> int:
     ap.add_argument("--max-new", type=int, default=8)
     ap.add_argument("--slo-ms", type=int, default=1500)
     ap.add_argument("--max-clients", type=int, default=48)
+    ap.add_argument("--modes", default="row_loop,prefix_share",
+                    help="comma-separated decode-mode lanes: "
+                         + ",".join(MODES))
     ap.add_argument("--json", default=None,
                     help="write results JSON here (e.g. "
                          "benchmarks/results/infer-slo-cpusim.json)")
     args = ap.parse_args()
     loads = [float(x) for x in args.loads.split(",") if x.strip()]
+    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+    bad = [m for m in modes if m not in MODES]
+    if bad:
+        ap.error(f"unknown modes {bad}; pick from {list(MODES)}")
 
     os.environ["TPU_MPI_INFER_SLO_MS"] = str(args.slo_ms)
     from tpu_mpi import config, serve
     config.load(refresh=True)
-    broker = serve.Broker(nranks=args.nranks, token="bench",
-                          max_tenants=args.max_clients + 8, infer=True)
-    broker.run_in_thread()
-    points = []
-    try:
-        # one warmup generation absorbs client/engine one-offs
-        s = serve.attach(broker.address, token="bench", tenant="warm")
-        s.generate(list(range(args.prompt_len)), max_new=2)
-        s.detach()
-        for rps in loads:
-            pt = run_point(broker, rps, args.duration, args.prompt_len,
-                           args.max_new, args.max_clients)
-            points.append(pt)
-            print(f"load {rps:>6.1f} req/s: {pt['completed']}/{pt['requests']} "
-                  f"ok, {pt['evicted']} evicted, "
-                  f"p50 {pt['p50_tokens_per_s'] or 0:.1f} tok/s, "
-                  f"p99 lat {pt['p99_latency_ms'] or 0:.0f} ms")
-            deadline = time.time() + 60
-            while time.time() < deadline:     # drain before the next point
-                inf = broker.stats().get("infer") or {}
-                if not inf.get("pending") and not inf.get("active"):
-                    break
-                time.sleep(0.05)
-    finally:
-        broker.close()
 
-    knee = find_knee(points, args.slo_ms)
+    lanes = {}
+    for mode in modes:
+        broker = serve.Broker(nranks=args.nranks, token="bench",
+                              max_tenants=args.max_clients + 8,
+                              infer=dict(MODES[mode]))
+        broker.run_in_thread()
+        points = []
+        try:
+            # one warmup generation absorbs client/engine one-offs (and on
+            # sharing lanes, seeds the system-prompt registry entry)
+            s = serve.attach(broker.address, token="bench", tenant="warm")
+            s.generate(_prompt(mode, 0, args.prompt_len), max_new=2)
+            s.detach()
+            for rps in loads:
+                pt = run_point(broker, mode, rps, args.duration,
+                               args.prompt_len, args.max_new,
+                               args.max_clients)
+                points.append(pt)
+                print(f"[{mode}] load {rps:>6.1f} req/s: "
+                      f"{pt['completed']}/{pt['requests']} ok, "
+                      f"{pt['evicted']} evicted, "
+                      f"p50 {pt['p50_tokens_per_s'] or 0:.1f} tok/s, "
+                      f"p99 lat {pt['p99_latency_ms'] or 0:.0f} ms, "
+                      f"{pt['rounds_per_token'] or 0:.2f} rounds/tok"
+                      + (f", kv hit {pt['kv_prefix_hit_rate']:.0%}"
+                         if pt["kv_prefix_hit_rate"] is not None else ""))
+                deadline = time.time() + 60
+                while time.time() < deadline:  # drain before the next point
+                    inf = broker.stats().get("infer") or {}
+                    if not inf.get("pending") and not inf.get("active"):
+                        break
+                    time.sleep(0.05)
+        finally:
+            broker.close()
+        knee = find_knee(points, args.slo_ms)
+        lanes[mode] = {
+            "engine": MODES[mode], "points": points,
+            "knee": {"offered_load_rps": knee, "found": knee is not None},
+        }
+        print(f"[{mode}] knee: "
+              f"{knee if knee is not None else 'not reached in sweep'}")
+
+    # the headline record is the last (most-capable) requested lane; the
+    # per-mode lanes ride alongside for the A/B story
+    head = lanes[modes[-1]]
     record = {
         "benchmark": "infer-slo", "substrate": "cpu-sim",
         "nranks": args.nranks, "slo_ms": args.slo_ms,
         "prompt_len": args.prompt_len, "max_new": args.max_new,
-        "duration_s": args.duration, "points": points,
-        "knee": {"offered_load_rps": knee, "found": knee is not None},
+        "duration_s": args.duration, "points": head["points"],
+        "knee": head["knee"], "mode": modes[-1], "lanes": lanes,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
-    print(f"knee: {knee if knee is not None else 'not reached in sweep'}")
     if args.json:
         os.makedirs(os.path.dirname(args.json), exist_ok=True)
         with open(args.json, "w") as f:

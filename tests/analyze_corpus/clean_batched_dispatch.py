@@ -1,0 +1,40 @@
+# nprocs: 2
+#
+# Clean fixture: the vectorized decode dispatch pattern — two co-batched
+# requests' rows are concatenated into ONE count exchange + Alltoallv
+# dispatch + Alltoallv combine per layer round, so each per-peer count
+# is the SUM of the co-batched requests' contributions. The books still
+# balance pairwise (rank i's scounts[j] == rank j's rcounts[i]) even
+# though no single request's rows alone would produce these vectors, so
+# the T201/T202 checks must stay silent.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+d = 2                                   # row width (d_model)
+
+# request A routes one row to each expert; request B routes both of its
+# rows to expert 1 — the batched plan is the per-peer sum of A + B.
+if rank == 0:
+    scounts, rcounts = [1, 3], [1, 1]   # A:[1,1] + B:[0,2]
+    send = np.arange(4 * d, dtype=np.float64)
+else:
+    scounts, rcounts = [1, 1], [3, 1]
+    send = np.arange(2 * d, dtype=np.float64) + 100.0
+
+# count exchange announces the batched plan (same shape every round)
+sbuf = np.array(scounts, np.int64)
+rbuf = np.zeros(2, np.int64)
+MPI.Alltoall(sbuf, rbuf, 1, comm)
+assert list(rbuf) == rcounts
+
+sc = [c * d for c in scounts]
+rc = [c * d for c in rcounts]
+recv = np.zeros(sum(rc))
+MPI.Alltoallv(send, recv, sc, rc, comm)       # dispatch
+back = np.zeros(sum(sc))
+MPI.Alltoallv(recv, back, rc, sc, comm)       # combine: counts transpose
+assert back.shape == (sum(sc),)
+MPI.Barrier(comm)

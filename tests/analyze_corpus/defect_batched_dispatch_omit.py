@@ -1,0 +1,29 @@
+# nprocs: 2
+#
+# Defect: batched-dispatch omission. Two requests are co-batched into
+# one Alltoallv dispatch, but rank 0 forgot to fold request B's rows
+# into its scounts toward rank 1 — it ships only request A's row while
+# rank 1 budgeted for A + B. The allocating form sizes its result from
+# the senders' counts, so the exchange completes without a runtime
+# error: request B's tokens silently never reach their expert. Only the
+# cross-rank per-peer count check (T202) can see that rank 0's send
+# plan disagrees with rank 1's receive plan.
+import numpy as np
+
+import tpu_mpi as MPI
+
+comm = MPI.COMM_WORLD
+rank = MPI.Comm_rank(comm)
+d = 2                                   # row width (d_model)
+
+if rank == 0:
+    scounts, rcounts = [1, 1], [1, 1]   # B's 2 rows toward rank 1: omitted
+    send = np.arange(2 * d, dtype=np.float64)
+else:
+    scounts, rcounts = [1, 1], [3, 1]   # still expects A + B from rank 0
+    send = np.arange(2 * d, dtype=np.float64) + 100.0
+
+sc = [c * d for c in scounts]
+rc = [c * d for c in rcounts]
+out = MPI.Alltoallv(send, sc, rc, comm)   # trace: T202
+MPI.Barrier(comm)

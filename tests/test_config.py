@@ -91,6 +91,37 @@ def test_serve_knobs(clean_env, monkeypatch):
     config.load(refresh=True)
 
 
+def test_decode_fastpath_knobs(clean_env, monkeypatch):
+    cfg = config.load(refresh=True)
+    assert cfg.infer_vectorized is True
+    assert cfg.infer_spec_k == 0
+    assert cfg.infer_prefill_chunk == 0
+    assert cfg.kv_prefix_share is False
+    monkeypatch.setenv("TPU_MPI_INFER_VECTORIZED", "0")
+    monkeypatch.setenv("TPU_MPI_INFER_SPEC_K", "4")
+    monkeypatch.setenv("TPU_MPI_INFER_PREFILL_CHUNK", "64")
+    monkeypatch.setenv("TPU_MPI_KV_PREFIX_SHARE", "1")
+    cfg = config.load(refresh=True)
+    assert cfg.infer_vectorized is False
+    assert cfg.infer_spec_k == 4
+    assert cfg.infer_prefill_chunk == 64
+    assert cfg.kv_prefix_share is True
+    # malformed values fail loudly, matching every other knob
+    monkeypatch.setenv("TPU_MPI_INFER_SPEC_K", "fast")
+    with pytest.raises(MPIError):
+        config.load(refresh=True)
+    monkeypatch.setenv("TPU_MPI_INFER_SPEC_K", "4")
+    monkeypatch.setenv("TPU_MPI_INFER_PREFILL_CHUNK", "a-few")
+    with pytest.raises(MPIError):
+        config.load(refresh=True)
+    monkeypatch.setenv("TPU_MPI_INFER_PREFILL_CHUNK", "0")
+    monkeypatch.setenv("TPU_MPI_KV_PREFIX_SHARE", "maybe")
+    with pytest.raises(MPIError):
+        config.load(refresh=True)
+    monkeypatch.setenv("TPU_MPI_KV_PREFIX_SHARE", "0")
+    config.load(refresh=True)
+
+
 def test_runtime_deadlock_timeout_uses_env(clean_env, monkeypatch):
     from tpu_mpi._runtime import deadlock_timeout
     monkeypatch.setenv("TPU_MPI_DEADLOCK_TIMEOUT", "7")

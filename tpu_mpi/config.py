@@ -242,6 +242,28 @@ class Config:
     # KV-cache paged-block granularity in tokens; also the partition size
     # for cross-stage prefill streaming over Psend_init/Precv_init.
     kv_block_tokens: int = 16
+    # decode fast path (docs/serving.md "Decode fast path"): batch every
+    # co-scheduled request's token rows into ONE MoE dispatch/combine per
+    # layer round instead of one round per prefill partition per request.
+    # Bitwise-identical outputs (row-wise math); off = the PR 12 row-loop
+    # baseline, kept for A/B lanes in benchmarks/infer_sweep.py.
+    infer_vectorized: bool = True
+    # speculative multi-token decode: draft up to k tokens per request per
+    # step from the session's own history, verify in one batched pass and
+    # accept the greedy-matching prefix. <= 1 = off (the k=1 baseline).
+    # Greedy acceptance keeps output streams bitwise identical to k=1.
+    infer_spec_k: int = 0
+    # per-step prefill token budget: a prompt longer than this is split
+    # across consecutive StepPlans so one giant prefill cannot
+    # head-of-line-block co-batched decodes. 0 = off (whole prompt in one
+    # step). The chunk boundaries ride in the rank-uniform plan.
+    infer_prefill_chunk: int = 0
+    # cross-tenant KV prefix sharing: content-hash full prompt-prefix
+    # blocks in the paged KV cache, refcounted + copy-on-write, so
+    # requests sharing a system prompt reuse physical KV blocks and skip
+    # recomputing the shared prefix. Tenants only ever match prefixes of
+    # tokens they themselves presented (admission-layer isolation).
+    kv_prefix_share: bool = False
     # LRU bound on the persistent-collective plan cache AND the auto-arm
     # signature table (the auto table is capped at max(8, this // 4)) —
     # the shape-churn pressure guard; evictions are counted in the pvar
@@ -343,6 +365,10 @@ _ENV_MAP = {
     "infer_slo_ms": "TPU_MPI_INFER_SLO_MS",
     "infer_max_batch": "TPU_MPI_INFER_MAX_BATCH",
     "kv_block_tokens": "TPU_MPI_KV_BLOCK_TOKENS",
+    "infer_vectorized": "TPU_MPI_INFER_VECTORIZED",
+    "infer_spec_k": "TPU_MPI_INFER_SPEC_K",
+    "infer_prefill_chunk": "TPU_MPI_INFER_PREFILL_CHUNK",
+    "kv_prefix_share": "TPU_MPI_KV_PREFIX_SHARE",
     "plan_cache_max": "TPU_MPI_PLAN_CACHE_MAX",
     "domains": "TPU_MPI_DOMAINS",
     "hier_min_bytes": "TPU_MPI_HIER_MIN_BYTES",
@@ -435,7 +461,12 @@ def _coerce(name: str, default: Any, raw: Any) -> Any:
     kind = type(default)
     try:
         if kind is bool:
-            return str(raw).lower() in ("1", "true", "yes", "on")
+            s = str(raw).lower()
+            if s in ("1", "true", "yes", "on"):
+                return True
+            if s in ("0", "false", "no", "off", ""):
+                return False
+            raise ValueError(s)
         return kind(raw)
     except (TypeError, ValueError):
         raise MPIError(f"config key {name}={raw!r} is not a valid {kind.__name__}",

@@ -7,21 +7,42 @@ stage 1 owns ``[L/2, L)`` on ranks ``[ep, R)``; rank ``s`` pairs with rank
 and attention run on the pair ``(s, s + ep)``, while its MoE FFN tokens
 route to whichever expert rank the gate picks via
 :func:`tpu_mpi.parallel.ep.moe_host_dispatch_combine` — two Alltoallv
-rendezvous plus a count Alltoall per layer per step, all passing through
+rendezvous plus a count Alltoall per layer round, all passing through
 the algorithm-selection layer and the online bandit's decision point.
+
+Decode fast path (docs/serving.md "Decode fast path"):
+
+- **Vectorized dispatch** (``TPU_MPI_INFER_VECTORIZED``, default on):
+  every co-batched prefill advances partition-p-for-everyone per round,
+  so one step makes ONE batched Alltoallv dispatch + one combine per
+  layer round with all requests' rows concatenated and per-peer counts
+  taken from the whole batch — instead of one round per request per
+  partition. Decode rows were already co-batched per layer.
+- **Speculative multi-token decode** (``TPU_MPI_INFER_SPEC_K``): a
+  :class:`Decode` feeds up to k token rows per request (last accepted
+  token + k-1 drafted); stage 1 accepts the longest prefix where each
+  drafted token equals the greedy output one row earlier, so every
+  accepted token is bitwise the k=1 token. Rejected rows' KV is rolled
+  back by the next plan's authoritative ``pos`` (no extra rendezvous).
+- **KV prefix sharing** (``TPU_MPI_KV_PREFIX_SHARE``): admission adopts
+  registered prompt-prefix blocks (:meth:`kv_prefix_acquire`) so prefill
+  only computes the divergent suffix.
 
 Determinism contract (the scheduler-order-independence acceptance): every
 batch-size-dependent reduction is forbidden. Attention is computed one
 token row at a time against that session's own KV; experts apply row-wise
-inside the dispatcher; the MoE capacity (``block_tokens`` for prefill,
-``max_batch`` for decode) always covers a sender's worst case, so no
-token is ever dropped by co-batching. A request's token sequence is a
-function of its prompt and the model alone.
+inside the dispatcher; the MoE capacity always covers a sender's worst
+case, so no token is ever dropped by co-batching. A request's token
+sequence is a function of its prompt and the model alone — which is the
+left-fold composition argument for why the batched dispatch, the
+speculative verify pass, and an adopted shared prefix all reproduce the
+row-loop k=1 private-KV stream bitwise.
 
 Rank-uniformity contract: all R ranks execute the SAME :class:`StepPlan`,
 so every rank makes the identical sequence of collective calls per step —
-non-home ranks contribute zero token rows. That is what lets prefill and
-decode co-batch freely without collective-order divergence (T201).
+non-home ranks contribute zero token rows, chunk boundaries and prefix
+hit lengths ride in the plan. That is what lets prefill and decode
+co-batch freely without collective-order divergence (T201/T202).
 
 Prefill streams stage 0 -> stage 1 through the partitioned-op machinery
 (:class:`~tpu_mpi.infer.kvcache.PartitionStreamWriter` /
@@ -51,17 +72,38 @@ N_STAGES = 2
 
 
 class Prefill:
-    __slots__ = ("rid", "slot", "tokens", "tag")
+    """One prompt chunk of one request: ``tokens`` starting at global
+    position ``pos0`` (> 0 after a prefix-share hit or an earlier chunk);
+    ``last`` marks the chunk that produces the first sampled token;
+    ``register`` carries the full prompt for prefix-registry publication
+    on the home pair (None = sharing off)."""
 
-    def __init__(self, rid: int, slot: int, tokens: List[int], tag: int):
+    __slots__ = ("rid", "slot", "tokens", "tag", "pos0", "last", "register")
+
+    def __init__(self, rid: int, slot: int, tokens: List[int], tag: int,
+                 pos0: int = 0, last: bool = True,
+                 register: Optional[List[int]] = None):
         self.rid, self.slot, self.tokens, self.tag = rid, slot, tokens, tag
+        self.pos0, self.last, self.register = int(pos0), bool(last), register
 
 
 class Decode:
-    __slots__ = ("rid", "slot", "token", "pos")
+    """One decode feed of one request: ``tokens[0]`` is the last accepted
+    token, the rest are speculative drafts; ``pos`` is the global position
+    of ``tokens[0]`` AND the authoritative KV length — every rank rolls
+    the session's chains back to ``pos`` before feeding (the speculative
+    rejection rollback, no extra rendezvous needed)."""
 
-    def __init__(self, rid: int, slot: int, token: int, pos: int):
-        self.rid, self.slot, self.token, self.pos = rid, slot, token, pos
+    __slots__ = ("rid", "slot", "tokens", "pos")
+
+    def __init__(self, rid: int, slot: int, tokens, pos: int):
+        self.rid, self.slot, self.pos = rid, slot, pos
+        self.tokens = [int(tokens)] if np.isscalar(tokens) else \
+            [int(t) for t in tokens]
+
+    @property
+    def token(self) -> int:
+        return self.tokens[0]
 
 
 class StepPlan:
@@ -110,7 +152,11 @@ class InferEngine:
     def __init__(self, pool, cfg=None, *, seed: int = 0,
                  max_batch: Optional[int] = None,
                  block_tokens: Optional[int] = None,
-                 kv_blocks: Optional[int] = None):
+                 kv_blocks: Optional[int] = None,
+                 vectorized: Optional[bool] = None,
+                 spec_k: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_share: Optional[bool] = None):
         from ..models.transformer import TransformerConfig
         nr = pool.nranks
         if nr < 2 or nr % 2:
@@ -137,6 +183,15 @@ class InferEngine:
         self.block_tokens = max(1, int(knobs.kv_block_tokens
                                        if block_tokens is None
                                        else block_tokens))
+        self.vectorized = bool(knobs.infer_vectorized
+                               if vectorized is None else vectorized)
+        self.spec_k = max(1, int(knobs.infer_spec_k
+                                 if spec_k is None else spec_k))
+        self.prefill_chunk = max(0, int(knobs.infer_prefill_chunk
+                                        if prefill_chunk is None
+                                        else prefill_chunk))
+        self.prefix_share = bool(knobs.kv_prefix_share
+                                 if prefix_share is None else prefix_share)
         if kv_blocks is None:
             per_sess = self.layers_local * math.ceil(self.cfg.max_seq
                                                      / self.block_tokens)
@@ -145,6 +200,8 @@ class InferEngine:
         self._state: Dict[int, dict] = {}
         self._reserved = [0] * self.ep
         self._resv_lock = threading.Lock()
+        self.moe_rounds = 0           # dispatch/combine rounds, both stages
+        self._rounds_lock = threading.Lock()
         self.wcomm = None
         self.ep_comms = (None, None)
 
@@ -222,6 +279,26 @@ class InferEngine:
         with self._resv_lock:
             self._reserved[slot] = max(0, self._reserved[slot] - need)
 
+    def kv_prefix_acquire(self, rid: int, slot: int,
+                          tokens: List[int]) -> int:
+        """Adopt the longest registered shared prompt prefix for ``rid``
+        on BOTH home ranks of ``slot``; the two caches evict
+        independently, so reconcile to the shorter match (truncate keeps
+        the plan's ``pos0`` honest on both stages). Returns adopted
+        tokens (0 = off/miss)."""
+        if not self.prefix_share or len(tokens) < 2:
+            return 0
+        c0 = self._state[self.ranks[slot]]["kv"]
+        c1 = self._state[self.ranks[slot + self.ep]]["kv"]
+        h0 = c0.prefix_acquire(rid, tokens)
+        h1 = c1.prefix_acquire(rid, tokens)
+        h = min(h0, h1)
+        if h0 > h:
+            c0.truncate(rid, h)
+        if h1 > h:
+            c1.truncate(rid, h)
+        return h
+
     def kv_stats(self) -> dict:
         caches = [st["kv"].stats() for st in self._state.values()]
         with self._resv_lock:
@@ -231,14 +308,21 @@ class InferEngine:
                 "in_use_max": max(c["in_use"] for c in caches),
                 "peak_in_use_max": max(c["peak_in_use"] for c in caches),
                 "alloc_failures": sum(c["alloc_failures"] for c in caches),
-                "reserved_max": reserved}
+                "reserved_max": reserved,
+                "shared_blocks_max": max(c["shared_blocks"] for c in caches),
+                "prefix_entries_max": max(c["prefix_entries"]
+                                          for c in caches),
+                "prefix_evictions": sum(c["prefix_evictions"]
+                                        for c in caches),
+                "cow_forks": sum(c["cow_forks"] for c in caches)}
 
     # -- step execution ------------------------------------------------------
-    def run_step(self, plan: StepPlan) -> Dict[int, int]:
-        """Execute one plan on every pool rank; returns {rid: next token}.
-        The per-rank closures enqueue under the pool's dispatch lock so
-        engine steps interleave atomically with tenant collective ops."""
-        results: Dict[int, int] = {}
+    def run_step(self, plan: StepPlan) -> Dict[int, List[int]]:
+        """Execute one plan on every pool rank; returns {rid: accepted
+        tokens} (one per prefill, up to spec_k per decode). The per-rank
+        closures enqueue under the pool's dispatch lock so engine steps
+        interleave atomically with tenant collective ops."""
+        results: Dict[int, List[int]] = {}
         errs: list = []
         done = threading.Event()
         remaining = [len(self.ranks)]
@@ -274,8 +358,12 @@ class InferEngine:
                            code=_ec.ERR_OTHER)
         return results
 
-    def _rank_step(self, rank: int, plan: StepPlan) -> Dict[int, int]:
+    def _rank_step(self, rank: int, plan: StepPlan) -> Dict[int, List[int]]:
         st = self._state[rank]
+        # speculative rollback: the plan's pos is the authoritative chain
+        # length — drop any rows a previous verify pass rejected
+        for dc in plan.decodes:
+            st["kv"].truncate(dc.rid, dc.pos)
         out = (self._stage0_step(st, plan) if st["stage"] == 0
                else self._stage1_step(st, plan))
         for rid in plan.releases:
@@ -309,8 +397,15 @@ class InferEngine:
                   capacity: int) -> np.ndarray:
         """The MoE FFN half-layer over this rank's ``(k, d)`` rows: gate,
         dispatch to expert ranks, combine, residual. Called by EVERY rank
-        of the stage each round (k may be 0) — rank-uniform collectives."""
+        of the stage each round (k may be 0) — rank-uniform collectives.
+        One call = one batched dispatch + one combine (plus the count
+        exchange); ``moe_rounds`` is what rounds/token is measured from."""
         from ..parallel.ep import moe_host_dispatch_combine
+        if st["slot"] == 0:
+            with self._rounds_lock:
+                self.moe_rounds += 1
+            if perfvars.enabled():
+                perfvars.note_infer(moe_rounds=1)
         sp = st["sp"]
         d = self.cfg.d_model
         k = xs.shape[0]
@@ -339,8 +434,10 @@ class InferEngine:
         logits = _rms_row(x, sp["ln_f"]) @ sp["embed"].T
         return int(np.argmax(logits))
 
-    # -- stage bodies --------------------------------------------------------
-    def _stage0_step(self, st: dict, plan: StepPlan) -> Dict[int, int]:
+    # -- prefill bodies ------------------------------------------------------
+    def _prefill_rows0(self, st: dict, plan: StepPlan) -> int:
+        """Row-loop baseline (``infer_vectorized`` off): each prefill's
+        partitions make their own MoE rounds, one request at a time."""
         cfg, B, slot = self.cfg, self.block_tokens, st["slot"]
         sp, L0 = st["sp"], self.layers_local
         serial_ns = 0
@@ -362,33 +459,79 @@ class InferEngine:
                     xs = np.zeros((0, cfg.d_model), np.float32)
                 for li in range(L0):
                     for j in range(xs.shape[0]):
-                        xs[j] = self._attn_row(st, pf.rid, li, xs[j], lo + j)
+                        xs[j] = self._attn_row(st, pf.rid, li, xs[j],
+                                               pf.pos0 + lo + j)
                     xs = self._moe_rows(st, self.ep_comms[0], li, xs, B)
                 if mine:
                     writer.publish(p, xs)
                     serial_ns += time.perf_counter_ns() - t0
             if writer is not None:
                 writer.finish()
-        mine_dec = [dc for dc in plan.decodes if dc.slot == slot]
-        xs = (np.stack([sp["embed"][dc.token].copy() for dc in mine_dec])
-              if mine_dec else np.zeros((0, cfg.d_model), np.float32))
-        for li in range(L0):
-            for j, dc in enumerate(mine_dec):
-                xs[j] = self._attn_row(st, dc.rid, li, xs[j], dc.pos)
-            xs = self._moe_rows(st, self.ep_comms[0], li, xs, self.max_batch)
-        if mine_dec:
-            from .. import pointtopoint as p2p
-            p2p.Send(np.ascontiguousarray(xs, dtype=np.float32),
-                     self.ep + slot, DECODE_TAG_BASE + plan.seq % 4096,
-                     self.wcomm)
-        if serial_ns and perfvars.enabled():
-            perfvars.note_infer(stage_serial_ns=serial_ns)
-        return {}
+                if pf.last and pf.register is not None:
+                    st["kv"].register_prefix(pf.rid, pf.register)
+        return serial_ns
 
-    def _stage1_step(self, st: dict, plan: StepPlan) -> Dict[int, int]:
+    def _prefill_vec0(self, st: dict, plan: StepPlan) -> int:
+        """Vectorized: every prefill advances partition p together — one
+        batched dispatch + combine per (round, layer) for the whole
+        co-batch, per-peer counts from all requests' rows at once. Each
+        request's rows still stream out the moment its own partition is
+        computed, so the cross-stage overlap is untouched."""
+        cfg, B, slot = self.cfg, self.block_tokens, st["slot"]
+        sp, L0 = st["sp"], self.layers_local
+        serial_ns = 0
+        live = []
+        for pf in plan.prefills:
+            nparts = math.ceil(len(pf.tokens) / B)
+            mine = pf.slot == slot
+            writer = (PartitionStreamWriter(nparts, B, cfg.d_model,
+                                            self.ep + slot, pf.tag,
+                                            self.wcomm)
+                      if mine else None)
+            live.append((pf, nparts, mine, writer))
+        for p in range(max((e[1] for e in live), default=0)):
+            active = [e for e in live if p < e[1]]
+            segs, cap = [], 0
+            for pf, _, mine, _ in active:
+                lo, hi = p * B, min((p + 1) * B, len(pf.tokens))
+                cap += hi - lo
+                xs = (np.stack([sp["embed"][t].copy()
+                                for t in pf.tokens[lo:hi]]) if mine
+                      else np.zeros((0, cfg.d_model), np.float32))
+                segs.append([pf, lo, xs])
+            t0 = time.perf_counter_ns()
+            for li in range(L0):
+                for seg in segs:
+                    pf, lo, xs = seg
+                    for j in range(xs.shape[0]):
+                        xs[j] = self._attn_row(st, pf.rid, li, xs[j],
+                                               pf.pos0 + lo + j)
+                cat = (np.concatenate([s[2] for s in segs]) if segs
+                       else np.zeros((0, cfg.d_model), np.float32))
+                cat = self._moe_rows(st, self.ep_comms[0], li, cat, cap)
+                o = 0
+                for seg in segs:
+                    n = seg[2].shape[0]
+                    seg[2] = cat[o:o + n]
+                    o += n
+            published = False
+            for (pf, _, mine, writer), seg in zip(active, segs):
+                if mine:
+                    writer.publish(p, seg[2])
+                    published = True
+            if published:
+                serial_ns += time.perf_counter_ns() - t0
+        for pf, _, mine, writer in live:
+            if writer is not None:
+                writer.finish()
+                if pf.last and pf.register is not None:
+                    st["kv"].register_prefix(pf.rid, pf.register)
+        return serial_ns
+
+    def _prefill_rows1(self, st: dict, plan: StepPlan,
+                       results: Dict[int, List[int]]) -> int:
         cfg, B, slot = self.cfg, self.block_tokens, st["slot"]
         L1 = self.layers_local
-        results: Dict[int, int] = {}
         pwait_ns = 0
         for pf in plan.prefills:
             tlen = len(pf.tokens)
@@ -407,27 +550,132 @@ class InferEngine:
                     xs = np.zeros((0, cfg.d_model), np.float32)
                 for li in range(L1):
                     for j in range(xs.shape[0]):
-                        xs[j] = self._attn_row(st, pf.rid, li, xs[j], lo + j)
+                        xs[j] = self._attn_row(st, pf.rid, li, xs[j],
+                                               pf.pos0 + lo + j)
                     xs = self._moe_rows(st, self.ep_comms[1], li, xs, B)
                 if mine and hi == tlen:
                     last = xs[-1]
             if reader is not None:
                 reader.finish()
                 pwait_ns += reader.wait_ns
-                results[pf.rid] = self._sample(st, last)
+                if pf.last and pf.register is not None:
+                    st["kv"].register_prefix(pf.rid, pf.register)
+                if pf.last:
+                    results[pf.rid] = [self._sample(st, last)]
+        return pwait_ns
+
+    def _prefill_vec1(self, st: dict, plan: StepPlan,
+                      results: Dict[int, List[int]]) -> int:
+        cfg, B, slot = self.cfg, self.block_tokens, st["slot"]
+        L1 = self.layers_local
+        pwait_ns = 0
+        live, lasts = [], {}
+        for pf in plan.prefills:
+            nparts = math.ceil(len(pf.tokens) / B)
+            mine = pf.slot == slot
+            reader = (PartitionStreamReader(nparts, B, cfg.d_model, slot,
+                                            pf.tag, self.wcomm)
+                      if mine else None)
+            live.append((pf, nparts, mine, reader))
+        for p in range(max((e[1] for e in live), default=0)):
+            active = [e for e in live if p < e[1]]
+            segs, cap = [], 0
+            for pf, _, mine, reader in active:
+                lo, hi = p * B, min((p + 1) * B, len(pf.tokens))
+                cap += hi - lo
+                xs = (np.ascontiguousarray(
+                    reader.take(p)[:hi - lo]).astype(np.float32) if mine
+                    else np.zeros((0, cfg.d_model), np.float32))
+                segs.append([pf, lo, hi, xs])
+            for li in range(L1):
+                for seg in segs:
+                    pf, lo, _, xs = seg
+                    for j in range(xs.shape[0]):
+                        xs[j] = self._attn_row(st, pf.rid, li, xs[j],
+                                               pf.pos0 + lo + j)
+                cat = (np.concatenate([s[3] for s in segs]) if segs
+                       else np.zeros((0, cfg.d_model), np.float32))
+                cat = self._moe_rows(st, self.ep_comms[1], li, cat, cap)
+                o = 0
+                for seg in segs:
+                    n = seg[3].shape[0]
+                    seg[3] = cat[o:o + n]
+                    o += n
+            for (pf, _, mine, _), seg in zip(active, segs):
+                if mine and seg[2] == len(pf.tokens):
+                    lasts[pf.rid] = np.array(seg[3][-1])
+        for pf, _, mine, reader in live:
+            if reader is not None:
+                reader.finish()
+                pwait_ns += reader.wait_ns
+                if pf.last and pf.register is not None:
+                    st["kv"].register_prefix(pf.rid, pf.register)
+                if pf.last:
+                    results[pf.rid] = [self._sample(st, lasts[pf.rid])]
+        return pwait_ns
+
+    # -- stage bodies --------------------------------------------------------
+    def _decode_cap(self, plan: StepPlan) -> int:
+        """Per-expert routing capacity for the decode dispatch — plan-wide
+        row total, so no sender can ever overflow it (rank-uniform)."""
+        return max(self.max_batch,
+                   sum(len(dc.tokens) for dc in plan.decodes))
+
+    def _stage0_step(self, st: dict, plan: StepPlan) -> Dict[int, List[int]]:
+        cfg, slot = self.cfg, st["slot"]
+        sp, L0 = st["sp"], self.layers_local
+        serial_ns = (self._prefill_vec0(st, plan) if self.vectorized
+                     else self._prefill_rows0(st, plan))
         mine_dec = [dc for dc in plan.decodes if dc.slot == slot]
-        if mine_dec:
+        rows = [(dc, j) for dc in mine_dec for j in range(len(dc.tokens))]
+        xs = (np.stack([sp["embed"][t].copy()
+                        for dc in mine_dec for t in dc.tokens])
+              if rows else np.zeros((0, cfg.d_model), np.float32))
+        cap = self._decode_cap(plan)
+        for li in range(L0):
+            for i, (dc, j) in enumerate(rows):
+                xs[i] = self._attn_row(st, dc.rid, li, xs[i], dc.pos + j)
+            xs = self._moe_rows(st, self.ep_comms[0], li, xs, cap)
+        if rows:
             from .. import pointtopoint as p2p
-            xs = np.zeros((len(mine_dec), cfg.d_model), np.float32)
+            p2p.Send(np.ascontiguousarray(xs, dtype=np.float32),
+                     self.ep + slot, DECODE_TAG_BASE + plan.seq % 4096,
+                     self.wcomm)
+        if serial_ns and perfvars.enabled():
+            perfvars.note_infer(stage_serial_ns=serial_ns)
+        return {}
+
+    def _stage1_step(self, st: dict, plan: StepPlan) -> Dict[int, List[int]]:
+        cfg, slot = self.cfg, st["slot"]
+        L1 = self.layers_local
+        results: Dict[int, List[int]] = {}
+        pwait_ns = (self._prefill_vec1(st, plan, results) if self.vectorized
+                    else self._prefill_rows1(st, plan, results))
+        mine_dec = [dc for dc in plan.decodes if dc.slot == slot]
+        rows = [(dc, j) for dc in mine_dec for j in range(len(dc.tokens))]
+        if rows:
+            from .. import pointtopoint as p2p
+            xs = np.zeros((len(rows), cfg.d_model), np.float32)
             p2p.Recv(xs, slot, DECODE_TAG_BASE + plan.seq % 4096, self.wcomm)
         else:
             xs = np.zeros((0, cfg.d_model), np.float32)
+        cap = self._decode_cap(plan)
         for li in range(L1):
-            for j, dc in enumerate(mine_dec):
-                xs[j] = self._attn_row(st, dc.rid, li, xs[j], dc.pos)
-            xs = self._moe_rows(st, self.ep_comms[1], li, xs, self.max_batch)
-        for j, dc in enumerate(mine_dec):
-            results[dc.rid] = self._sample(st, xs[j])
+            for i, (dc, j) in enumerate(rows):
+                xs[i] = self._attn_row(st, dc.rid, li, xs[i], dc.pos + j)
+            xs = self._moe_rows(st, self.ep_comms[1], li, xs, cap)
+        # speculative acceptance: row i's greedy output is valid iff every
+        # drafted token before it matched the greedy output one row
+        # earlier — so each accepted token is bitwise the k=1 token
+        o = 0
+        for dc in mine_dec:
+            kk = len(dc.tokens)
+            outs = [self._sample(st, xs[o + i]) for i in range(kk)]
+            m = 1
+            while m < kk and dc.tokens[m] == outs[m - 1]:
+                m += 1
+            results[dc.rid] = outs[:m]
+            o += kk
         if pwait_ns and perfvars.enabled():
             perfvars.note_infer(pwait_ns=pwait_ns)
         return results

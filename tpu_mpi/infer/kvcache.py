@@ -8,7 +8,17 @@ Two concerns live here, both per-rank state of the inference engine:
   counting problem: the scheduler admits a request iff the blocks its
   whole generation can touch are still free, so a full cache turns into
   queueing delay (and eventually a typed SLO eviction) instead of a
-  mid-generation failure.
+  mid-generation failure. Blocks are refcounted: with
+  ``TPU_MPI_KV_PREFIX_SHARE`` on, a completed prefill publishes its
+  prompt-prefix blocks into a content-hash registry
+  (:meth:`~PagedKVCache.register_prefix`) and later sessions presenting
+  the same prompt prefix adopt them read-only
+  (:meth:`~PagedKVCache.prefix_acquire`) — the first append into a block
+  someone else can still see forks a private copy (copy-on-write), so a
+  sharer can never observe another tenant's writes. Isolation is a
+  property of the admission layer: a session only ever matches prefixes
+  of tokens it presented itself, and the KV rows behind a match are a
+  pure function of those tokens and the model.
 - :class:`PartitionStreamWriter` / :class:`PartitionStreamReader` — the
   prefill activation stream between pipeline stages, built on the MPI-4
   partitioned ops (``Psend_init``/``Pready`` producing,
@@ -21,14 +31,25 @@ Two concerns live here, both per-rank state of the inference engine:
 
 from __future__ import annotations
 
+import hashlib
+import math
 import threading
 import time
-from typing import Dict, List, Tuple
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..error import MPIError
 from .. import error as _ec
+
+
+def _prefix_key(tokens: Sequence[int]) -> bytes:
+    """Content hash of a token prefix (the registry key). Stored entries
+    also keep the token tuple itself and compare it on lookup, so a hash
+    collision can never splice one tenant's KV into another's prompt."""
+    return hashlib.blake2b(np.asarray(tokens, np.int64).tobytes(),
+                           digest_size=16).digest()
 
 
 class PagedKVCache:
@@ -50,33 +71,84 @@ class PagedKVCache:
         # pop() from the tail: allocation order is a pure function of the
         # alloc/release history, never of timing
         self._free: List[int] = list(range(self.n_blocks - 1, -1, -1))
+        self._refs: Dict[int, int] = {}
         self._chains: Dict[Tuple[int, int], List[int]] = {}
         self._len: Dict[Tuple[int, int], int] = {}
+        # content-hash prefix registry (LRU): key -> {"tokens": tuple,
+        # "blocks": {layer: [ids]}, "partials": [{"tokens","blocks"}]}.
+        # The registry holds one reference per block it can hand out.
+        self._registry: "OrderedDict[bytes, dict]" = OrderedDict()
         self._lock = threading.Lock()
         self.peak_in_use = 0
         self.alloc_failures = 0
+        self.cow_forks = 0
+        self.prefix_evictions = 0
 
+    # -- block accounting (lock held) ----------------------------------------
+    def _alloc_locked(self) -> int:
+        if not self._free:
+            self._evict_registry_locked()
+        if not self._free:
+            self.alloc_failures += 1
+            raise MPIError(
+                f"KV cache exhausted: {self.n_blocks} blocks all in "
+                f"use (raise TPU_MPI_KV_BLOCK_TOKENS pool sizing or "
+                f"lower TPU_MPI_INFER_MAX_BATCH)",
+                code=_ec.ERR_BUFFER)
+        b = self._free.pop()
+        self._refs[b] = 1
+        in_use = self.n_blocks - len(self._free)
+        if in_use > self.peak_in_use:
+            self.peak_in_use = in_use
+        return b
+
+    def _deref_locked(self, b: int) -> None:
+        r = self._refs.get(b, 1) - 1
+        if r <= 0:
+            self._refs.pop(b, None)
+            self._free.append(b)
+        else:
+            self._refs[b] = r
+
+    def _evict_registry_locked(self) -> None:
+        """Drop LRU registry entries until a block actually frees (or the
+        registry is empty): the prefix cache yields under pool pressure,
+        never the other way around."""
+        while self._registry and not self._free:
+            _, e = self._registry.popitem(last=False)
+            self.prefix_evictions += 1
+            for blocks in e["blocks"].values():
+                for b in blocks:
+                    self._deref_locked(b)
+            for ch in e["partials"]:
+                for b in ch["blocks"].values():
+                    self._deref_locked(b)
+
+    # -- chains ---------------------------------------------------------------
     def append(self, sid: int, layer: int, k_row: np.ndarray,
                v_row: np.ndarray) -> None:
         """Append one token's ``(h, dh)`` K/V rows to a chain, growing it
-        by a fresh block on a block boundary."""
+        by a fresh block on a block boundary. Appending into a block that
+        anyone else can still see (another chain or the prefix registry)
+        forks a private copy first — copy-on-write."""
         key = (sid, layer)
+        B = self.block_tokens
         with self._lock:
             n = self._len.get(key, 0)
             chain = self._chains.setdefault(key, [])
-            if n % self.block_tokens == 0:
-                if not self._free:
-                    self.alloc_failures += 1
-                    raise MPIError(
-                        f"KV cache exhausted: {self.n_blocks} blocks all in "
-                        f"use (raise TPU_MPI_KV_BLOCK_TOKENS pool sizing or "
-                        f"lower TPU_MPI_INFER_MAX_BATCH)",
-                        code=_ec.ERR_BUFFER)
-                chain.append(self._free.pop())
-                in_use = self.n_blocks - len(self._free)
-                if in_use > self.peak_in_use:
-                    self.peak_in_use = in_use
-            b, off = chain[n // self.block_tokens], n % self.block_tokens
+            if n % B == 0 and n // B == len(chain):
+                chain.append(self._alloc_locked())
+            bi = n // B
+            b = chain[bi]
+            if self._refs.get(b, 1) > 1:
+                nb = self._alloc_locked()
+                self.k[nb] = self.k[b]
+                self.v[nb] = self.v[b]
+                self._deref_locked(b)
+                chain[bi] = nb
+                self.cow_forks += 1
+                b = nb
+            off = n % B
             self.k[b, off] = k_row
             self.v[b, off] = v_row
             self._len[key] = n + 1
@@ -104,17 +176,151 @@ class PagedKVCache:
         with self._lock:
             return self._len.get((sid, layer), 0)
 
+    def truncate(self, sid: int, new_len: int) -> None:
+        """Roll every chain of one session back to at most ``new_len``
+        tokens (the speculative-decode rejection rollback). Whole blocks
+        past the boundary are dereferenced; a surviving tail block that is
+        still shared simply stays read-only until the next append forks
+        it."""
+        B = self.block_tokens
+        with self._lock:
+            for key in [k for k in self._chains if k[0] == sid]:
+                n = self._len.get(key, 0)
+                if n <= new_len:
+                    continue
+                chain = self._chains[key]
+                keep = math.ceil(new_len / B)
+                for b in reversed(chain[keep:]):
+                    self._deref_locked(b)
+                del chain[keep:]
+                self._len[key] = new_len
+
     def close(self, sid: int) -> int:
-        """Release every chain of one session; returns blocks freed."""
+        """Release every chain of one session; returns blocks dropped
+        from its chains (shared blocks survive under their remaining
+        references)."""
         freed = 0
         with self._lock:
             for key in [k for k in self._chains if k[0] == sid]:
                 chain = self._chains.pop(key)
                 self._len.pop(key, None)
-                self._free.extend(reversed(chain))
+                for b in reversed(chain):
+                    self._deref_locked(b)
                 freed += len(chain)
         return freed
 
+    # -- cross-tenant prefix sharing ------------------------------------------
+    def register_prefix(self, sid: int, tokens: Sequence[int]) -> None:
+        """Publish session ``sid``'s prompt-prefix KV into the registry:
+        one entry per full-block boundary (so a later prompt that
+        diverges anywhere can still match its longest agreeing boundary),
+        each holding its prefix blocks by reference plus a *continuation
+        child* — the next block's tokens — for mid-block matches. Full
+        blocks are referenced as-is (prefill never writes into a
+        completed full block again, so they are immutable); the trailing
+        partial block is COPIED so the owner keeps appending into its own
+        tail without a fork."""
+        toks = tuple(int(t) for t in tokens)
+        B = self.block_tokens
+        nfull = len(toks) // B
+        if nfull == 0:
+            return
+        with self._lock:
+            layers = sorted(k[1] for k in self._chains if k[0] == sid)
+            if not layers or any(len(self._chains[(sid, li)]) * B
+                                 < len(toks) for li in layers):
+                return
+            for j in range(1, nfull + 1):
+                key = _prefix_key(toks[:j * B])
+                e = self._registry.get(key)
+                if e is None or e["tokens"] != toks[:j * B]:
+                    blocks = {li: list(self._chains[(sid, li)][:j])
+                              for li in layers}
+                    for bl in blocks.values():
+                        for b in bl:
+                            self._refs[b] = self._refs.get(b, 1) + 1
+                    e = {"tokens": toks[:j * B], "blocks": blocks,
+                         "partials": []}
+                    self._registry[key] = e
+                self._registry.move_to_end(key)
+                cont = toks[j * B:min((j + 1) * B, len(toks))]
+                if not cont or any(ch["tokens"][:len(cont)] == cont
+                                   for ch in e["partials"]
+                                   if len(ch["tokens"]) >= len(cont)):
+                    continue
+                if j < nfull:
+                    # continuation is a completed (immutable) full block:
+                    # share it by reference
+                    pblocks = {}
+                    for li in layers:
+                        b = self._chains[(sid, li)][j]
+                        self._refs[b] = self._refs.get(b, 1) + 1
+                        pblocks[li] = b
+                else:
+                    # trailing partial: the owner still appends into it —
+                    # copy, so neither side ever needs a fork for it
+                    pblocks = {}
+                    try:
+                        for li in layers:
+                            src = self._chains[(sid, li)][j]
+                            nb = self._alloc_locked()
+                            self.k[nb] = self.k[src]
+                            self.v[nb] = self.v[src]
+                            pblocks[li] = nb
+                    except MPIError:
+                        self.alloc_failures -= 1  # pressure: skip, not fail
+                        for b in pblocks.values():
+                            self._deref_locked(b)
+                        continue
+                e["partials"].append({"tokens": cont, "blocks": pblocks})
+
+    def prefix_acquire(self, sid: int, tokens: Sequence[int]) -> int:
+        """Adopt the longest registered shared prefix of ``tokens`` as the
+        initial chains for session ``sid``, capped at ``len(tokens) - 1``
+        (the final prompt token is always recomputed so prefill still
+        produces the first sampled hidden state). Returns the adopted
+        token count (0 = miss). Adopted blocks are read-only references;
+        the first divergent append copy-on-writes."""
+        toks = tuple(int(t) for t in tokens)
+        cap = len(toks) - 1
+        B = self.block_tokens
+        with self._lock:
+            for j in range(len(toks) // B, 0, -1):
+                key = _prefix_key(toks[:j * B])
+                e = self._registry.get(key)
+                if e is None or e["tokens"] != toks[:j * B]:
+                    continue
+                base = min(j * B, cap)
+                best, best_len = None, 0
+                if base == j * B:
+                    for ch in e["partials"]:
+                        L = 0
+                        for a, b in zip(ch["tokens"], toks[j * B:]):
+                            if a != b:
+                                break
+                            L += 1
+                        L = min(L, cap - j * B)
+                        if L > best_len:
+                            best, best_len = ch, L
+                adopted = base + best_len
+                if adopted <= 0:
+                    continue
+                nb_full = min(j, math.ceil(adopted / B))
+                for li, blocks in e["blocks"].items():
+                    chain = list(blocks[:nb_full])
+                    for b in chain:
+                        self._refs[b] = self._refs.get(b, 1) + 1
+                    if best is not None and best_len:
+                        pb = best["blocks"][li]
+                        self._refs[pb] = self._refs.get(pb, 1) + 1
+                        chain.append(pb)
+                    self._chains[(sid, li)] = chain
+                    self._len[(sid, li)] = adopted
+                self._registry.move_to_end(key)
+                return adopted
+        return 0
+
+    # -- reporting ------------------------------------------------------------
     def free_blocks(self) -> int:
         with self._lock:
             return len(self._free)
@@ -122,11 +328,16 @@ class PagedKVCache:
     def stats(self) -> dict:
         with self._lock:
             in_use = self.n_blocks - len(self._free)
+            shared = sum(1 for r in self._refs.values() if r > 1)
             return {"blocks": self.n_blocks,
                     "block_tokens": self.block_tokens,
                     "in_use": in_use, "peak_in_use": self.peak_in_use,
                     "chains": len(self._chains),
-                    "alloc_failures": self.alloc_failures}
+                    "alloc_failures": self.alloc_failures,
+                    "shared_blocks": shared,
+                    "prefix_entries": len(self._registry),
+                    "prefix_evictions": self.prefix_evictions,
+                    "cow_forks": self.cow_forks}
 
 
 class PartitionStreamWriter:

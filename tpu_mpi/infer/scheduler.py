@@ -6,18 +6,27 @@ One daemon thread turns the request stream into :class:`StepPlan`s:
   already ordered them across tenants); the head is admitted when a batch
   slot AND its whole KV-block demand on its home pair are free — paged-KV
   backpressure becomes queueing delay, never a mid-generation failure.
+  With ``TPU_MPI_KV_PREFIX_SHARE`` on, admission is also where a request
+  adopts registered shared-prefix KV blocks (read-only, copy-on-write):
+  the isolation boundary is that a session can only ever match prefixes
+  of tokens it presented itself.
 - **SLO eviction**: with ``TPU_MPI_INFER_SLO_MS`` set, a request still
   *pending* past its deadline is evicted with the typed, retriable
   :class:`~tpu_mpi.error.SLOExpiredError`; a request that completes is
   booked as an SLO hit or miss against the same deadline.
-- **Continuous batching**: every step co-schedules the newly admitted
-  prefills with every in-flight decode — one engine step, one new token
-  per active request. Finished/cancelled sessions ride out in the plan's
-  release list so every rank frees their KV chains in lockstep.
+- **Continuous batching**: every step co-schedules prefill chunks with
+  every in-flight decode. ``TPU_MPI_INFER_PREFILL_CHUNK`` bounds the
+  prefill tokens per step, splitting giant prompts across consecutive
+  plans so they cannot head-of-line-block co-batched decodes.
+- **Speculative drafting**: with ``TPU_MPI_INFER_SPEC_K`` > 1, each
+  decode feeds up to k rows — the last accepted token plus drafts walked
+  from the request's own bigram history (last-occurrence-wins, a pure
+  function of its own stream). The engine accepts the greedy-matching
+  prefix, so several tokens can ride one round of collectives.
 
 Token values never depend on what else is in a batch (the engine's
 row-wise contract), so greedy sequences are bitwise identical whether
-requests arrive together or staggered.
+requests arrive together or staggered, speculated or not.
 """
 
 from __future__ import annotations
@@ -45,7 +54,8 @@ class InferRequest:
 
     __slots__ = ("rid", "tenant", "prompt", "max_new", "slot", "kv_need",
                  "tag", "slo_ms", "deadline", "submitted", "pos",
-                 "generated", "out", "state")
+                 "generated", "out", "state", "pf_done", "pf_chunk",
+                 "draft", "spec_fed")
 
     def __init__(self, rid: int, tenant: str, prompt: List[int],
                  max_new: int, slot: int, kv_need: int, slo_ms: int):
@@ -61,7 +71,14 @@ class InferRequest:
         self.deadline = (self.submitted + self.slo_ms / 1e3
                          if self.slo_ms > 0 else None)
         self.pos = 0                      # next feed position (set at prefill)
+        self.pf_done = 0                  # prompt tokens already in KV
+        self.pf_chunk = 0                 # tokens in the in-flight chunk
         self.generated: List[int] = []
+        # bigram draft table over this request's own stream
+        # (last-occurrence-wins); seeded from the prompt
+        self.draft: Dict[int, int] = {a: b for a, b
+                                      in zip(self.prompt, self.prompt[1:])}
+        self.spec_fed = 1
         self.out: "queue.Queue" = queue.Queue()
         self.state = "pending"
 
@@ -89,6 +106,7 @@ class InferScheduler:
         self.slo_ms = int(knobs.infer_slo_ms if slo_ms is None else slo_ms)
         self._lock = threading.Lock()
         self._pending: Deque[InferRequest] = deque()
+        self._prefilling: List[InferRequest] = []
         self._active: List[InferRequest] = []
         self._releases: List[InferRequest] = []
         self._rid = itertools.count(1)
@@ -103,7 +121,9 @@ class InferScheduler:
         self.counters = {"admitted": 0, "completed": 0, "cancelled": 0,
                          "slo_evictions": 0, "slo_hits": 0, "slo_misses": 0,
                          "steps": 0, "step_ns": 0, "tokens": 0,
-                         "batch_slots": 0, "prefill_tokens": 0}
+                         "batch_slots": 0, "prefill_tokens": 0,
+                         "spec_drafted": 0, "spec_accepted": 0,
+                         "prefix_hit_tokens": 0, "prefix_miss_tokens": 0}
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
@@ -118,8 +138,10 @@ class InferScheduler:
             self._thread.join(timeout=10)
         exc = SessionError("inference engine shutting down")
         with self._lock:
-            doomed = list(self._pending) + list(self._active)
+            doomed = (list(self._pending) + list(self._prefilling)
+                      + list(self._active))
             self._pending.clear()
+            self._prefilling.clear()
             self._active.clear()
         for r in doomed:
             r.fail(exc)
@@ -145,14 +167,19 @@ class InferScheduler:
     def cancel_tenant(self, tenant: str) -> int:
         """Evict every request of a revoked tenant: pending ones fail
         immediately, in-flight ones leave the batch and their KV chains
-        are released on the next step. Survivor tenants never notice."""
+        are released on the next step. Survivor tenants never notice —
+        shared prefix blocks they adopted stay alive under their own
+        references."""
         exc = SessionError(f"lease for tenant {tenant!r} revoked "
                            f"mid-generation")
         with self._lock:
             dropped = [r for r in self._pending if r.tenant == tenant]
             self._pending = deque(r for r in self._pending
                                   if r.tenant != tenant)
-            victims = [r for r in self._active if r.tenant == tenant]
+            victims = [r for r in self._prefilling + self._active
+                       if r.tenant == tenant]
+            self._prefilling = [r for r in self._prefilling
+                                if r.tenant != tenant]
             self._active = [r for r in self._active if r.tenant != tenant]
             for r in victims:
                 r.state = "cancelled"
@@ -180,34 +207,88 @@ class InferScheduler:
                 still.append(r)
         self._pending = still
 
+    def _draft_feed(self, r: InferRequest) -> List[int]:
+        """The decode feed for one request: last accepted token plus up to
+        k-1 bigram drafts, never past its max_new budget."""
+        feed = [r.generated[-1]]
+        k = min(self.engine.spec_k, r.max_new - len(r.generated))
+        cur = feed[0]
+        while len(feed) < k:
+            nxt = r.draft.get(cur)
+            if nxt is None:
+                break
+            feed.append(nxt)
+            cur = nxt
+        r.spec_fed = len(feed)
+        return feed
+
     def _build_plan(self) -> Optional[tuple]:
         """Under the lock: evict, admit, snapshot one step. Returns
-        (plan, prefills, decodes) or None when there is nothing to do."""
+        (plan, prefills, decodes, releases) or None when idle."""
         self._evict_expired(monotonic())
+        budget = self.engine.prefill_chunk or None   # None = unbounded
         prefills: List[InferRequest] = []
+        # continuing chunked prefills first (FIFO by admission)
+        for r in self._prefilling:
+            if budget is not None and budget <= 0:
+                break
+            remaining = len(r.prompt) - r.pf_done
+            take = remaining if budget is None else min(remaining, budget)
+            if take <= 0:
+                continue
+            if budget is not None:
+                budget -= take
+            r.pf_chunk = take
+            r.tag = PREFILL_TAG_BASE + next(self._stream) % 4096
+            prefills.append(r)
+        # fresh admissions under slot + KV + prefill-budget pressure
         while (self._pending
-               and len(self._active) + len(prefills) < self.max_batch):
+               and (len(self._active) + len(self._prefilling)
+                    < self.max_batch)
+               and (budget is None or budget > 0)):
             head = self._pending[0]
             if not self.engine.can_admit(head.slot, head.kv_need):
                 break                     # KV backpressure: FIFO holds
             self._pending.popleft()
             self.engine.reserve(head.slot, head.kv_need)
+            hit = self.engine.kv_prefix_acquire(head.rid, head.slot,
+                                               head.prompt)
+            head.pf_done = hit
+            self.counters["prefix_hit_tokens"] += hit
+            self.counters["prefix_miss_tokens"] += len(head.prompt) - hit
+            if perfvars.enabled():
+                perfvars.note_infer(kv_prefix_hit_tokens=hit,
+                                    kv_prefix_miss_tokens=(len(head.prompt)
+                                                           - hit))
+            remaining = len(head.prompt) - hit
+            take = remaining if budget is None else min(remaining, budget)
+            if budget is not None:
+                budget -= take
+            head.pf_chunk = take
             head.tag = PREFILL_TAG_BASE + next(self._stream) % 4096
             head.state = "running"
             self.counters["admitted"] += 1
+            self._prefilling.append(head)
             prefills.append(head)
         decodes = list(self._active)
         releases = self._releases
         self._releases = []
         if not prefills and not decodes and not releases:
-            self._wake.clear()
+            if not self._prefilling:
+                self._wake.clear()
             return None
-        plan = StepPlan(next(self._seq),
-                        [Prefill(r.rid, r.slot, r.prompt, r.tag)
-                         for r in prefills],
-                        [Decode(r.rid, r.slot, r.generated[-1], r.pos)
-                         for r in decodes],
-                        [r.rid for r in releases])
+        share = self.engine.prefix_share
+        plan = StepPlan(
+            next(self._seq),
+            [Prefill(r.rid, r.slot,
+                     r.prompt[r.pf_done:r.pf_done + r.pf_chunk], r.tag,
+                     pos0=r.pf_done,
+                     last=(r.pf_done + r.pf_chunk == len(r.prompt)),
+                     register=(r.prompt if share else None))
+             for r in prefills],
+            [Decode(r.rid, r.slot, self._draft_feed(r), r.pos)
+             for r in decodes],
+            [r.rid for r in releases])
         return plan, prefills, decodes, releases
 
     def pause(self, timeout: float = 30.0) -> bool:
@@ -245,8 +326,11 @@ class InferScheduler:
             except BaseException as e:      # noqa: BLE001 - engine is down
                 self._dead = e
                 with self._lock:
-                    doomed = prefills + decodes + list(self._pending)
+                    doomed = (prefills + decodes + list(self._pending)
+                              + [r for r in self._prefilling
+                                 if r not in prefills])
                     self._pending.clear()
+                    self._prefilling.clear()
                     self._active.clear()
                 for r in doomed:
                     r.fail(e if isinstance(e, MPIError) else
@@ -260,25 +344,44 @@ class InferScheduler:
     def _book_step(self, plan, prefills, decodes, releases, results,
                    step_ns) -> None:
         emitted = 0
+        drafted = accepted = 0
         now = monotonic()
         with self._lock:
             for r in releases:
                 self.engine.unreserve(r.slot, r.kv_need)
             for r in prefills:
-                r.pos = len(r.prompt)     # first decode feeds at this pos
+                r.pf_done += r.pf_chunk
+                r.pf_chunk = 0
             for r in prefills + decodes:
                 if r.state != "running":
                     continue              # cancelled while the step ran
-                tok = results.get(r.rid)
-                if tok is None:
+                if r in prefills:
+                    if r.pf_done < len(r.prompt):
+                        continue          # chunked prefill still going
+                    r.pos = len(r.prompt)  # first decode feeds at this pos
+                toks = results.get(r.rid)
+                if not toks:
                     continue
                 if r in prefills:
+                    self._prefilling.remove(r)
                     self._active.append(r)
                 else:
-                    r.pos += 1
-                r.generated.append(tok)
-                emitted += 1
-                r.out.put(("tok", [tok]))
+                    toks = toks[:r.max_new - len(r.generated)]
+                    r.pos += len(toks)
+                    self.counters["spec_drafted"] += r.spec_fed - 1
+                    self.counters["spec_accepted"] += len(toks) - 1
+                    drafted += r.spec_fed - 1
+                    accepted += len(toks) - 1
+                # extend the bigram draft table along the accepted stream
+                prev = (r.generated[-1] if r.generated
+                        else (r.prompt[-1] if r.prompt else None))
+                for t in toks:
+                    if prev is not None:
+                        r.draft[prev] = t
+                    prev = t
+                r.generated.extend(toks)
+                emitted += len(toks)
+                r.out.put(("tok", list(toks)))
                 if len(r.generated) >= r.max_new:
                     self._active.remove(r)
                     self._releases.append(r)
@@ -296,29 +399,37 @@ class InferScheduler:
             self.counters["step_ns"] += step_ns
             self.counters["tokens"] += emitted
             self.counters["batch_slots"] += len(prefills) + len(decodes)
-            self.counters["prefill_tokens"] += sum(len(r.prompt)
-                                                   for r in prefills)
-            if self._pending or self._releases:
+            self.counters["prefill_tokens"] += sum(len(p.tokens)
+                                                   for p in plan.prefills)
+            if self._pending or self._releases or self._prefilling:
                 self._wake.set()
         if perfvars.enabled():
             perfvars.note_infer(steps=1, step_ns=step_ns, tokens=emitted,
                                 batch_slots=len(prefills) + len(decodes),
-                                prefills=len(prefills))
+                                prefills=len(prefills),
+                                spec_drafted=drafted, spec_accepted=accepted)
             kv = self.engine.kv_stats()
             perfvars.set_infer_gauges(
                 max_batch=self.max_batch,
+                spec_k=self.engine.spec_k,
                 kv_blocks_per_rank=kv["blocks_per_rank"],
                 kv_in_use_max=kv["in_use_max"],
                 kv_peak_in_use_max=kv["peak_in_use_max"],
-                kv_alloc_failures=kv["alloc_failures"])
+                kv_alloc_failures=kv["alloc_failures"],
+                kv_shared_blocks_max=kv["shared_blocks_max"],
+                kv_prefix_entries_max=kv["prefix_entries_max"],
+                kv_cow_forks=kv["cow_forks"])
 
     # -- reporting -----------------------------------------------------------
     def stats(self) -> dict:
         with self._lock:
             c = dict(self.counters)
-            pending, active = len(self._pending), len(self._active)
+            pending = len(self._pending)
+            active = len(self._active) + len(self._prefilling)
         finished = c["slo_hits"] + c["slo_misses"]
         decode_s = c["step_ns"] / 1e9
+        rounds = self.engine.moe_rounds
+        probed = c["prefix_hit_tokens"] + c["prefix_miss_tokens"]
         return {
             "max_batch": self.max_batch, "slo_ms": self.slo_ms,
             "pending": pending, "active": active,
@@ -330,5 +441,25 @@ class InferScheduler:
                                 if c["steps"] else None),
             "slo_hit_rate": (round(c["slo_hits"] / finished, 4)
                              if finished else None),
-            "kv": self.engine.kv_stats(),
+            "decode": {
+                "vectorized": self.engine.vectorized,
+                "spec_k": self.engine.spec_k,
+                "prefill_chunk": self.engine.prefill_chunk,
+                "moe_rounds": rounds,
+                "rounds_per_token": (round(rounds / c["tokens"], 4)
+                                     if c["tokens"] else None),
+                "drafted": c["spec_drafted"],
+                "accepted": c["spec_accepted"],
+                "accept_rate": (round(c["spec_accepted"]
+                                      / c["spec_drafted"], 4)
+                                if c["spec_drafted"] else None),
+            },
+            "kv": {
+                **self.engine.kv_stats(),
+                "prefix_share": self.engine.prefix_share,
+                "prefix_hit_tokens": c["prefix_hit_tokens"],
+                "prefix_miss_tokens": c["prefix_miss_tokens"],
+                "prefix_hit_rate": (round(c["prefix_hit_tokens"] / probed, 4)
+                                    if probed else None),
+            },
         }

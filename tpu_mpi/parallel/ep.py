@@ -22,12 +22,32 @@ Two realizations live here:
 
 from __future__ import annotations
 
+import threading
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+# Per-thread persistent count-exchange buffers, keyed by (cid, n). The
+# count Alltoall has a FIXED signature (n int64 per rank, same comm)
+# every decode step — reusing the same buffer objects is what lets the
+# auto-arm signature table (PR 11 plan cache) promote it to an armed
+# persistent collective instead of re-planning per step. Thread-local
+# because in the thread tier every rank drives its own copy of this
+# function concurrently over the shared comm object.
+_count_bufs = threading.local()
+
+
+def _count_exchange_bufs(cid: int, n: int):
+    cache = getattr(_count_bufs, "m", None)
+    if cache is None:
+        cache = _count_bufs.m = {}
+    key = (cid, n)
+    if key not in cache:
+        cache[key] = (np.zeros(n, np.int64), np.zeros(n, np.int64))
+    return cache[key]
 
 
 def moe_dispatch_combine(tokens: jnp.ndarray, expert_idx: jnp.ndarray,
@@ -78,7 +98,14 @@ def moe_host_dispatch_combine(tokens: np.ndarray, expert_idx: np.ndarray,
 
     Every call makes exactly two Alltoallv rendezvous (dispatch, combine)
     plus one int64 Alltoall for the return counts — three decision-point
-    visits per decode step for the online autotuner.
+    visits per layer round for the online autotuner. The engine's
+    vectorized decode path concatenates ALL co-batched requests' rows
+    into one call, so the per-peer counts come from the whole batch and
+    the round count per step is independent of batch width; batching is
+    pure data movement here (the expert below stays row-wise), which is
+    why a batched round is bitwise identical to the same rows sent one
+    request at a time. The count exchange reuses per-thread persistent
+    buffers so its fixed signature repeats verbatim and can auto-arm.
     """
     from .. import collective as _c
     tokens = np.ascontiguousarray(tokens)
@@ -97,9 +124,11 @@ def moe_host_dispatch_combine(tokens: np.ndarray, expert_idx: np.ndarray,
              np.zeros(0, np.int64)).astype(np.int64)
     send = tokens[order] if t else tokens.reshape(0, d)
 
-    rcounts = np.zeros(n, np.int64)
-    _c.Alltoall(np.asarray(scounts, np.int64), rcounts, 1, comm)
-    rcounts = [int(c) for c in rcounts]
+    sbuf, rbuf = _count_exchange_bufs(comm.cid, n)
+    sbuf[:] = scounts
+    rbuf[:] = 0
+    _c.Alltoall(sbuf, rbuf, 1, comm)
+    rcounts = [int(c) for c in rbuf]
     sc_el = [c * d for c in scounts]
     rc_el = [c * d for c in rcounts]
 

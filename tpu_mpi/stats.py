@@ -259,6 +259,30 @@ def render(agg: dict, out=None) -> None:
             w(f"  prefill stream: stage-1 waited {pw / 1e6:.2f}ms of the "
               f"{ser / 1e6:.2f}ms stage-0 produce time "
               f"({1 - pw / ser:.0%} overlapped)\n")
+        rounds = inf.get("moe_rounds", 0)
+        if rounds:
+            toks = inf.get("tokens", 0)
+            w(f"\ndecode: {rounds} collective layer rounds, "
+              f"{rounds / toks:.2f} rounds/token\n" if toks else
+              f"\ndecode: {rounds} collective layer rounds\n")
+            drafted = inf.get("spec_drafted", 0)
+            if drafted:
+                acc = inf.get("spec_accepted", 0)
+                w(f"  speculative: k={int(g.get('spec_k') or 1)}, "
+                  f"{acc}/{drafted} extra drafts accepted "
+                  f"({acc / drafted:.0%})\n")
+        probed = (inf.get("kv_prefix_hit_tokens", 0)
+                  + inf.get("kv_prefix_miss_tokens", 0))
+        if probed or g.get("kv_prefix_entries_max") or g.get("kv_cow_forks"):
+            w("\nkv cache:")
+            if probed:
+                hits = inf.get("kv_prefix_hit_tokens", 0)
+                w(f" prefix {hits}/{probed} prompt tokens adopted "
+                  f"({hits / probed:.0%} hit rate)")
+            w("\n")
+            w(f"  {g.get('kv_shared_blocks_max', 0)} shared blocks (peak), "
+              f"{g.get('kv_prefix_entries_max', 0)} registry entries, "
+              f"{g.get('kv_cow_forks', 0)} CoW forks\n")
 
     ela = agg.get("elastic") or {}
     if ela.get("resizes") or ela.get("failures"):
