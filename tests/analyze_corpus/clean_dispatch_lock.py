@@ -1,0 +1,29 @@
+# nprocs: 2
+#
+# Clean twin of defect_blocking_under_dispatch_lock: the blocking
+# ``queue.get()`` runs OUTSIDE the dispatch-lock critical section — the
+# lock only guards the (fast) bookkeeping after the op arrives. Zero
+# lock diagnostics.
+import queue
+import threading
+
+
+class MiniBroker:
+    def __init__(self):
+        self._dispatch_lock = threading.Lock()
+        self._inbox = queue.Queue()
+        self.dispatched = 0
+
+    def submit(self, op):
+        self._inbox.put(op)
+
+    def pump(self):
+        op = self._inbox.get()
+        with self._dispatch_lock:
+            self.dispatched += 1
+            return op
+
+
+b = MiniBroker()
+b.submit("op-1")
+assert b.pump() == "op-1"

@@ -1,0 +1,29 @@
+# nprocs: 2
+#
+# Clean twin of defect_unguarded_shared_field: every write to
+# ``self.total`` — on both thread roots — happens under the same lock,
+# so the guard intersection is non-empty and there is no race. Zero
+# lock diagnostics.
+import threading
+
+
+class Meter:
+    def __init__(self):
+        self.total = 0
+        self._lock = threading.Lock()
+        self._poller = threading.Thread(target=self._poll, daemon=True)
+        self._drainer = threading.Thread(target=self._drain, daemon=True)
+
+    def _poll(self):
+        with self._lock:
+            self.total = self.total + 1
+
+    def _drain(self):
+        with self._lock:
+            self.total = 0
+
+
+m = Meter()
+m._poll()
+m._drain()
+assert m.total == 0

@@ -1,0 +1,30 @@
+# nprocs: 2
+#
+# Clean twin of defect_lock_order_cycle: both paths acquire a BEFORE b,
+# so the acquisition-order graph is acyclic — two threads can run
+# refill() and flush() concurrently without deadlock. Zero lock
+# diagnostics.
+import threading
+
+
+class Spooler:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+        self.items = []
+
+    def refill(self):
+        with self.a:
+            with self.b:
+                self.items.append("x")
+
+    def flush(self):
+        with self.a:
+            with self.b:
+                self.items.clear()
+
+
+s = Spooler()
+s.refill()
+s.flush()
+assert s.items == []

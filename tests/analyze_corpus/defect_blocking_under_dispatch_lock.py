@@ -1,0 +1,29 @@
+# nprocs: 2
+#
+# Seeded concurrency defect: a blocking ``queue.get()`` runs while the
+# dispatch lock is held (L113). Every other thread that needs the
+# dispatch lock — including the producer that would feed the queue —
+# stalls behind a consumer that may wait forever: the classic
+# held-while-blocking convoy. Executed under the trace runner this file
+# is harmless: the queue is pre-loaded so the get returns immediately.
+import queue
+import threading
+
+
+class MiniBroker:
+    def __init__(self):
+        self._dispatch_lock = threading.Lock()
+        self._inbox = queue.Queue()
+
+    def submit(self, op):
+        self._inbox.put(op)
+
+    def pump(self):
+        with self._dispatch_lock:
+            op = self._inbox.get()  # locks: L113
+            return op
+
+
+b = MiniBroker()
+b.submit("op-1")
+assert b.pump() == "op-1"

@@ -1,0 +1,34 @@
+# nprocs: 2
+#
+# Seeded concurrency defect: two acquisition paths establish INVERTED
+# lock order — refill() nests a under b while flush() nests b under a.
+# Two threads running the two paths concurrently can deadlock; the
+# static concurrency lint proves it from the AST alone (L112, with both
+# acquisition chains), no execution needed. Executed under the trace
+# runner this file is harmless: the paths run sequentially on one
+# thread, so the inversion never bites — exactly the kind of latent bug
+# that survives every test run until the unlucky interleaving.
+import threading
+
+
+class Spooler:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+        self.items = []
+
+    def refill(self):
+        with self.a:
+            with self.b:
+                self.items.append("x")
+
+    def flush(self):
+        with self.b:
+            with self.a:  # locks: L112
+                self.items.clear()
+
+
+s = Spooler()
+s.refill()
+s.flush()
+assert s.items == []

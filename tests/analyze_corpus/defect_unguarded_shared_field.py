@@ -1,0 +1,31 @@
+# nprocs: 2
+#
+# Seeded concurrency defect: ``self.total`` is written from two thread
+# roots (the poller thread and the drainer thread mapped from their
+# ``Thread(target=...)`` constructions) with no common lock guarding the
+# writes — a lost-update race the moment both threads run (L114).
+# Executed under the trace runner this file is harmless: the threads are
+# constructed but never started, and the writes run sequentially.
+import threading
+
+
+class Meter:
+    def __init__(self):
+        self.total = 0
+        self._lock = threading.Lock()
+        self._poller = threading.Thread(target=self._poll, daemon=True)
+        self._drainer = threading.Thread(target=self._drain, daemon=True)
+
+    def _poll(self):
+        self.total = self.total + 1  # locks: L114
+
+    def _drain(self):
+        with self._lock:
+            pass                     # guards nothing: the write is outside
+        self.total = 0
+
+
+m = Meter()
+m._poll()
+m._drain()
+assert m.total == 0

@@ -1,0 +1,756 @@
+"""Concurrency analyzer: the static lock-graph lint
+(tpu_mpi.analyze.concurrency, L112-L115) and the runtime lock witness
+(tpu_mpi.locksmith: LockOrderError, C401, contention pvars, T215).
+
+The static half is checked three ways: synthetic sources per rule, the
+seeded corpus twins at their exact ``# locks:`` markers, and the
+zero-false-positive contract over the whole shipped tree. The runtime
+half arms TPU_MPI_LOCKCHECK=1 and proves the inverted-order reproducer
+raises a typed LockOrderError with both acquisition chains *without any
+thread ever deadlocking*."""
+
+import glob
+import os
+import re
+import threading
+import time
+
+import pytest
+
+from tpu_mpi import config, locksmith, perfvars
+from tpu_mpi.analyze import concurrency as conc
+from tpu_mpi.analyze.diagnostics import CODES
+from tpu_mpi.error import LockOrderError
+
+CORPUS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "analyze_corpus")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFECTS = sorted(glob.glob(os.path.join(CORPUS, "defect_*.py")))
+CLEAN = sorted(glob.glob(os.path.join(CORPUS, "clean_*.py")))
+
+
+def marked(path):
+    """Expected (code, line) pairs from ``# locks: Lxxx`` markers."""
+    out = []
+    with open(path) as f:
+        for lineno, text in enumerate(f, 1):
+            for m in re.finditer(r"locks:\s*([A-Z]\d+)", text):
+                out.append((m.group(1), lineno))
+    return sorted(out)
+
+
+# ---------------------------------------------------------------------------
+# Corpus twins: exact markers on the defects, zero on everything else
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("path", DEFECTS + CLEAN, ids=os.path.basename)
+def test_corpus_locks_markers_exact(path):
+    got = sorted((d.code, d.line) for d in conc.lock_lint_paths([path]))
+    assert got == marked(path)
+
+
+def test_lock_corpus_covers_three_defect_classes():
+    codes = {c for p in DEFECTS for c, _ in marked(p)}
+    assert {"L112", "L113", "L114"} <= codes
+
+
+def test_defect_diagnostics_carry_chains():
+    path = os.path.join(CORPUS, "defect_lock_order_cycle.py")
+    (d,) = conc.lock_lint_paths([path])
+    assert d.code == "L112" and d.code in CODES
+    assert d.mpi_code > 0
+    # both acquisition paths rendered as file:line related locations
+    assert len(d.related) >= 2
+    for f, ln, note in d.related:
+        assert os.path.abspath(f) == os.path.abspath(path) and ln > 0
+        assert "acquired while holding" in note
+    assert f":{d.line}:" in str(d)
+
+
+def test_whole_tree_is_clean():
+    # the zero-false-positive contract, extended to L112-L115: the whole
+    # shipped package (a real thread fabric) must produce no diagnostics
+    diags = conc.lock_lint_paths([os.path.join(REPO, "tpu_mpi")])
+    assert diags == [], "\n".join(map(str, diags))
+
+
+def test_examples_are_clean():
+    diags = conc.lock_lint_paths([os.path.join(REPO, "examples")])
+    assert diags == [], "\n".join(map(str, diags))
+
+
+def test_real_broker_edges_are_discovered():
+    # silence must come from precision, not blindness: the analyzer sees
+    # the real dispatch->queues / dispatch->links nestings in the broker
+    path = os.path.join(REPO, "tpu_mpi", "serve", "broker.py")
+    an, diags = conc._analyze_source(open(path).read(), path)
+    assert diags == []
+    pairs = {(a.split(".")[-1], b.split(".")[-1]) for a, b in an.edges}
+    assert ("_dispatch_lock", "_queues_lock") in pairs
+    assert ("_dispatch_lock", "_links_lock") in pairs
+
+
+# ---------------------------------------------------------------------------
+# Synthetic sources: one rule at a time
+# ---------------------------------------------------------------------------
+
+def _codes(src):
+    return sorted(d.code for d in conc.lock_lint_source(src, "t.py"))
+
+
+def test_l112_interprocedural_cycle():
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+    def inner_b(self):
+        with self.b:
+            pass
+
+    def fwd(self):
+        with self.a:
+            self.inner_b()     # a -> b via the call
+
+    def bwd(self):
+        with self.b:
+            with self.a:
+                pass
+"""
+    assert _codes(src) == ["L112"]
+
+
+def test_l112_consistent_order_is_silent():
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+    def one(self):
+        with self.a:
+            with self.b:
+                pass
+
+    def two(self):
+        with self.a:
+            with self.b:
+                pass
+"""
+    assert _codes(src) == []
+
+
+def test_l112_cross_file_cycle():
+    fwd = """
+import threading
+A = threading.Lock()
+B = threading.Lock()
+
+def fwd():
+    with A:
+        with B:
+            pass
+"""
+    bwd = """
+import threading
+A = threading.Lock()
+B = threading.Lock()
+
+def bwd():
+    with B:
+        with A:
+            pass
+"""
+    # per-file each half is acyclic; only the aggregate graph closes the
+    # loop (names are per-module so this needs the same module basename)
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        sub1 = os.path.join(d, "one")
+        sub2 = os.path.join(d, "two")
+        os.makedirs(sub1)
+        os.makedirs(sub2)
+        p1 = os.path.join(sub1, "mod.py")
+        p2 = os.path.join(sub2, "mod.py")
+        open(p1, "w").write(fwd)
+        open(p2, "w").write(bwd)
+        assert conc.lock_lint_paths([p1]) == []
+        assert conc.lock_lint_paths([p2]) == []
+        codes = [x.code for x in conc.lock_lint_paths([p1, p2])]
+        assert codes == ["L112"]
+
+
+def test_l113_blocking_variants():
+    base = """
+import queue
+import threading
+
+class B:
+    def __init__(self):
+        self._dispatch_lock = threading.Lock()
+        self._q = queue.Queue()
+        self._ev = threading.Event()
+
+    def bad(self):
+        with self._dispatch_lock:
+            %s
+"""
+    assert _codes(base % "self._q.get()") == ["L113"]
+    assert _codes(base % "self._ev.wait()") == ["L113"]
+    assert _codes(base % "x = MPI.Allreduce(1)") == ["L113"]
+    # non-blocking get and plain dict-style calls stay silent
+    assert _codes(base % "self._q.get(block=False)") == []
+    assert _codes(base % "self._q.put(1)") == []
+
+
+def test_l113_interprocedural_and_nondispatch_silent():
+    src = """
+import queue
+import threading
+
+class B:
+    def __init__(self):
+        self._dispatch_lock = threading.Lock()
+        self._misc_lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def drain(self):
+        return self._q.get()
+
+    def bad(self):
+        with self._dispatch_lock:
+            return self.drain()
+
+    def fine(self):
+        with self._misc_lock:
+            return self._q.get()
+"""
+    got = conc.lock_lint_source(src, "t.py")
+    assert [d.code for d in got] == ["L113"]
+    # anchored at the blocking call, with the call path in related
+    assert any("reached via this call" in n for _, _, n in got[0].related)
+
+
+def test_l113_condition_wait_on_own_lock_is_exempt():
+    src = """
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def pop(self):
+        with self._cond:
+            self._cond.wait()
+"""
+    assert _codes(src) == []
+
+
+def test_l113_condition_wait_under_dispatch_lock_flagged():
+    src = """
+import threading
+
+class Q:
+    def __init__(self):
+        self._dispatch_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def pop(self):
+        with self._dispatch_lock:
+            with self._cond:
+                self._cond.wait()
+"""
+    assert _codes(src) == ["L113"]
+
+
+def test_l114_requires_two_roots_and_no_common_guard():
+    two_roots = """
+import threading
+
+class C:
+    def __init__(self):
+        self.x = 0
+        self._t1 = threading.Thread(target=self.w1)
+        self._t2 = threading.Thread(target=self.w2)
+
+    def w1(self):
+        self.x = 1
+
+    def w2(self):
+        self.x = 2
+"""
+    assert _codes(two_roots) == ["L114"]
+    one_root = two_roots.replace("self._t2 = threading.Thread"
+                                 "(target=self.w2)", "pass")
+    assert _codes(one_root) == []
+
+
+def test_l114_init_writes_and_guard_annotation_exempt():
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self.x = 0          # __init__ writes never count
+        self._t1 = threading.Thread(target=self.w1)
+        self._t2 = threading.Thread(target=self.w2)
+
+    def w1(self):
+        self.x = 1          # lock: guard external
+
+    def w2(self):
+        self.x = 2          # lock: guard external
+"""
+    assert _codes(src) == []
+
+
+def test_l115_exception_edge():
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.v = 0
+
+    def bad(self):
+        self._lock.acquire()
+        self.v = compute()
+        self._lock.release()
+
+    def good(self):
+        self._lock.acquire()
+        try:
+            self.v = compute()
+        finally:
+            self._lock.release()
+
+    def handoff(self):
+        self._lock.acquire()     # no release in this body: not flagged
+        self.v = 1
+"""
+    got = conc.lock_lint_source(src, "t.py")
+    assert [d.code for d in got] == ["L115"]
+    assert got[0].line == 10
+
+
+def test_l115_acquire_inside_finally_is_silent():
+    # the release-then-reacquire idiom from Channel.run: cond.acquire()
+    # inside a finally is the repair path, not a leak
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.v = 0
+
+    def fold(self):
+        self._lock.acquire()
+        try:
+            self._lock.release()
+            try:
+                self.v = compute()
+            finally:
+                self._lock.acquire()
+        finally:
+            self._lock.release()
+"""
+    assert _codes(src) == []
+
+
+def test_annotations_ignore_and_acquires():
+    flagged = """
+import queue
+import threading
+
+class B:
+    def __init__(self):
+        self._dispatch_lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def bad(self):
+        with self._dispatch_lock:
+            self._q.get()
+"""
+    assert _codes(flagged) == ["L113"]
+    ignored = flagged.replace("self._q.get()",
+                              "self._q.get()  # lock: ignore")
+    assert _codes(ignored) == []
+    annotated = """
+import queue
+import threading
+
+class B:
+    def __init__(self):
+        self._lk = threading.Lock()   # lock: dispatch
+        self._q = queue.Queue()
+
+    def bad(self):
+        with self._lk:
+            self._q.get()
+"""
+    assert _codes(annotated) == ["L113"]
+
+
+def test_blocking_annotation():
+    src = """
+import threading
+
+class B:
+    def __init__(self):
+        self._dispatch_lock = threading.Lock()
+
+    def bad(self):
+        with self._dispatch_lock:
+            self.rpc()  # lock: blocking
+"""
+    assert _codes(src) == ["L113"]
+
+
+def test_syntax_error_reports_l100(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def oops(:\n")
+    (d,) = conc.lock_lint_paths([str(bad)])
+    assert d.code == "L100"
+
+
+def test_cli_exit_codes(capsys):
+    defect = os.path.join(CORPUS, "defect_lock_order_cycle.py")
+    assert conc.main([defect]) == 1
+    out = capsys.readouterr().out
+    assert "L112" in out and "diagnostic(s)" in out
+    assert conc.main([os.path.join(CORPUS, "clean_lock_order.py")]) == 0
+    assert conc.main(["-h"]) == 0
+
+
+def test_analyze_cli_has_locks_command(capsys):
+    from tpu_mpi.analyze.__main__ import main as analyze_main
+    defect = os.path.join(CORPUS, "defect_blocking_under_dispatch_lock.py")
+    assert analyze_main(["locks", defect]) == 1
+    assert "L113" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Runtime witness (TPU_MPI_LOCKCHECK=1)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def witness(monkeypatch):
+    monkeypatch.setenv("TPU_MPI_LOCKCHECK", "1")
+    config.load(refresh=True)
+    locksmith.reset()
+    perfvars.reset()
+    yield
+    locksmith.reset()
+    monkeypatch.delenv("TPU_MPI_LOCKCHECK", raising=False)
+    config.load(refresh=True)
+
+
+def test_pay_for_use_off_means_plain_primitives(monkeypatch):
+    monkeypatch.delenv("TPU_MPI_LOCKCHECK", raising=False)
+    config.load(refresh=True)
+    lk = locksmith.make_lock("t")
+    # the plain threading primitive, not a shim: zero steady-state cost
+    assert type(lk) is type(threading.Lock())
+    assert isinstance(locksmith.make_rlock("t"),
+                      type(threading.RLock()))
+    assert isinstance(locksmith.make_condition("t"), threading.Condition)
+
+
+def test_inverted_order_raises_before_any_deadlock(witness):
+    """The acceptance reproducer: two threads establish inverted
+    acquisition order; the second gets a typed LockOrderError the moment
+    the graph gains a cycle — neither thread ever blocks on a lock."""
+    a = locksmith.make_lock("repro.A")
+    b = locksmith.make_lock("repro.B")
+    errors = []
+
+    def t1():
+        with a:
+            with b:      # establishes A -> B
+                pass
+
+    def t2():
+        try:
+            with b:
+                with a:  # inversion: B -> A
+                    pass
+        except LockOrderError as e:
+            errors.append(e)
+
+    th1 = threading.Thread(target=t1)
+    th1.start()
+    th1.join(5)
+    th2 = threading.Thread(target=t2)
+    th2.start()
+    th2.join(5)
+    assert not th2.is_alive(), "witness failed: thread deadlocked"
+    assert len(errors) == 1
+    msg = str(errors[0])
+    # both acquisition paths, as file:line chains
+    assert "this thread" in msg and "established order" in msg
+    assert __file__.split(os.sep)[-1] in msg
+    assert errors[0].CODE == 76  # ERR_LOCK_ORDER
+
+
+def test_exception_edge_releases_witness_entry(witness):
+    lk = locksmith.make_lock("exc.lock")
+    with pytest.raises(RuntimeError):
+        with lk:
+            raise RuntimeError("boom")
+    # the with-exit released on the exception edge: nothing held
+    assert locksmith.witness_report() == ""
+    # and the lock is actually free
+    assert lk.acquire(blocking=False)
+    lk.release()
+
+
+def test_contention_pvars(witness):
+    lk = locksmith.make_lock("pv.lock")
+    started = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            started.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert started.wait(5)
+    assert not lk.acquire(blocking=False)   # contended observation
+    release.set()
+    t.join(5)
+    with lk:
+        time.sleep(0.01)
+    snap = perfvars.locks_snapshot()["pv.lock"]
+    assert snap["acquires"] >= 2
+    assert snap["contended"] >= 1
+    assert snap["max_held_ns"] >= 10_000_000   # the 10ms hold
+
+
+def test_c401_condition_wait_while_holding_other_lock(witness):
+    other = locksmith.make_lock("c401.other")
+    cond = locksmith.make_condition("c401.cond")
+    waiter_done = threading.Event()
+
+    def waiter():
+        with other:
+            with cond:
+                cond.wait(0.05)
+        waiter_done.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    t.join(5)
+    assert waiter_done.is_set()
+    diags = locksmith.c401_diagnostics()
+    assert [d.code for d in diags] == ["C401"]
+    assert "c401.other" in str(diags[0])
+    assert any("c401.other" in n for _, _, n in diags[0].related)
+    # waiting with no other lock held records nothing new
+    with cond:
+        cond.wait(0.01)
+    assert len(locksmith.c401_diagnostics()) == 1
+
+
+def test_condition_wait_notify_roundtrip(witness):
+    cond = locksmith.make_condition("cw.cond")
+    seen = []
+
+    def consumer():
+        with cond:
+            while not seen:
+                cond.wait(5)
+            seen.append("consumed")
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        seen.append("produced")
+        cond.notify()
+    t.join(5)
+    assert seen == ["produced", "consumed"]
+    assert locksmith.witness_report() == ""
+
+
+def test_rlock_reentrancy_no_self_edges(witness):
+    rl = locksmith.make_rlock("re.lock")
+    with rl:
+        with rl:
+            assert "re.lock" in locksmith.witness_report()
+    assert locksmith.witness_report() == ""
+    assert locksmith.order_graph() == {}
+
+
+def test_witness_report_in_deadlock_dump(witness):
+    from tpu_mpi.analyze.matcher import deadlock_report
+    lk = locksmith.make_lock("dump.lock")
+    held = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            held.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert held.wait(5)
+    try:
+        report = deadlock_report(object())   # no tracer: witness part only
+        assert "witness-held locks per thread" in report
+        assert "dump.lock" in report and ".py:" in report
+    finally:
+        release.set()
+        t.join(5)
+
+
+def test_lockcheck_stacks_records_chain(witness, monkeypatch):
+    monkeypatch.setenv("TPU_MPI_LOCKCHECK_STACKS", "1")
+    config.load(refresh=True)
+    lk = locksmith.make_lock("stk.lock")
+    hold = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            hold.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert hold.wait(5)
+    try:
+        report = locksmith.witness_report()
+        assert " <- " in report      # multi-frame acquisition stack
+    finally:
+        release.set()
+        t.join(5)
+        monkeypatch.delenv("TPU_MPI_LOCKCHECK_STACKS", raising=False)
+        config.load(refresh=True)
+
+
+# ---------------------------------------------------------------------------
+# T215: dispatch-section serialization over the event IR
+# ---------------------------------------------------------------------------
+
+def _mk_tracer():
+    from tpu_mpi.analyze.events import Event, Tracer, BROKER_RANK
+    tr = Tracer(nprocs=2, cap=256)
+    for cid in (100, 200):
+        tr.record(Event("serve", BROKER_RANK, op="dispatch", cid=cid,
+                        file="b.py", line=1))
+    return tr, Event, BROKER_RANK
+
+
+def test_t215_clean_when_orders_agree():
+    from tpu_mpi.analyze.matcher import _check_lock_serialization
+    tr, Event, _ = _mk_tracer()
+    for rank in (0, 1):
+        for cid in (100, 200):
+            tr.record(Event("coll", rank, op="Allreduce", cid=cid,
+                            file="w.py", line=5))
+    assert _check_lock_serialization(tr) == []
+
+
+def test_t215_flags_inverted_initiation():
+    from tpu_mpi.analyze.matcher import _check_lock_serialization
+    tr, Event, _ = _mk_tracer()
+    tr.record(Event("coll", 0, op="Allreduce", cid=100, file="w.py", line=5))
+    tr.record(Event("coll", 0, op="Allreduce", cid=200, file="w.py", line=5))
+    # rank 1 initiates 200 before 100: dispatch sections did not serialize
+    tr.record(Event("coll", 1, op="Allreduce", cid=200, file="w.py", line=9))
+    tr.record(Event("coll", 1, op="Allreduce", cid=100, file="w.py", line=9))
+    (d,) = _check_lock_serialization(tr)
+    assert d.code == "T215" and d.rank == 1
+    assert "did not serialize" in d.message
+
+
+def test_t215_overflowed_ring_is_skipped():
+    from tpu_mpi.analyze.matcher import _check_lock_serialization
+    tr, Event, _ = _mk_tracer()
+    tr.record(Event("coll", 1, op="Allreduce", cid=200, file="w.py", line=9))
+    tr.record(Event("coll", 1, op="Allreduce", cid=100, file="w.py", line=9))
+    tr.dropped[1] = 3   # ring evicted this rank's early events
+    assert _check_lock_serialization(tr) == []
+
+
+def test_t215_in_codes_table():
+    assert "T215" in CODES and "C401" in CODES
+    for code in ("L112", "L113", "L114", "L115"):
+        assert code in CODES
+
+
+# ---------------------------------------------------------------------------
+# stats plumbing: the lock-contention block survives aggregation
+# ---------------------------------------------------------------------------
+
+def test_stats_aggregate_and_render_locks():
+    import io
+    from tpu_mpi import stats
+    recs = [
+        {"locks": {"pool.dispatch": {"acquires": 3, "contended": 1,
+                                     "max_held_ns": 5_000_000}}},
+        {"locks": {"pool.dispatch": {"acquires": 2, "contended": 0,
+                                     "max_held_ns": 9_000_000},
+                   "fairqueue": {"acquires": 7, "contended": 0,
+                                 "max_held_ns": 1_000}}},
+    ]
+    agg = stats.aggregate(recs)
+    assert agg["locks"]["pool.dispatch"] == {
+        "acquires": 5, "contended": 1, "max_held_ns": 9_000_000}
+    out = io.StringIO()
+    stats.render(agg, out=out)
+    text = out.getvalue()
+    assert "lock contention" in text
+    assert "pool.dispatch" in text and "fairqueue" in text
+
+
+# ---------------------------------------------------------------------------
+# Witness-armed serve smoke: the live broker under LOCKCHECK
+# ---------------------------------------------------------------------------
+
+def test_serve_smoke_with_witness_armed(witness):
+    import numpy as np
+    from tpu_mpi import serve
+    b = serve.Broker(nranks=2)
+    b.run_in_thread()
+    try:
+        s = serve.attach(b.address, tenant="wt")
+        got = s.allreduce([np.ones(8, np.float32)] * 2)
+        assert np.allclose(got, 2.0)
+        s.detach()
+    finally:
+        b.close()
+    # the witness observed the fabric and found a consistent order
+    graph = locksmith.order_graph()
+    assert any("pool.dispatch" in outer for outer in graph), graph
+    snap = perfvars.locks_snapshot()
+    assert snap.get("pool.dispatch", {}).get("acquires", 0) > 0
+    assert snap.get("fairqueue", {}).get("acquires", 0) > 0
+
+
+@pytest.mark.slow
+def test_serve_chaos_case_with_witness_armed(witness):
+    """Re-run a test_serve chaos case under the witness: a SIGKILL'd
+    client's lease is revoked and the pool survives, with LOCKCHECK on
+    the whole time (no LockOrderError from the broker fabric)."""
+    import subprocess
+    import sys
+    env = dict(os.environ, TPU_MPI_LOCKCHECK="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         os.path.join(os.path.dirname(__file__), "test_serve.py"),
+         "-k", "sigkilled_client"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -21,9 +21,9 @@ from ._runtime import (ANY_SOURCE, ANY_TAG, PROC_NULL, UNDEFINED,
                        SpmdContext, spmd_run)
 from .error import (AbortError, AnalyzerError, CollectiveMismatchError,
                     DeadlockError, Error_string, Get_error_string,
-                    InvalidCommError, MPIError, ProcFailedError,
-                    QuotaExceededError, RevokedError, ServeBusyError,
-                    SessionError, TruncationError)
+                    InvalidCommError, LockOrderError, MPIError,
+                    ProcFailedError, QuotaExceededError, RevokedError,
+                    ServeBusyError, SessionError, TruncationError)
 
 # Communication-correctness analysis (docs/analysis.md): static lint,
 # cross-rank trace verifier, RMA race detector.

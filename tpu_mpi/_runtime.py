@@ -28,9 +28,13 @@ import threading
 import time
 from typing import Any, Callable, Optional, Sequence
 
+from . import locksmith
 from .error import (AbortError, CollectiveMismatchError, DeadlockError,
                     MPIError, ProcFailedError, RevokedError, SessionError)
 from . import perfvars as _pv
+
+# per-instance witness-name sequence for Mailbox/CollectiveChannel locks
+_lock_seq = itertools.count(1)
 
 # Wildcards / sentinels (values mirror the MPI spec's spirit; they are our own).
 ANY_SOURCE = -2
@@ -184,7 +188,7 @@ class CidNamespace:
         self.base = base          # first cid of the range (== the world cid)
         self.limit = limit        # one past the last usable cid
         self._next = base
-        self._lock = threading.Lock()
+        self._lock = locksmith.make_lock(f"ns[{tenant}]")
 
     def alloc(self) -> int:
         with self._lock:
@@ -362,8 +366,11 @@ class Mailbox(_Waitable):
         self.ctx = ctx
         # RLock: ctx.fail() may notify a condition whose lock the failing
         # thread itself holds (observed self-deadlock on collective mismatch).
-        self.lock = threading.RLock()
-        self.cond = threading.Condition(self.lock)
+        # Witness names are per-instance: two mailboxes' locks are distinct
+        # order-graph nodes, not one shared node with self-edges.
+        name = f"mailbox[{next(_lock_seq)}]"
+        self.lock = locksmith.make_rlock(name)
+        self.cond = locksmith.make_condition(name, self.lock)
         self.queue: list[Message] = []        # unexpected messages, FIFO
         self.recvs: list[PendingRecv] = []    # posted receives, FIFO
         self.queued_bytes = 0                 # unexpected-queue footprint
@@ -615,8 +622,10 @@ class CollectiveChannel(_Waitable):
     def __init__(self, ctx: "SpmdContext", size: int):
         self.ctx = ctx
         self.size = size
-        self.lock = threading.RLock()   # see Mailbox.__init__ on reentrancy
-        self.cond = threading.Condition(self.lock)
+        # see Mailbox.__init__ on reentrancy + per-instance witness names
+        name = f"channel[{next(_lock_seq)}]"
+        self.lock = locksmith.make_rlock(name)
+        self.cond = locksmith.make_condition(name, self.lock)
         # per-rank next-round counters + live per-round rendezvous slots
         self.rank_round = [0] * size
         self.rounds: dict[int, dict] = {}
@@ -810,12 +819,12 @@ class SpmdContext:
         self.universe_size = universe_size if universe_size is not None else size
         self.mailboxes = [Mailbox(self) for _ in range(size)]
         self._channels: dict[int, CollectiveChannel] = {}
-        self._channels_lock = threading.Lock()
+        self._channels_lock = locksmith.make_lock("ctx.channels")
         # cid 0 = COMM_WORLD, 1 = COMM_SELF; dynamic cids start at 2.
         self._next_cid = itertools.count(2)
         self.failure: Optional[BaseException] = None
         self.failed_rank: Optional[int] = None
-        self._failure_lock = threading.Lock()
+        self._failure_lock = locksmith.make_lock("ctx.failure")
         # ULFM fault state (docs/fault-tolerance.md): world ranks the
         # failure detector declared dead, ranks that left cleanly (Finalize
         # with detection on — NOT failures), and revoked communicator cids.
@@ -828,7 +837,7 @@ class SpmdContext:
         # namespace. Empty outside a broker — the cross-tenant channel guard
         # is then a single truth test (pay-for-use, like the fault path).
         self.cid_namespaces: dict[str, CidNamespace] = {}
-        self._ns_lock = threading.Lock()
+        self._ns_lock = locksmith.make_lock("ctx.ns")
         self._ns_next_base = 1 << 20   # far above itertools.count(2)'s reach
         # Per-rank lifecycle flags (src/environment.jl:267-287 queries).
         self.initialized = [False] * size
@@ -837,7 +846,7 @@ class SpmdContext:
         self.main_threads: list[Optional[int]] = [None] * size
         # Attribute store for windows/files keyed by (kind, id).
         self.objects: dict[Any, Any] = {}
-        self.objects_lock = threading.Lock()
+        self.objects_lock = locksmith.make_lock("ctx.objects")
         # Dynamic process management (src/comm.jl:123-162): each world rank
         # belongs to a "job world" — its own COMM_WORLD group + context id.
         # Spawned groups get a fresh world (MPI gives spawned jobs their own
@@ -848,9 +857,9 @@ class SpmdContext:
         self.spawn_argv: dict[int, list] = {}     # spawned rank -> its argv
         # debug sequence-check counters: (dest_world, cid, src_comm_rank)
         self._seq_counters: dict = {}
-        self._seq_lock = threading.Lock()
+        self._seq_lock = locksmith.make_lock("ctx.seq")
         self.spawned_threads: list[threading.Thread] = []
-        self._spawn_lock = threading.Lock()
+        self._spawn_lock = locksmith.make_lock("ctx.spawn")
 
     @property
     def host_token(self) -> str:

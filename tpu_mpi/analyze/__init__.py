@@ -30,7 +30,8 @@ from __future__ import annotations
 
 from .diagnostics import CODES, Diagnostic
 
-__all__ = ["CODES", "Diagnostic", "lint_paths", "lint_source", "verify_trace",
+__all__ = ["CODES", "Diagnostic", "lint_paths", "lint_source",
+           "lock_lint_paths", "lock_lint_source", "verify_trace",
            "detect_races", "deadlock_report", "last_trace", "timeline",
            "merge_trace", "write_chrome", "clock_offsets", "explore",
            "ExploreResult", "load_trace", "dump_trace"]
@@ -42,6 +43,9 @@ def __getattr__(name):
     if name in ("lint_paths", "lint_source"):
         from . import lint as _lint
         return getattr(_lint, name)
+    if name in ("lock_lint_paths", "lock_lint_source"):
+        from . import concurrency as _concurrency
+        return getattr(_concurrency, name)
     if name in ("verify_trace", "deadlock_report"):
         from . import matcher as _matcher
         return getattr(_matcher, name)

@@ -4,6 +4,11 @@ Commands:
 
 - ``lint file.py dir/ …`` — static communication lint (also available as
   ``python -m tpu_mpi.lint``);
+- ``locks file.py dir/ …`` — static concurrency lint: builds the
+  lock-acquisition graph and flags lock-order cycles (L112), blocking
+  calls under a dispatch lock (L113), unguarded shared fields (L114) and
+  missed releases on exception edges (L115)
+  (:mod:`tpu_mpi.analyze.concurrency`);
 - ``explore <trace prefix or files> [--max-schedules N] [--max-states N]``
   — DPOR-style schedule-space verification over a recorded trace
   (:mod:`tpu_mpi.analyze.explore`); record one with ``TPU_MPI_TRACE=1
@@ -31,6 +36,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if cmd == "lint":
         from .lint import main as lint_main
         return lint_main(rest)
+    if cmd == "locks":
+        from .concurrency import main as locks_main
+        return locks_main(rest)
     if cmd == "explore":
         from .explore import main as explore_main
         return explore_main(rest)

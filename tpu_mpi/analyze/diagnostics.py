@@ -5,6 +5,9 @@ One code space across the three passes (docs/analysis.md):
 - ``Lxxx`` — static lint (:mod:`tpu_mpi.analyze.lint`)
 - ``Txxx`` — cross-rank trace verifier (:mod:`tpu_mpi.analyze.matcher`)
 - ``Rxxx`` — RMA race detector (:mod:`tpu_mpi.analyze.races`)
+- ``Cxxx`` — runtime lock witness (:mod:`tpu_mpi.locksmith`); the static
+  concurrency lint (:mod:`tpu_mpi.analyze.concurrency`) shares the
+  ``Lxxx`` space (L112–L115)
 
 Each diagnostic projects onto an MPI error class
 (:data:`tpu_mpi.error.DIAGNOSTIC_CODES`), so ``Error_string`` /
@@ -32,6 +35,12 @@ CODES = {
             "between Start and Wait / Start after free)",
     "L110": "operation on a revoked or shrunk communicator",
     "L111": "serve-session misuse (cross-tenant comm / op after detach)",
+    "L112": "lock-order cycle across acquisition paths (potential deadlock)",
+    "L113": "blocking call while holding a dispatch/pool lock",
+    "L114": "shared mutable field written on multiple threads with no "
+            "common guard",
+    "L115": "lock released on a different path than it was acquired "
+            "(missed release on an exception edge)",
     "T201": "ranks called different collectives in the same round",
     "T202": "collective signature (root/dtype/count) disagrees across ranks",
     "T203": "sent message was never received",
@@ -44,6 +53,11 @@ CODES = {
     "T213": "algorithm selection disagrees across ranks in a collective "
             "round",
     "T214": "a rank skipped an elastic rebind quiesce/resume barrier",
+    "T215": "dispatch-lock critical sections failed to serialize "
+            "(op-initiation order diverges from cross-rank collective "
+            "order)",
+    "C401": "blocking call while holding another witnessed lock "
+            "(runtime lock witness)",
     "R301": "concurrent overlapping RMA accesses (vector-clock race)",
     "R302": "donated persistent-fold result used after a later Start "
             "invalidated it",

@@ -63,6 +63,7 @@ def verify_trace(obj: Any = None) -> List[Diagnostic]:
     out += _check_ft(tr)
     out += _check_serve(tr)
     out += _check_elastic(tr)
+    out += _check_lock_serialization(tr)
     from .races import detect_donation_races, detect_races
     out += detect_races(tr)
     out += detect_donation_races(tr)
@@ -352,6 +353,56 @@ def _check_serve(tr) -> List[Diagnostic]:
 
 
 # ---------------------------------------------------------------------------
+# Dispatch-lock serialization (T215): the broker's dispatcher records each
+# pop under BROKER_RANK; if the dispatch-lock critical sections serialize,
+# every rank initiates its first collective per comm in the same relative
+# order the dispatcher released them.
+# ---------------------------------------------------------------------------
+
+def _check_lock_serialization(tr) -> List[Diagnostic]:
+    from .events import BROKER_RANK
+    out: List[Diagnostic] = []
+    dispatch_order: List[Any] = []          # cids by first dispatch event
+    seen: set = set()
+    for ev in tr.events(BROKER_RANK):
+        if ev.kind == "serve" and ev.op == "dispatch" and ev.cid is not None:
+            if ev.cid not in seen:
+                seen.add(ev.cid)
+                dispatch_order.append(ev.cid)
+    if len(dispatch_order) < 2:
+        return out
+    pos = {cid: i for i, cid in enumerate(dispatch_order)}
+    with tr.lock:
+        ranks = sorted(r for r in tr.rings if r != BROKER_RANK)
+        dropped = dict(tr.dropped)
+    for rank in ranks:
+        if dropped.get(rank):
+            # the ring evicted this rank's early events: its observed first
+            # occurrences are not the real first occurrences — stay silent
+            continue
+        firsts: List[Any] = []
+        by_cid: Dict[Any, Any] = {}
+        for ev in tr.events(rank):
+            if ev.kind == "coll" and ev.cid in pos and ev.cid not in by_cid:
+                by_cid[ev.cid] = ev
+                firsts.append(ev.cid)
+        for a, b in zip(firsts, firsts[1:]):
+            if pos[a] > pos[b]:
+                ev = by_cid[b]
+                out.append(Diagnostic(
+                    "T215",
+                    f"rank {rank} initiated comm {b}'s first collective "
+                    f"before comm {a}'s, but the dispatcher released "
+                    f"{a} before {b} — dispatch-lock critical sections "
+                    f"did not serialize op initiation",
+                    file=ev.file, line=ev.line, rank=rank,
+                    context=f"dispatch order {dispatch_order}, "
+                            f"rank order {firsts}"))
+                break
+    return out
+
+
+# ---------------------------------------------------------------------------
 # DeadlockError dump: per-rank pending operations + the wait-for cycle
 # ---------------------------------------------------------------------------
 
@@ -407,33 +458,45 @@ def _find_cycle(edges: Dict[int, List[int]]) -> Optional[List[int]]:
 
 def deadlock_report(ctx: Any) -> str:
     """Multi-line dump of per-rank pending operations and the wait-for
-    cycle, appended to DeadlockError messages when tracing is on. Returns
-    "" when there is nothing useful to say — never raises (this runs while
-    the job is already failing)."""
+    cycle, appended to DeadlockError messages when tracing is on; armed
+    witness runs (TPU_MPI_LOCKCHECK=1) additionally get every thread's
+    held-lock set with acquisition sites. Returns "" when there is nothing
+    useful to say — never raises (this runs while the job is already
+    failing)."""
+    lines: List[str] = []
     try:
         tr = getattr(ctx, "_tracer", None)
-        if tr is None:
-            return ""
-        with tr.lock:
-            blocked = dict(tr.blocked)
-        if not blocked:
-            return ""
-        now = time.monotonic()
-        lines = ["per-rank pending operations:"]
-        edges: Dict[int, List[int]] = {}
-        for r in sorted(blocked):
-            ev = blocked[r]
-            lines.append(f"  world rank {r}: blocked {now - ev.t:.1f}s in "
-                         f"{ev.describe()} at {ev.file}:{ev.line}")
-            edges[r] = _waits_for(ctx, ev, blocked)
-        idle = [r for r in range(getattr(ctx, "size", 0)) if r not in blocked]
-        if idle:
-            lines.append(f"  rank(s) {idle} not blocked in any traced "
-                         f"operation")
-        cyc = _find_cycle(edges)
-        if cyc:
-            lines.append("wait-for cycle: "
-                         + " -> ".join(f"rank {r}" for r in cyc + [cyc[0]]))
-        return "\n".join(lines)
+        blocked = {}
+        if tr is not None:
+            with tr.lock:
+                blocked = dict(tr.blocked)
+        if blocked:
+            now = time.monotonic()
+            lines.append("per-rank pending operations:")
+            edges: Dict[int, List[int]] = {}
+            for r in sorted(blocked):
+                ev = blocked[r]
+                lines.append(f"  world rank {r}: blocked {now - ev.t:.1f}s "
+                             f"in {ev.describe()} at {ev.file}:{ev.line}")
+                edges[r] = _waits_for(ctx, ev, blocked)
+            idle = [r for r in range(getattr(ctx, "size", 0))
+                    if r not in blocked]
+            if idle:
+                lines.append(f"  rank(s) {idle} not blocked in any traced "
+                             f"operation")
+            cyc = _find_cycle(edges)
+            if cyc:
+                lines.append("wait-for cycle: " + " -> ".join(
+                    f"rank {r}" for r in cyc + [cyc[0]]))
     except Exception:
-        return ""
+        pass
+    try:
+        # witness-armed runs know which locks every thread holds and where
+        # it acquired them — the missing half of a deadlock dump
+        from .. import locksmith
+        witness = locksmith.witness_report()
+        if witness:
+            lines.append(witness)
+    except Exception:
+        pass
+    return "\n".join(lines)

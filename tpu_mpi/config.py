@@ -304,6 +304,14 @@ class Config:
     # its rank failed (the chaos hook for the thread-tier pool, where rank
     # threads cannot be killed individually).
     elastic_sidecars: bool = False
+    # runtime lock witness (tpu_mpi.locksmith): swap every named lock
+    # construction site for a LockWitness that maintains the global
+    # acquisition-order graph and raises LockOrderError on inversion.
+    # Pay-for-use: off means plain threading primitives, zero overhead.
+    lockcheck: bool = False
+    # record full acquisition stacks (not just the caller's site) in
+    # witness reports — costlier, for post-mortem dumps.
+    lockcheck_stacks: bool = False
 
     def replace(self, **kw: Any) -> "Config":
         d = {f.name: getattr(self, f.name) for f in fields(self)}
@@ -381,6 +389,8 @@ _ENV_MAP = {
     "elastic_depth_high": "TPU_MPI_ELASTIC_DEPTH_HIGH",
     "elastic_idle_ticks": "TPU_MPI_ELASTIC_IDLE_TICKS",
     "elastic_sidecars": "TPU_MPI_ELASTIC_SIDECARS",
+    "lockcheck": "TPU_MPI_LOCKCHECK",
+    "lockcheck_stacks": "TPU_MPI_LOCKCHECK_STACKS",
 }
 
 _lock = threading.Lock()

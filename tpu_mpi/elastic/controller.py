@@ -36,6 +36,7 @@ import time
 from typing import Optional
 
 from .. import config
+from .. import locksmith
 from ..analyze import events as _ev
 
 
@@ -57,7 +58,7 @@ class ElasticController:
         self._idle_ticks = 0
         self._last_busy = 0
         self._last_resize_mono = 0.0
-        self._resize_lock = threading.Lock()
+        self._resize_lock = locksmith.make_lock("elastic.resize")
         self._kick = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -21,6 +21,8 @@ import sys
 import threading
 from typing import Callable, Dict, Optional
 
+from .. import locksmith
+
 
 class RankSidecars:
     """One sleeping child process per world rank + a poller thread."""
@@ -32,7 +34,7 @@ class RankSidecars:
         self._procs: Dict[int, subprocess.Popen] = {}
         self._reported: set = set()
         self._retired: set = set()
-        self._lock = threading.Lock()
+        self._lock = locksmith.make_lock("elastic.sidecars")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         for r in ranks:

@@ -64,6 +64,7 @@ ERR_SERVE_BUSY = 72
 ERR_SESSION = 73
 ERR_SLO_EXPIRED = 74
 ERR_POOL_DEGRADED = 75
+ERR_LOCK_ORDER = 76
 
 _ERROR_STRINGS = {
     SUCCESS: "MPI_SUCCESS: no error",
@@ -132,6 +133,9 @@ _ERROR_STRINGS = {
                        "is running degraded — this tenant's communicators "
                        "span a dead rank; retriable once the autoscaler "
                        "restores capacity and rebinds the lease",
+    ERR_LOCK_ORDER: "TPU_ERR_LOCK_ORDER: two threads established inverted "
+                    "lock-acquisition order (tpu_mpi.locksmith witness) — a "
+                    "potential deadlock caught before any thread blocked",
 }
 
 # tpu_mpi.analyze diagnostic code -> MPI error class. The analyzer's own
@@ -151,6 +155,10 @@ DIAGNOSTIC_CODES = {
     "L109": ERR_REQUEST,                # persistent-request misuse
     "L110": ERR_REVOKED,                # op on revoked/shrunk communicator
     "L111": ERR_SESSION,                # serve-session misuse
+    "L112": ERR_LOCK_ORDER,             # static lock-order cycle
+    "L113": ERR_DEADLOCK,               # blocking under a dispatch/pool lock
+    "L114": ERR_INTERN,                 # unguarded cross-thread field write
+    "L115": ERR_LOCK_ORDER,             # release path differs from acquire
     "T201": ERR_COLLECTIVE_MISMATCH,    # collective order mismatch (traced)
     "T202": ERR_COLLECTIVE_MISMATCH,    # collective signature mismatch
     "T203": ERR_PENDING,                # sent message never received
@@ -162,6 +170,8 @@ DIAGNOSTIC_CODES = {
     "T212": ERR_ARG,                    # schedule-dependent wildcard values
     "T213": ERR_COLLECTIVE_MISMATCH,    # per-rank algorithm selection split
     "T214": ERR_COLLECTIVE_MISMATCH,    # rank skipped elastic rebind barrier
+    "T215": ERR_COLLECTIVE_MISMATCH,    # dispatch sections failed to serialize
+    "C401": ERR_DEADLOCK,               # blocked while holding a witnessed lock
     "R301": ERR_RMA_RACE,               # vector-clock RMA race
     "R302": ERR_BUFFER,                 # donated fold result read after inval
 }
@@ -212,6 +222,17 @@ class DeadlockError(MPIError):
     """A blocking operation exceeded the runtime's deadlock timeout."""
 
     CODE = ERR_DEADLOCK
+
+
+class LockOrderError(MPIError):
+    """Two threads established inverted lock-acquisition order.
+
+    Raised by the :mod:`tpu_mpi.locksmith` witness (``TPU_MPI_LOCKCHECK=1``)
+    the moment the global order graph gains a cycle — no thread has to
+    actually deadlock for this to fire. The message carries both
+    acquisition paths as file:line chains."""
+
+    CODE = ERR_LOCK_ORDER
 
 
 class TruncationError(MPIError):

@@ -61,6 +61,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .. import config
+from .. import locksmith
 from .. import perfvars
 from ..error import MPIError
 from .. import error as _ec
@@ -199,9 +200,9 @@ class InferEngine:
         self.kv_blocks = int(kv_blocks)
         self._state: Dict[int, dict] = {}
         self._reserved = [0] * self.ep
-        self._resv_lock = threading.Lock()
+        self._resv_lock = locksmith.make_lock("infer.reservations")
         self.moe_rounds = 0           # dispatch/combine rounds, both stages
-        self._rounds_lock = threading.Lock()
+        self._rounds_lock = locksmith.make_lock("infer.rounds")
         self.wcomm = None
         self.ep_comms = (None, None)
 

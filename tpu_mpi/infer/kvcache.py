@@ -42,6 +42,7 @@ import numpy as np
 
 from ..error import MPIError
 from .. import error as _ec
+from .. import locksmith
 
 
 def _prefix_key(tokens: Sequence[int]) -> bytes:
@@ -78,7 +79,7 @@ class PagedKVCache:
         # "blocks": {layer: [ids]}, "partials": [{"tokens","blocks"}]}.
         # The registry holds one reference per block it can hand out.
         self._registry: "OrderedDict[bytes, dict]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = locksmith.make_lock("infer.kvcache")
         self.peak_in_use = 0
         self.alloc_failures = 0
         self.cow_forks = 0

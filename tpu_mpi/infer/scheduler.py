@@ -40,6 +40,7 @@ from typing import Deque, Dict, List, Optional
 
 from .. import config
 from .. import error as _ec
+from .. import locksmith
 from .. import perfvars
 from ..error import MPIError, SessionError, SLOExpiredError
 from .engine import Decode, InferEngine, Prefill, StepPlan, PREFILL_TAG_BASE
@@ -104,7 +105,7 @@ class InferScheduler:
         self.max_batch = max(1, int(engine.max_batch if max_batch is None
                                     else max_batch))
         self.slo_ms = int(knobs.infer_slo_ms if slo_ms is None else slo_ms)
-        self._lock = threading.Lock()
+        self._lock = locksmith.make_lock("infer.scheduler")
         self._pending: Deque[InferRequest] = deque()
         self._prefilling: List[InferRequest] = []
         self._active: List[InferRequest] = []

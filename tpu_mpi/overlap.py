@@ -32,6 +32,7 @@ from collections import OrderedDict, deque
 from typing import Any, Callable, Optional
 
 from . import error as _ec
+from . import locksmith
 from .error import MPIError
 
 
@@ -158,7 +159,7 @@ class PlanCache:
     AUTO_CAP = 32
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locksmith.make_lock("overlap.plancache")
         self._plans: "OrderedDict[Any, CollectivePlan]" = OrderedDict()
         self.hits = 0
         self.misses = 0
@@ -491,7 +492,7 @@ class BufferRegistry:
     lease count actually hit zero."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locksmith.make_lock("overlap.registrations")
         self._by_cid: dict[Any, list] = {}
 
     def add(self, reg: PlanRegistration) -> PlanRegistration:

@@ -566,6 +566,38 @@ def serve_frame_snapshot() -> dict:
         return dict(_serve_frame)
 
 
+# -- lock-contention block (tpu_mpi.locksmith) -------------------------------
+#
+# Populated only when the lock witness is armed (TPU_MPI_LOCKCHECK=1):
+# per named lock, how many acquisitions there were, how many had to wait
+# behind another holder, and the longest single hold in nanoseconds.
+# Process-global like the serve_frame block — lock names already carry
+# their subsystem (``broker.dispatch``, ``pool.queues``, ...).
+
+_locks: Dict[str, Dict[str, int]] = {}
+
+
+def note_lock(name: str, acquires: int = 0, contended: int = 0,
+              held_ns: int = 0) -> None:
+    """Accumulate contention counters for one named lock. ``held_ns`` is
+    a single observed hold time; the block keeps the max."""
+    with _store_lock:
+        row = _locks.get(name)
+        if row is None:
+            row = _locks[name] = {"acquires": 0, "contended": 0,
+                                  "max_held_ns": 0}
+        row["acquires"] += int(acquires)
+        row["contended"] += int(contended)
+        if held_ns > row["max_held_ns"]:
+            row["max_held_ns"] = int(held_ns)
+
+
+def locks_snapshot() -> dict:
+    """The locks block of :func:`snapshot` (empty when the witness is off)."""
+    with _store_lock:
+        return {k: dict(v) for k, v in _locks.items()}
+
+
 def note_explore(comm: Any, explored: bool) -> None:
     """One online-autotuner decision on this comm (tpu_mpi.tune_online):
     ``explored`` when the call was routed to an alternate arm."""
@@ -652,7 +684,8 @@ def snapshot(rank: Optional[int] = None, reset: bool = False) -> dict:
             "topology": _topology_stamp(),
             "comms": comms, "plan_cache": plans.stats(),
             "infer": infer_snapshot(), "elastic": elastic_snapshot(),
-            "serve_frame": serve_frame_snapshot()}
+            "serve_frame": serve_frame_snapshot(),
+            "locks": locks_snapshot()}
 
 
 def comm_snapshot(comm: Any, reset: bool = False) -> dict:
@@ -681,6 +714,7 @@ def reset() -> None:
         _elastic.clear()
         _elastic_gauges.clear()
         _serve_frame.clear()
+        _locks.clear()
         _store_gen += 1
 
 

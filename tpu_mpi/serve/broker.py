@@ -55,6 +55,7 @@ import numpy as np
 
 from .. import config
 from .. import error as _ec
+from .. import locksmith
 from ..analyze import events as _ev
 from ..error import MPIError, PoolDegradedError, ProcFailedError, SessionError
 from .._runtime import CidNamespace, SpmdContext, set_current_tenant, set_env
@@ -124,11 +125,11 @@ class _ThreadPool:
         self.base_comm: Any = None             # warm -> shrunk -> merged comm
         self._queues: List[queue.Queue] = [queue.Queue()
                                            for _ in range(self.nranks)]
-        self._queues_lock = threading.Lock()
+        self._queues_lock = locksmith.make_lock("pool.queues")
         self._threads: List[threading.Thread] = []
-        self._dispatch_lock = threading.Lock()
+        self._dispatch_lock = locksmith.make_lock("pool.dispatch")
         self._comms: Dict[int, Any] = {}          # cid -> Comm (shared)
-        self._comms_lock = threading.Lock()
+        self._comms_lock = locksmith.make_lock("pool.comms")
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
@@ -482,7 +483,7 @@ class _BrokerCtx:
     def __init__(self, size: int, shard: CidShard):
         self.size = size
         self.cid_namespaces: Dict[str, CidNamespace] = {}
-        self._ns_lock = threading.Lock()
+        self._ns_lock = locksmith.make_lock("brokerctx.ns")
         self._ns_next_base = shard.base
         self._ns_limit = shard.limit
         self.revoked_cids: set = set()
@@ -550,14 +551,15 @@ class _ProcsPool:
         self.base_comm: Any = None
         self.sim = sim                       # CPU-sim chips per worker; None = real
         self._on_failure = on_failure
-        self._dispatch_lock = threading.Lock()
+        self._dispatch_lock = locksmith.make_lock("procs.dispatch")
         self._comms: Dict[Any, Any] = {}
-        self._comms_lock = threading.Lock()
+        self._comms_lock = locksmith.make_lock("procs.comms")
         self._links: Dict[int, _WorkerLink] = {}
-        self._links_lock = threading.Lock()
-        self._link_cond = threading.Condition(self._links_lock)
+        self._links_lock = locksmith.make_lock("procs.links")
+        self._link_cond = locksmith.make_condition("procs.links",
+                                                   self._links_lock)
         self._pending: Dict[int, _Pending] = {}
-        self._pending_lock = threading.Lock()
+        self._pending_lock = locksmith.make_lock("procs.pending")
         self._wire_oid = itertools.count(1)
         self._pool_cid = itertools.count(101)  # pool-internal cids < NS_FLOOR
         self._token = secrets.token_hex(16)
@@ -1065,7 +1067,7 @@ class Lease:
         self.root_cid = root_cid
         self.comms = {root_cid}           # cids this lease may touch
         self.conn = conn
-        self.send_lock = threading.Lock()
+        self.send_lock = locksmith.make_lock(f"lease[{tenant}].send")
         self.attached_at = time.time()
         self.revoked = False
 
@@ -1115,7 +1117,7 @@ class Broker:
         self._listener: Optional[socket.socket] = None
         self.address: Optional[str] = None
         self._leases: Dict[str, Lease] = {}
-        self._lease_lock = threading.Lock()
+        self._lease_lock = locksmith.make_lock("broker.leases")
         # cid-range ownership outlives the lease so pvar attribution in the
         # ledger stays correct after revocation
         self._cid_ranges: List[tuple] = []    # (base, limit, tenant)
@@ -1135,7 +1137,7 @@ class Broker:
         self._resize_gate.set()
         self.elastic = None                    # ElasticController when on
         self.sidecars = None
-        self._elastic_lock = threading.Lock()
+        self._elastic_lock = locksmith.make_lock("broker.elastic")
         self.elastic_state = {"enabled": bool(self._elastic_spec),
                               "resizes": 0, "rebinds": 0, "failures": 0,
                               "last_resize": None}
@@ -1149,6 +1151,10 @@ class Broker:
                 "broker with TPU_MPI_SERVE_BACKEND=threads (or shard infer "
                 "tenants onto a threads broker behind the router)",
                 code=_ec.ERR_UNSUPPORTED_OPERATION)
+        if locksmith.enabled():
+            # dispatch-named lock transitions land in the event IR so
+            # `analyze verify` can audit dispatch serialization (T215)
+            locksmith.bind_context(self.pool.ctx)
         self.pool.start()
         if self._infer_spec:
             from ..infer import InferEngine, InferScheduler

@@ -25,6 +25,7 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 from .. import error as _ec
+from .. import locksmith
 from ..error import MPIError, QuotaExceededError
 
 POOL_TENANT = "_pool"     # pseudo-tenant for pre-lease / shared-cid traffic
@@ -84,7 +85,7 @@ class CidShard:
 class Ledger:
     def __init__(self, quota_bytes: int = 0):
         self.quota_bytes = int(quota_bytes)
-        self._lock = threading.Lock()
+        self._lock = locksmith.make_lock("ledger")
         self._tenants: Dict[str, dict] = {}
         self._flushes = 0
         self._last_flush: Optional[float] = None

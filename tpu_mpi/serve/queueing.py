@@ -25,6 +25,7 @@ import threading
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from .. import locksmith
 from ..error import ServeBusyError, SessionError
 
 
@@ -39,8 +40,8 @@ class FairQueue:
         self.quantum = int(quantum)
         self.max_depth = int(max_depth)
         self.max_inflight = int(max_inflight)
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        self._lock = locksmith.make_lock("fairqueue")
+        self._cond = locksmith.make_condition("fairqueue", self._lock)
         self._queues: Dict[str, deque] = {}        # tenant -> ops
         self._deficit: Dict[str, int] = {}
         self._inflight: Dict[str, int] = {}

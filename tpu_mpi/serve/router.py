@@ -34,6 +34,7 @@ import threading
 from typing import Dict, List, Optional
 
 from .. import config
+from .. import locksmith
 from ..error import MPIError, SessionError
 from . import protocol
 
@@ -135,7 +136,7 @@ class Router:
         self._threads: List[threading.Thread] = []
         # observability: tenant -> home broker of every live splice
         self.routes: Dict[str, str] = {}
-        self._routes_lock = threading.Lock()
+        self._routes_lock = locksmith.make_lock("router.routes")
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:

@@ -22,6 +22,7 @@ from typing import Any, List, Optional
 import numpy as np
 
 from .. import config
+from .. import locksmith
 from ..error import SessionError
 from . import protocol
 
@@ -46,7 +47,7 @@ class ClientSession:
 
     def __init__(self, sock, lease_meta: dict, address: str):
         self._sock = sock
-        self._lock = threading.Lock()   # one RPC in flight per session
+        self._lock = locksmith.make_lock("session.rpc")   # one RPC in flight
         self.address = address
         self.tenant: str = lease_meta["tenant"]
         self.ranks: List[int] = list(lease_meta["ranks"])

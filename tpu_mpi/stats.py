@@ -41,6 +41,7 @@ def aggregate(records: Sequence[dict]) -> dict:
     batch = {"flushes": 0, "ops": 0}
     explore = {"calls": 0, "explored": 0, "table_swaps": 0,
                "last_swap_gen": 0}
+    locks: Dict[str, Dict[str, int]] = {}
     arm_counts: Dict[Tuple[str, str], int] = {}
     nranks = set()
     for rec in records:
@@ -66,6 +67,13 @@ def aggregate(records: Sequence[dict]) -> dict:
                         int(elastic["gauges"].get(g, 0)), int(gv))
             else:
                 elastic[k] = int(elastic.get(k, 0)) + int(v)
+        for name, row in (rec.get("locks") or {}).items():
+            ent = locks.setdefault(name, {"acquires": 0, "contended": 0,
+                                          "max_held_ns": 0})
+            ent["acquires"] += int((row or {}).get("acquires", 0))
+            ent["contended"] += int((row or {}).get("contended", 0))
+            ent["max_held_ns"] = max(ent["max_held_ns"],
+                                     int((row or {}).get("max_held_ns", 0)))
         for label, sig in (au.get("signatures") or {}).items():
             ent = auto["signatures"].setdefault(
                 label, {"calls": 0, "hits": 0, "demotions": 0,
@@ -131,6 +139,7 @@ def aggregate(records: Sequence[dict]) -> dict:
         "arm_counts": arm_counts,
         "infer": infer,
         "elastic": elastic,
+        "locks": locks,
     }
 
 
@@ -283,6 +292,15 @@ def render(agg: dict, out=None) -> None:
             w(f"  {g.get('kv_shared_blocks_max', 0)} shared blocks (peak), "
               f"{g.get('kv_prefix_entries_max', 0)} registry entries, "
               f"{g.get('kv_cow_forks', 0)} CoW forks\n")
+
+    lw = agg.get("locks") or {}
+    if lw:
+        w("\nlock contention (TPU_MPI_LOCKCHECK witness):\n")
+        w(f"  {'lock':<24} {'acquires':>9} {'contended':>10} "
+          f"{'max held':>10}\n")
+        for name, row in sorted(lw.items()):
+            w(f"  {name:<24} {row['acquires']:>9} {row['contended']:>10} "
+              f"{row['max_held_ns'] / 1e6:>8.2f}ms\n")
 
     ela = agg.get("elastic") or {}
     if ela.get("resizes") or ela.get("failures"):
