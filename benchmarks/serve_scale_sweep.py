@@ -19,10 +19,18 @@ three axes:
   scatter-gather lane vs the legacy marshal lane
   (``TPU_MPI_SERVE_ZEROCOPY=0``); the gate is copies/op <= 1 on the
   zero-copy lane, with the legacy before-number committed alongside.
+- **C10k front door** — the event-transport broker is stormed with
+  pipelined attaches (``serve.attach_many``) over a sessions x window
+  grid; ``front_door.open_sockets`` is read mid-hold to prove the herd
+  is truly concurrent, a sampled op burst and the DRR fairness window
+  run with the full herd attached, and teardown is a mass raw-close
+  (10k simultaneous hangups drained by the poll loop). Gates: >= 10k
+  concurrent sockets on one broker, pipelined attach above the old
+  ~900/s serial baseline, Jain >= 0.99 at scale.
 
 Run:
     python benchmarks/serve_scale_sweep.py [--tenants 8000] [--ops 2]
-        [--drivers 32] [--quick]
+        [--drivers 32] [--fd-sessions 10000] [--quick]
         [--json benchmarks/results/serve-scale-cpusim.json]
 
 ``--quick`` (the CI smoke) shrinks the tenant count and skips the
@@ -203,6 +211,97 @@ def bench_fairness(address, tenants: int, window_s: float,
             "total_ops": sum(counts)}
 
 
+def _broker_stats(address: str, token: str) -> dict:
+    """Lease-less STATS probe (same frame `tpurun --serve --stats` sends):
+    one connect, one STATS, one reply — the broker closes the socket, so
+    this never perturbs the attached herd it is measuring."""
+    from tpu_mpi.serve import protocol
+    sock = protocol.connect(address, timeout=30.0)
+    try:
+        protocol.send_frame(sock, protocol.STATS, {"token": token})
+        kind, meta, _ = protocol.recv_frame(sock)
+        if kind != protocol.STATS:
+            raise RuntimeError(f"stats probe got kind {kind}: {meta}")
+        return meta
+    finally:
+        sock.close()
+
+
+def bench_frontdoor(address: str, grid: list, token: str,
+                    fair_tenants: int, fair_window: float,
+                    sample_ops: int = 256) -> dict:
+    """The C10k lane: storm one event-transport broker with pipelined
+    attaches (serve.attach_many) at each (sessions, window) grid point,
+    read ``front_door.open_sockets`` MID-HOLD to prove the herd is truly
+    concurrent, and — with the largest herd still attached — drive a
+    sampled op burst plus the DRR fairness window. Teardown is raw socket
+    close (10k serial DETACH round trips would dominate the lane), which
+    doubles as a mass-hangup drain test on the event loop."""
+    from tpu_mpi import serve
+    x = np.ones(8, np.float32)
+
+    # serial-attach baseline: what the thread-per-connection front door
+    # gave us (one HELLO/LEASE round trip at a time)
+    n_base = 100
+    t0 = time.perf_counter()
+    for i in range(n_base):
+        serve.attach(address, tenant=f"base{i}", token=token).detach()
+    serial_attach_per_s = n_base / (time.perf_counter() - t0)
+
+    rows = []
+    last = len(grid) - 1
+    held = {}
+    for gi, (sessions, window) in enumerate(grid):
+        t0 = time.perf_counter()
+        herd = serve.attach_many(address, sessions, token=token,
+                                 window=window)
+        attach_wall = time.perf_counter() - t0
+        fd = _broker_stats(address, token).get("front_door") or {}
+        row = {"sessions": sessions, "window": window,
+               "attach_wall_s": attach_wall,
+               "attach_per_s": sessions / attach_wall,
+               "open_sockets": fd.get("open_sockets", 0),
+               "engine": fd.get("engine"),
+               "recv_lease_hit_rate": (fd.get("recv_lease") or {})
+               .get("hit_rate")}
+        if gi == last:
+            # ops still flow with the full herd attached: one op across a
+            # sample of the herd, driven by a small thread pool
+            sample = herd[:min(sample_ops, len(herd))]
+            t1 = time.perf_counter()
+            lat, errors = _drive(sample, 1, min(32, len(sample)), x)
+            assert not errors, errors[:3]
+            row["held_ops_per_s"] = len(lat) / (time.perf_counter() - t1)
+            row["held_op_latency"] = percentiles(lat)
+            held["fairness"] = bench_fairness(address, fair_tenants,
+                                              fair_window, token)
+        rows.append(row)
+        # raw-close teardown: mass EPOLLHUP, broker revokes every lease
+        t2 = time.perf_counter()
+        for s in herd:
+            try:
+                s._sock.close()
+            except OSError:
+                pass
+        deadline = time.perf_counter() + 120.0
+        open_after = None
+        while time.perf_counter() < deadline:
+            open_after = (_broker_stats(address, token)
+                          .get("front_door") or {}).get("open_sockets")
+            if not open_after or open_after <= 1:   # <= 1: the probe's own
+                break                               # socket counts itself
+            time.sleep(0.25)
+        row["drain_s"] = time.perf_counter() - t2
+        row["open_sockets_after_drain"] = open_after
+
+    return {"serial_attach_per_s": serial_attach_per_s,
+            "grid": rows,
+            "max_concurrent_sockets": max(r["open_sockets"] for r in rows),
+            "best_attach_per_s": max(r["attach_per_s"] for r in rows),
+            "jain_index": held["fairness"]["jain_index"],
+            "fairness_at_scale": held["fairness"]}
+
+
 def bench_copies(nranks: int, reps: int, token: str) -> dict:
     """The before/after for the zero-copy frame path: the same workload on
     the legacy marshal lane vs the sendmsg scatter-gather lane, copies/op
@@ -255,6 +354,8 @@ def main() -> int:
     ap.add_argument("--copy-reps", type=int, default=200)
     ap.add_argument("--rounds", type=int, default=2,
                     help="op-phase repeats per lane (best rate kept)")
+    ap.add_argument("--fd-sessions", type=int, default=10000,
+                    help="largest herd in the front-door C10k lane")
     ap.add_argument("--quick", action="store_true",
                     help="CI smoke: shrink the sweep, skip the speedup gate")
     ap.add_argument("--json", default=None)
@@ -265,6 +366,17 @@ def main() -> int:
         args.rounds = 1
         args.fair_window = min(args.fair_window, 1.0)
         args.copy_reps = min(args.copy_reps, 40)
+        args.fd_sessions = min(args.fd_sessions, 128)
+
+    # 10k concurrent client sockets need headroom over the usual 1024 soft
+    # cap; brokers are subprocesses and inherit the raised limit
+    try:
+        import resource
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < hard:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+    except (ImportError, ValueError, OSError):
+        pass
 
     from tpu_mpi import serve
     from tpu_mpi.serve.router import Router
@@ -292,6 +404,20 @@ def main() -> int:
     router.close()
     stop_brokers([p0, p1])
 
+    # -- lane C: C10k front door — pipelined attach storms, one broker -------
+    if args.quick:
+        fd_grid = [(args.fd_sessions, 64)]
+    else:
+        fd_grid = [(args.fd_sessions // 4, 256),
+                   (args.fd_sessions // 2, 512),
+                   (args.fd_sessions, 512)]
+    pf, af = spawn_broker(args.nranks, token,
+                          max(2048, args.fd_sessions + 256))
+    serve.attach(af, tenant="warmup", token=token).detach()
+    front_door = bench_frontdoor(af, fd_grid, token, args.fair_tenants,
+                                 args.fair_window)
+    stop_brokers([pf])
+
     copies = bench_copies(args.nranks, args.copy_reps, token)
     speedup = fleet["ops_per_s"] / single["ops_per_s"]
 
@@ -300,8 +426,18 @@ def main() -> int:
         "two_broker_speedup": speedup,
         "zerocopy_copies_per_op_max": 1.0,
         "zerocopy_copies_per_op": copies["zerocopy"]["copies_per_op"],
+        "front_door_sockets_min": 10000,
+        "front_door_sockets": front_door["max_concurrent_sockets"],
+        "front_door_attach_per_s_min": 900.0,
+        "front_door_attach_per_s": front_door["best_attach_per_s"],
+        "front_door_jain_min": 0.99,
+        "front_door_jain": front_door["jain_index"],
         "passed": (copies["zerocopy"]["copies_per_op"] <= 1.0
-                   and (args.quick or speedup >= 1.5)),
+                   and (args.quick or speedup >= 1.5)
+                   and (args.quick
+                        or (front_door["max_concurrent_sockets"] >= 10000
+                            and front_door["best_attach_per_s"] > 900.0
+                            and front_door["jain_index"] >= 0.99))),
     }
     result = {
         "benchmark": "serve-scale",
@@ -314,6 +450,7 @@ def main() -> int:
         "two_broker_router": fleet,
         "two_broker_speedup": speedup,
         "fairness": fairness,
+        "front_door": front_door,
         "copies": copies,
         "gate": gate,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -327,6 +464,14 @@ def main() -> int:
           f"({speedup:.2f}x)")
     print(f"DRR fairness      jain {fairness['jain_index']:.4f} over "
           f"{fairness['tenants']} tenants, {fairness['total_ops']} ops")
+    for r in front_door["grid"]:
+        print(f"front door        {r['sessions']:6d} sockets "
+              f"(held {r['open_sockets']:6d})   attach "
+              f"{r['attach_per_s']:8.1f}/s (window {r['window']})   "
+              f"drain {r['drain_s']:.1f}s")
+    print(f"front door        serial-attach baseline "
+          f"{front_door['serial_attach_per_s']:.1f}/s   jain@scale "
+          f"{front_door['jain_index']:.4f}")
     print(f"copies/op         legacy {copies['legacy']['copies_per_op']:.2f}"
           f" -> zerocopy {copies['zerocopy']['copies_per_op']:.2f}   "
           f"(zc {copies['zerocopy']['ops_per_s']:.0f} ops/s vs legacy "

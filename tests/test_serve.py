@@ -198,9 +198,12 @@ def test_socket_spec_classification():
 # Broker integration: one warm pool, real sessions over loopback
 # ---------------------------------------------------------------------------
 
-@pytest.fixture(scope="module")
-def broker():
-    b = serve.Broker(nranks=4, token="hunter2")
+# every broker contract below runs against BOTH session transports: the
+# event-driven front door (serve.frontdoor) and the legacy thread-per-
+# connection path — the serve protocol is transport-blind by contract
+@pytest.fixture(scope="module", params=["events", "threads"])
+def broker(request):
+    b = serve.Broker(nranks=4, token="hunter2", transport=request.param)
     b.run_in_thread()
     yield b
     b.close()

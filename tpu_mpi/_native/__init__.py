@@ -100,8 +100,96 @@ def load() -> ctypes.CDLL:
         lib.tm_stop.argtypes = [ctypes.c_void_p]
         lib.tm_destroy.restype = None
         lib.tm_destroy.argtypes = [ctypes.c_void_p]
+        # fd engine (serve front door): edge-triggered readiness over
+        # session sockets + the kernel splice byte pump
+        lib.tmfd_create.restype = ctypes.c_void_p
+        lib.tmfd_create.argtypes = []
+        lib.tmfd_add.restype = ctypes.c_int
+        lib.tmfd_add.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+        lib.tmfd_mod.restype = ctypes.c_int
+        lib.tmfd_mod.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+        lib.tmfd_del.restype = ctypes.c_int
+        lib.tmfd_del.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.tmfd_wait.restype = ctypes.c_int
+        lib.tmfd_wait.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_int),
+                                  ctypes.POINTER(ctypes.c_int),
+                                  ctypes.c_int, ctypes.c_int]
+        lib.tmfd_wake.restype = None
+        lib.tmfd_wake.argtypes = [ctypes.c_void_p]
+        lib.tmfd_destroy.restype = None
+        lib.tmfd_destroy.argtypes = [ctypes.c_void_p]
+        lib.tmfd_splice.restype = ctypes.c_longlong
+        lib.tmfd_splice.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                    ctypes.c_int, ctypes.c_longlong]
         _lib = lib
         return lib
+
+
+class NativeFdEngine:
+    """Edge-triggered readiness engine over an open fd population — the
+    serve front door's C10k substrate (tmfd_* in transport.cc). Same shape
+    as ``select.epoll`` so the two are drop-in interchangeable in
+    tpu_mpi/serve/frontdoor.py; registering an fd also flips it nonblocking
+    (ET + a blocking read would deadlock the loop).
+
+    Event bits in ``wait`` results: 1 = readable/hangup, 2 = writable.
+    A cross-thread :meth:`wake` surfaces as one ``(-1, 0)`` entry."""
+
+    _MAX_EVENTS = 512
+
+    def __init__(self):
+        self._lib = load()
+        self._h = self._lib.tmfd_create()
+        if not self._h:
+            raise NativeBuildError("tmfd_create failed (epoll/pipe error)")
+        self._fds = (ctypes.c_int * self._MAX_EVENTS)()
+        self._evs = (ctypes.c_int * self._MAX_EVENTS)()
+
+    def register(self, fd: int, want_write: bool = False) -> None:
+        if self._lib.tmfd_add(self._h, int(fd), 1 if want_write else 0) != 0:
+            raise OSError(f"tmfd_add({fd}) failed")
+
+    def modify(self, fd: int, want_write: bool) -> None:
+        if self._lib.tmfd_mod(self._h, int(fd), 1 if want_write else 0) != 0:
+            raise OSError(f"tmfd_mod({fd}) failed")
+
+    def unregister(self, fd: int) -> None:
+        self._lib.tmfd_del(self._h, int(fd))   # best effort: fd may be gone
+
+    def wait(self, timeout: float) -> list[tuple[int, int]]:
+        n = self._lib.tmfd_wait(self._h, self._fds, self._evs,
+                                self._MAX_EVENTS, int(timeout * 1000))
+        if n < 0:
+            raise OSError("tmfd_wait failed")
+        return [(self._fds[i], self._evs[i]) for i in range(n)]
+
+    def wake(self) -> None:
+        if self._h:
+            self._lib.tmfd_wake(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.tmfd_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def splice_fd(src_fd: int, dst_fd: int, pipe_rd: int, pipe_wr: int,
+              budget: int) -> int:
+    """Kernel splice byte pump (router splice mode): move up to ``budget``
+    bytes src -> dst through the caller's pipe. Returns bytes moved, 0 on
+    clean EOF, -1 when src would block; raises OSError on a hard error."""
+    rc = load().tmfd_splice(int(src_fd), int(dst_fd), int(pipe_rd),
+                            int(pipe_wr), int(budget))
+    if rc == -2:
+        raise OSError("tmfd_splice failed")
+    return int(rc)
 
 
 class NativeTransport:

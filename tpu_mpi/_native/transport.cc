@@ -22,6 +22,7 @@
 #include <netinet/tcp.h>
 #include <poll.h>
 #include <string.h>
+#include <sys/epoll.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
 #include <unistd.h>
@@ -795,9 +796,173 @@ class Transport {
   std::vector<Conn> conns_;
 };
 
+// ---------------------------------------------------------------------------
+// FdEngine: the serve tier's C10k front door (tmfd_* below).
+//
+// A second, independent consumer of this file's poll machinery: where
+// Transport multiplexes a FIXED set of rank peers, FdEngine watches an
+// arbitrary churning population of session sockets (attach/detach at
+// thousands per second) with edge-triggered epoll. It owns no buffers and
+// parses no frames — readiness events surface to Python, where the serve
+// front door (tpu_mpi/serve/frontdoor.py) runs the incremental frame parser
+// and worker pool. A self-pipe gives the Python loop a cross-thread wakeup
+// (close requests, deferred writability) without a timeout tick.
+struct FdEngine {
+  int epfd = -1;
+  int wake_rd = -1;
+  int wake_wr = -1;
+};
+
+static bool set_nonblock(int fd) {
+  int fl = ::fcntl(fd, F_GETFL, 0);
+  return fl >= 0 && ::fcntl(fd, F_SETFL, fl | O_NONBLOCK) == 0;
+}
+
 }  // namespace
 
 extern "C" {
+
+void* tmfd_create(void) {
+  auto* e = new FdEngine();
+  e->epfd = ::epoll_create1(EPOLL_CLOEXEC);
+  int p[2] = {-1, -1};
+  if (e->epfd < 0 || ::pipe(p) != 0) {
+    if (e->epfd >= 0) ::close(e->epfd);
+    delete e;
+    return nullptr;
+  }
+  e->wake_rd = p[0];
+  e->wake_wr = p[1];
+  set_nonblock(e->wake_rd);
+  set_nonblock(e->wake_wr);
+  // the wake pipe is level-triggered: a wakeup posted between epoll_wait
+  // calls must not be lost
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = e->wake_rd;
+  if (::epoll_ctl(e->epfd, EPOLL_CTL_ADD, e->wake_rd, &ev) != 0) {
+    ::close(e->epfd);
+    ::close(e->wake_rd);
+    ::close(e->wake_wr);
+    delete e;
+    return nullptr;
+  }
+  return e;
+}
+
+// Register a session socket: edge-triggered read/ hangup interest, and the
+// fd is flipped nonblocking here so callers cannot forget (ET + a blocking
+// read is a deadlock). events bit 1 adds EPOLLOUT interest (deferred-write
+// resume).
+int tmfd_add(void* h, int fd, int want_write) {
+  auto* e = static_cast<FdEngine*>(h);
+  if (!set_nonblock(fd)) return -1;
+  epoll_event ev{};
+  ev.events = EPOLLIN | EPOLLRDHUP | EPOLLET;
+  if (want_write) ev.events |= EPOLLOUT;
+  ev.data.fd = fd;
+  return ::epoll_ctl(e->epfd, EPOLL_CTL_ADD, fd, &ev) == 0 ? 0 : -1;
+}
+
+int tmfd_mod(void* h, int fd, int want_write) {
+  auto* e = static_cast<FdEngine*>(h);
+  epoll_event ev{};
+  ev.events = EPOLLIN | EPOLLRDHUP | EPOLLET;
+  if (want_write) ev.events |= EPOLLOUT;
+  ev.data.fd = fd;
+  return ::epoll_ctl(e->epfd, EPOLL_CTL_MOD, fd, &ev) == 0 ? 0 : -1;
+}
+
+int tmfd_del(void* h, int fd) {
+  auto* e = static_cast<FdEngine*>(h);
+  return ::epoll_ctl(e->epfd, EPOLL_CTL_DEL, fd, nullptr) == 0 ? 0 : -1;
+}
+
+// Block up to timeout_ms for readiness. Fills fds_out/events_out (capacity
+// max_events) and returns the count; the wake pipe is drained and reported
+// as fd -1 with events 0 so the caller can count wakeups without watching a
+// reserved fd. events_out bits: 1 = readable/hangup, 2 = writable.
+int tmfd_wait(void* h, int* fds_out, int* events_out, int max_events,
+              int timeout_ms) {
+  auto* e = static_cast<FdEngine*>(h);
+  if (max_events <= 0) return 0;
+  std::vector<epoll_event> evs(static_cast<size_t>(max_events));
+  int n = ::epoll_wait(e->epfd, evs.data(), max_events, timeout_ms);
+  if (n < 0) return errno == EINTR ? 0 : -1;
+  int out = 0;
+  for (int i = 0; i < n; i++) {
+    if (evs[i].data.fd == e->wake_rd) {
+      char sink[256];
+      while (::read(e->wake_rd, sink, sizeof sink) > 0) {
+      }
+      fds_out[out] = -1;
+      events_out[out++] = 0;
+      continue;
+    }
+    int bits = 0;
+    if (evs[i].events & (EPOLLIN | EPOLLRDHUP | EPOLLHUP | EPOLLERR)) bits |= 1;
+    if (evs[i].events & EPOLLOUT) bits |= 2;
+    fds_out[out] = evs[i].data.fd;
+    events_out[out++] = bits;
+  }
+  return out;
+}
+
+void tmfd_wake(void* h) {
+  auto* e = static_cast<FdEngine*>(h);
+  char b = 1;
+  ssize_t rc = ::write(e->wake_wr, &b, 1);
+  (void)rc;  // a full pipe already guarantees a pending wakeup
+}
+
+void tmfd_destroy(void* h) {
+  auto* e = static_cast<FdEngine*>(h);
+  if (e->epfd >= 0) ::close(e->epfd);
+  if (e->wake_rd >= 0) ::close(e->wake_rd);
+  if (e->wake_wr >= 0) ::close(e->wake_wr);
+  delete e;
+}
+
+// Kernel byte pump for the router's splice mode: move up to budget bytes
+// from src to dst through the caller's pipe (pipe_rd/pipe_wr) without the
+// bytes ever surfacing to userspace. src must be nonblocking. Returns
+// bytes moved (> 0), 0 on clean EOF at src, -1 when src has nothing to
+// read right now (EAGAIN), -2 on a hard error on either side. Bytes pulled
+// into the pipe are always fully drained to dst before returning (waiting
+// for dst writability if needed) so the pipe never retains data between
+// calls.
+long long tmfd_splice(int src, int dst, int pipe_rd, int pipe_wr,
+                      long long budget) {
+  long long moved = 0;
+  while (moved < budget) {
+    ssize_t in = ::splice(src, nullptr, pipe_wr, nullptr,
+                          static_cast<size_t>(budget - moved),
+                          SPLICE_F_MOVE | SPLICE_F_NONBLOCK);
+    if (in < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK)
+        return moved > 0 ? moved : -1;
+      return moved > 0 ? moved : -2;
+    }
+    if (in == 0) return moved;  // EOF (0 if nothing moved this call)
+    long long pending = in;
+    while (pending > 0) {
+      ssize_t out = ::splice(pipe_rd, nullptr, dst, nullptr,
+                             static_cast<size_t>(pending),
+                             SPLICE_F_MOVE | SPLICE_F_NONBLOCK);
+      if (out < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          pollfd p{dst, POLLOUT, 0};
+          if (::poll(&p, 1, 5000) <= 0) return -2;
+          continue;
+        }
+        return -2;
+      }
+      pending -= out;
+      moved += out;
+    }
+  }
+  return moved;
+}
 
 void* tm_create(int rank, int size) {
   auto* t = new Transport(rank, size);

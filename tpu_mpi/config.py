@@ -231,6 +231,22 @@ class Config:
     # router (clients need only its address); "redirect" answers HELLO
     # with the tenant's home broker so the data path goes direct.
     serve_router_mode: str = "splice"
+    # session transport at the broker's front door (docs/serving.md "Front
+    # door"): "events" multiplexes every attached session socket on one
+    # edge-triggered readiness loop with a fixed worker pool (idle sockets
+    # cost zero threads — the C10k path); "threads" is the legacy
+    # one-handler-thread-per-connection front door, kept for A/B and as
+    # the conservative fallback.
+    serve_transport: str = "events"
+    # size of the event-driven front door's worker pool: how many session
+    # frames can be in service at once (attaches, collectives waiting on
+    # the pool, stats probes). Sockets scale independently of this.
+    serve_workers: int = 8
+    # recv-lease window, bytes: inbound OP payloads at or under this size
+    # land zero-copy in a registered buffer recycled across frames (the
+    # inbound mirror of serve_zerocopy's sendmsg path); larger payloads
+    # fall back to a per-frame exact-size buffer (a lease miss, counted).
+    serve_lease_window: int = 1 << 16
     # inference engine (docs/serving.md "Inference engine"): per-request
     # latency SLO in milliseconds — a generation request whose deadline
     # expires before it finishes is EVICTED with a typed retriable
@@ -370,6 +386,9 @@ _ENV_MAP = {
     "serve_zerocopy": "TPU_MPI_SERVE_ZEROCOPY",
     "serve_router_socket": "TPU_MPI_SERVE_ROUTER_SOCKET",
     "serve_router_mode": "TPU_MPI_SERVE_ROUTER_MODE",
+    "serve_transport": "TPU_MPI_SERVE_TRANSPORT",
+    "serve_workers": "TPU_MPI_SERVE_WORKERS",
+    "serve_lease_window": "TPU_MPI_SERVE_LEASE_WINDOW",
     "infer_slo_ms": "TPU_MPI_INFER_SLO_MS",
     "infer_max_batch": "TPU_MPI_INFER_MAX_BATCH",
     "kv_block_tokens": "TPU_MPI_KV_BLOCK_TOKENS",

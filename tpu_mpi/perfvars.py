@@ -566,6 +566,43 @@ def serve_frame_snapshot() -> dict:
         return dict(_serve_frame)
 
 
+# -- front-door block (tpu_mpi.serve.frontdoor) ------------------------------
+#
+# Process-global like the serve_frame block: the event-driven session
+# transport multiplexes every attached socket on one readiness loop, so
+# per-comm attribution would smear loop mechanics over tenants. Counters
+# accumulate (attaches, wakeups, frames, lease_hits/lease_misses/
+# lease_drops, splice_bytes); gauges overwrite (open_sockets, workers,
+# workers_busy).
+
+_front_door: Dict[str, int] = {}
+_front_door_gauges: Dict[str, int] = {}
+
+
+def note_front_door(**counts: int) -> None:
+    """Accumulate front-door counters (attaches, wakeups, frames,
+    lease_hits, lease_misses, lease_drops, splice_bytes, ...)."""
+    with _store_lock:
+        for k, v in counts.items():
+            _front_door[k] = _front_door.get(k, 0) + int(v)
+
+
+def set_front_door_gauges(**vals: int) -> None:
+    """Overwrite front-door gauges (open_sockets, workers, workers_busy)."""
+    with _store_lock:
+        for k, v in vals.items():
+            _front_door_gauges[k] = int(v)
+
+
+def front_door_snapshot() -> dict:
+    """The front_door block of :func:`snapshot` (may be empty): accumulated
+    counters plus the latest gauges under ``"gauges"``."""
+    with _store_lock:
+        if not _front_door and not _front_door_gauges:
+            return {}
+        return {**_front_door, "gauges": dict(_front_door_gauges)}
+
+
 # -- lock-contention block (tpu_mpi.locksmith) -------------------------------
 #
 # Populated only when the lock witness is armed (TPU_MPI_LOCKCHECK=1):
@@ -685,6 +722,7 @@ def snapshot(rank: Optional[int] = None, reset: bool = False) -> dict:
             "comms": comms, "plan_cache": plans.stats(),
             "infer": infer_snapshot(), "elastic": elastic_snapshot(),
             "serve_frame": serve_frame_snapshot(),
+            "front_door": front_door_snapshot(),
             "locks": locks_snapshot()}
 
 
@@ -714,6 +752,8 @@ def reset() -> None:
         _elastic.clear()
         _elastic_gauges.clear()
         _serve_frame.clear()
+        _front_door.clear()
+        _front_door_gauges.clear()
         _locks.clear()
         _store_gen += 1
 

@@ -17,10 +17,11 @@ from .broker import Broker
 from .ledger import Ledger, POOL_TENANT
 from .protocol import Disconnect
 from .queueing import FairQueue
-from .session import ClientSession, SessionComm, attach
+from .session import ClientSession, SessionComm, attach, attach_many
 
 __all__ = ["Broker", "ClientSession", "SessionComm", "FairQueue", "Ledger",
-           "POOL_TENANT", "Disconnect", "attach", "current_session"]
+           "POOL_TENANT", "Disconnect", "attach", "attach_many",
+           "current_session"]
 
 # The session MPI.Init(session=...) attached on this process (one per
 # process, matching Init's once-per-rank contract). Finalize detaches it.

@@ -161,6 +161,12 @@ class _ThreadPool:
                 fn(rank)
             finally:
                 set_current_tenant(None)
+                # drop the task closure BEFORE blocking on the next get():
+                # a loop local that outlives its op pins the op's payload
+                # arrays — and with recv leases those alias registered
+                # buffers the front door wants to recycle (an idle pool
+                # would otherwise pin its last payload forever)
+                del item, fn
 
     # -- elastic membership --------------------------------------------------
     def healthy(self) -> List[int]:
@@ -1085,6 +1091,7 @@ class Broker:
                  max_inflight: int = 2, ns_span: int = 256,
                  infer=None, elastic=None,
                  backend: Optional[str] = None,
+                 transport: Optional[str] = None,
                  shard=None):
         cfg = config.load()
         self.token = cfg.session_token if token is None else token
@@ -1093,6 +1100,15 @@ class Broker:
         backend = (cfg.serve_backend if backend is None else backend) \
             or "threads"
         self.backend = backend
+        transport = (cfg.serve_transport if transport is None
+                     else transport) or "events"
+        if transport not in ("events", "threads"):
+            raise MPIError(
+                f"unknown serve transport {transport!r} "
+                f"(TPU_MPI_SERVE_TRANSPORT: 'events' or 'threads')",
+                code=_ec.ERR_ARG)
+        self.transport = transport
+        self.front_door = None         # FrontDoor when transport == "events"
         if not isinstance(shard, CidShard):
             shard = CidShard.parse(cfg.serve_shard if shard is None
                                    else shard)
@@ -1177,6 +1193,10 @@ class Broker:
             self.elastic.start()
         self._listener, self.address = protocol.listen(self._socket_spec)
         self._listener.settimeout(0.2)
+        if self.transport == "events":
+            from .frontdoor import FrontDoor
+            self.front_door = FrontDoor(self, self._listener)
+            self.front_door.start()
         d = threading.Thread(target=self._dispatch_loop,
                              name="serve-dispatch", daemon=True)
         d.start()
@@ -1184,6 +1204,11 @@ class Broker:
         self.started.set()
 
     def serve_forever(self) -> None:
+        if self.front_door is not None:
+            # events transport: this thread becomes the readiness loop;
+            # no per-connection threads are ever spawned
+            self.front_door.serve_forever()
+            return
         while not self._stop.is_set():
             try:
                 conn, _ = self._listener.accept()
@@ -1207,6 +1232,8 @@ class Broker:
 
     def close(self) -> None:
         self._stop.set()
+        if self.front_door is not None:
+            self.front_door.close()
         if self.elastic is not None:
             self.elastic.close()
         if self.sidecars is not None:
@@ -1244,6 +1271,7 @@ class Broker:
                 self._op_done(op)
                 continue
             self.pool.run_op(op, self._op_done)
+            del op      # don't pin the payload across the next blocking pop
 
     def _op_done(self, op: PoolOp) -> None:
         self.fq.complete(op)
@@ -1715,6 +1743,9 @@ class Broker:
         from ..overlap import plans
         return {"address": self.address, "pool": self.pool.info(),
                 "backend": self.pool.kind,
+                "transport": self.transport,
+                "front_door": (self.front_door.stats()
+                               if self.front_door is not None else None),
                 "shard": {"index": self.shard.index,
                           "count": self.shard.count,
                           "base": self.shard.base, "limit": self.shard.limit},

@@ -177,6 +177,18 @@ def send_frame(sock: socket.socket, kind: int,
                                   zc_bytes=zc_bytes)
 
 
+def decode_blob(raw, desc: Optional[dict]) -> np.ndarray:
+    """One received blob as a ``np.frombuffer`` VIEW over its receive
+    buffer (typed+shaped when the metadata describes it). Shared by the
+    blocking :func:`recv_frame` below and the event-driven front door's
+    incremental parser (serve.frontdoor) so both transports reconstruct
+    payloads identically."""
+    if desc is not None:
+        return (np.frombuffer(raw, dtype=np.dtype(desc["dtype"]))
+                .reshape(desc["shape"]))
+    return np.frombuffer(raw, dtype=np.uint8)
+
+
 def recv_frame(sock: socket.socket) -> tuple[int, dict, list]:
     """Receive one frame: (kind, meta, arrays). Raises Disconnect on EOF,
     SessionError on a corrupt stream. Each array is a ``np.frombuffer``
@@ -199,12 +211,7 @@ def recv_frame(sock: socket.socket) -> tuple[int, dict, list]:
             raise SessionError(f"session frame blob of {blen} bytes exceeds "
                                f"max_frame_bytes={max_blob}")
         raw = _recv_exact(sock, blen)
-        if i < len(descs):
-            d = descs[i]
-            arrays.append(np.frombuffer(raw, dtype=np.dtype(d["dtype"]))
-                          .reshape(d["shape"]))
-        else:
-            arrays.append(np.frombuffer(raw, dtype=np.uint8))
+        arrays.append(decode_blob(raw, descs[i] if i < len(descs) else None))
     return kind, meta, arrays
 
 
@@ -286,6 +293,12 @@ def connect(spec: str, timeout: float = 10.0) -> socket.socket:
     return s
 
 
+# Listen backlog: attach herds arrive in bursts (the front-door scale
+# lane dials thousands of sockets per second); a 64-entry backlog drops
+# SYNs under that load and the herd sees connection resets, not queueing.
+_BACKLOG = 1024
+
+
 def listen(spec: Optional[str]) -> tuple[socket.socket, str]:
     """Bind + listen on a serve socket spec (broker side). ``None``/"" picks
     a loopback TCP port. Returns (socket, canonical spec clients dial)."""
@@ -293,7 +306,7 @@ def listen(spec: Optional[str]) -> tuple[socket.socket, str]:
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         s.bind(("127.0.0.1", 0))
-        s.listen(64)
+        s.listen(_BACKLOG)
         return s, f"127.0.0.1:{s.getsockname()[1]}"
     kind, addr = parse_socket_addr(spec)
     if kind == "unix":
@@ -304,10 +317,10 @@ def listen(spec: Optional[str]) -> tuple[socket.socket, str]:
             pass
         s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         s.bind(addr)
-        s.listen(64)
+        s.listen(_BACKLOG)
         return s, addr
     s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     s.bind(addr)
-    s.listen(64)
+    s.listen(_BACKLOG)
     return s, f"{addr[0]}:{s.getsockname()[1]}"

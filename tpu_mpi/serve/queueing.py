@@ -196,3 +196,57 @@ class FairQueue:
                 "max_inflight": self.max_inflight,
                 "paused": self._paused,
             }
+
+
+class ReadyRing:
+    """The front door's ready-queue: connections with parsed frames
+    waiting for a worker, FIFO with membership dedup (an item is in the
+    ring at most once however many readiness events fire while it waits).
+    FIFO across connections is round-robin service at the connection
+    level — per-tenant byte fairness stays :class:`FairQueue`'s job at
+    admission, this ring only keeps one chatty socket from being enqueued
+    a thousand times ahead of everyone else.
+
+    Items need a writable ``queued`` attribute (the dedup bit, owned by
+    the ring). Any number of producers (the event loop, workers
+    re-enqueueing) and consumers (the worker pool) may call concurrently.
+    """
+
+    def __init__(self, name: str = "frontdoor.ready"):
+        self._lock = locksmith.make_lock(name)
+        self._cond = locksmith.make_condition(name, self._lock)
+        self._ring: deque = deque()
+        self._closed = False
+
+    def push(self, item: Any) -> bool:
+        """Enqueue unless already queued; returns True when enqueued."""
+        with self._lock:
+            if self._closed or item.queued:
+                return False
+            item.queued = True
+            self._ring.append(item)
+            self._cond.notify()
+            return True
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[Any]:
+        """Next connection, blocking up to ``timeout``; None on timeout or
+        close. The popped item's ``queued`` bit is cleared — a readiness
+        event landing while a worker holds it re-enqueues it afresh."""
+        with self._lock:
+            while not self._ring:
+                if self._closed:
+                    return None
+                if not self._cond.wait(timeout):
+                    return None
+            item = self._ring.popleft()
+            item.queued = False
+            return item
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._cond.notify_all()

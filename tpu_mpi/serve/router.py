@@ -29,8 +29,11 @@ from __future__ import annotations
 
 import hashlib
 import itertools
+import os
+import select
 import socket
 import threading
+import time
 from typing import Dict, List, Optional
 
 from .. import config
@@ -239,30 +242,113 @@ class Router:
             except OSError:
                 pass
 
+    # grace for the surviving direction once one side has sent EOF: the
+    # broker answers its own EOF promptly, so this only bounds a wedged peer
+    _HALF_CLOSE_GRACE = 30.0
+
     @staticmethod
     def _splice(a: socket.socket, b: socket.socket) -> None:
-        """Pump raw bytes both ways until either side closes: past the
-        HELLO the router adds no framing, no copies beyond the kernel's,
-        and no per-op latency — the session runs at broker speed."""
-        def pump(src, dst, done):
-            try:
-                while True:
-                    chunk = src.recv(1 << 16)
-                    if not chunk:
-                        break
-                    dst.sendall(chunk)
-            except OSError:
-                pass
-            finally:
-                done.set()
-                for s in (src, dst):
+        """Pump raw bytes both ways until BOTH sides finish: past the
+        HELLO the router adds no framing and — on the native path — no
+        userspace copies at all: each direction is a splice(2) byte pump
+        through its own kernel pipe (socket → pipe → socket, transport.cc
+        ``tmfd_splice``), with a plain recv/send pump as the portable
+        fallback. Half-close is honored: one peer's EOF shuts down only
+        the write side it feeds (``shutdown(SHUT_WR)`` on the opposite
+        socket) and the reverse direction keeps flowing until its own
+        EOF — a client done sending can still drain in-flight replies.
+        Runs entirely on the calling handler thread: no pump threads to
+        leak, one select loop owns both directions."""
+        try:
+            from .._native import splice_fd, load as _load_native
+            _load_native()             # probe now: no native lib, no splice
+        except Exception:
+            splice_fd = None
+
+        class _Dir:
+            __slots__ = ("src", "dst", "pipe", "native", "open")
+
+            def __init__(self, src, dst):
+                self.src, self.dst = src, dst
+                self.pipe = None
+                self.native = splice_fd is not None
+                if self.native:
                     try:
-                        s.shutdown(socket.SHUT_RDWR)
+                        self.pipe = os.pipe()
                     except OSError:
-                        pass
-        done = threading.Event()
-        t = threading.Thread(target=pump, args=(b, a, done),
-                             name="serve-splice", daemon=True)
-        t.start()
-        pump(a, b, done)
-        done.wait(timeout=5.0)
+                        self.native = False
+                self.open = True
+
+        def _py_pump(d) -> bool:
+            """One fallback pump slice; False = this direction is done."""
+            try:
+                chunk = d.src.recv(1 << 16)
+            except (BlockingIOError, InterruptedError):
+                return True
+            except OSError:
+                return False
+            if not chunk:
+                return False
+            view = memoryview(chunk)
+            deadline = time.monotonic() + Router._HALF_CLOSE_GRACE
+            while view.nbytes:
+                try:
+                    view = view[d.dst.send(view):]
+                except (BlockingIOError, InterruptedError):
+                    if not select.select([], [d.dst], [], 1.0)[1] \
+                            and time.monotonic() > deadline:
+                        return False    # peer stopped draining: give up
+                except OSError:
+                    return False
+            return True
+
+        def _pump(d) -> bool:
+            if d.native:
+                try:
+                    moved = splice_fd(d.src.fileno(), d.dst.fileno(),
+                                      d.pipe[0], d.pipe[1], 1 << 20)
+                except OSError:
+                    # EINVAL and friends: this fd pair can't splice —
+                    # demote the direction to the userspace pump
+                    d.native = False
+                    return _py_pump(d)
+                return moved != 0       # 0 = EOF; >0 moved; -1 = EAGAIN
+            return _py_pump(d)
+
+        dirs = [_Dir(a, b), _Dir(b, a)]
+        for s in (a, b):
+            s.setblocking(False)
+        first_eof = None
+        try:
+            while any(d.open for d in dirs):
+                rds = [d.src for d in dirs if d.open]
+                try:
+                    ready = select.select(rds, [], [], 1.0)[0]
+                except (OSError, ValueError):
+                    break               # a socket died out from under us
+                for d in dirs:
+                    if d.open and d.src in ready and not _pump(d):
+                        d.open = False
+                        try:            # propagate EOF, read side stays up
+                            d.dst.shutdown(socket.SHUT_WR)
+                        except OSError:
+                            pass
+                if any(d.open for d in dirs) != all(d.open for d in dirs):
+                    if first_eof is None:
+                        first_eof = time.monotonic()
+                    elif time.monotonic() - first_eof > \
+                            Router._HALF_CLOSE_GRACE:
+                        break           # lame-duck half: bounded wait
+        finally:
+            for d in dirs:
+                if d.pipe is not None:
+                    for fd in d.pipe:
+                        try:
+                            os.close(fd)
+                        except OSError:
+                            pass
+            for s in (a, b):
+                try:
+                    s.close()
+                except OSError:
+                    pass

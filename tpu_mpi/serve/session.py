@@ -64,8 +64,17 @@ class ClientSession:
         with self._lock:
             if self._closed:
                 raise SessionError("session is detached")
-            protocol.send_frame(self._sock, kind, meta, arrays)
-            rkind, rmeta, rarrays = protocol.recv_frame(self._sock)
+            try:
+                protocol.send_frame(self._sock, kind, meta, arrays)
+                rkind, rmeta, rarrays = protocol.recv_frame(self._sock)
+            except protocol.Disconnect as e:
+                # a vanished broker surfaces as the TYPED session error at
+                # the API boundary, and the session knows it is dead — the
+                # next call fails fast instead of writing to a corpse
+                self._closed = True
+                raise SessionError(
+                    f"broker at {self.address} hung up mid-session: "
+                    f"{e}") from None
         if rkind == protocol.ERROR:
             protocol.raise_for_error(rmeta)
         return rkind, rmeta, rarrays
@@ -119,7 +128,13 @@ class ClientSession:
                                  "max_new": int(max_new)}, [arr])
             tokens: List[int] = []
             while True:
-                rkind, rmeta, _ = protocol.recv_frame(self._sock)
+                try:
+                    rkind, rmeta, _ = protocol.recv_frame(self._sock)
+                except protocol.Disconnect as e:
+                    self._closed = True
+                    raise SessionError(
+                        f"broker at {self.address} hung up mid-stream: "
+                        f"{e}") from None
                 if rkind == protocol.ERROR:
                     protocol.raise_for_error(rmeta)
                 if rkind != protocol.RESULT:
@@ -242,3 +257,73 @@ def attach(address: Optional[str] = None, *, token: Optional[str] = None,
         return ClientSession(sock, meta, address)
     raise SessionError(f"attach followed a REDIRECT to {address} and was "
                        f"redirected again — router loop?")
+
+
+def attach_many(address: str, tenants: int, *, token: Optional[str] = None,
+                nranks: Optional[int] = None, timeout: float = 120.0,
+                window: int = 512) -> List[ClientSession]:
+    """Attach ``tenants`` sessions to one broker with a pipelined handshake.
+
+    :func:`attach` is one serial HELLO/LEASE round trip per call, so the
+    attach rate of a herd is capped by latency.  Here up to ``window``
+    handshakes are in flight at once: connect + HELLO are fired ahead and
+    LEASE replies are drained FIFO, which is what the connection-count
+    scaling lane (benchmarks/serve_scale_sweep.py) uses to storm a broker.
+    The address must be the broker itself — REDIRECT answers (a router in
+    redirect mode) are a :class:`~tpu_mpi.error.SessionError` here."""
+    from collections import deque
+
+    cfg = config.load()
+    token = cfg.session_token if token is None else token
+    hello: dict = {"token": token}
+    if nranks is not None:
+        hello["nranks"] = int(nranks)
+
+    sessions: List[ClientSession] = []
+    pending: "deque" = deque()               # sockets with HELLO sent
+
+    def _drain_one() -> None:
+        sock = pending.popleft()
+        try:
+            kind, meta, _ = protocol.recv_frame(sock)
+        except protocol.Disconnect as e:
+            sock.close()
+            raise SessionError(f"broker at {address} hung up during "
+                               f"pipelined attach: {e}") from None
+        if kind == protocol.ERROR:
+            sock.close()
+            protocol.raise_for_error(meta)
+        if kind != protocol.LEASE:
+            sock.close()
+            raise SessionError(f"pipelined attach expected LEASE, got "
+                               f"{protocol.KIND_NAMES.get(kind, kind)}")
+        sessions.append(ClientSession(sock, meta, address))
+
+    try:
+        for _ in range(int(tenants)):
+            sock = protocol.connect(address, timeout=timeout)
+            try:
+                protocol.send_frame(sock, protocol.HELLO, hello)
+            except (protocol.Disconnect, OSError) as e:
+                sock.close()
+                raise SessionError(f"broker at {address} refused a "
+                                   f"pipelined HELLO: {e}") from None
+            pending.append(sock)
+            while len(pending) >= max(1, int(window)):
+                _drain_one()
+        while pending:
+            _drain_one()
+    except BaseException:
+        for sock in pending:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for s in sessions:
+            try:
+                s._sock.close()
+                s._closed = True
+            except OSError:
+                pass
+        raise
+    return sessions

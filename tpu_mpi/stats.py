@@ -38,6 +38,7 @@ def aggregate(records: Sequence[dict]) -> dict:
             "evictions": 0, "signatures": {}}
     infer: Dict[str, Any] = {"gauges": {}}
     elastic: Dict[str, Any] = {"gauges": {}}
+    front: Dict[str, Any] = {"gauges": {}}
     batch = {"flushes": 0, "ops": 0}
     explore = {"calls": 0, "explored": 0, "table_swaps": 0,
                "last_swap_gen": 0}
@@ -67,6 +68,13 @@ def aggregate(records: Sequence[dict]) -> dict:
                         int(elastic["gauges"].get(g, 0)), int(gv))
             else:
                 elastic[k] = int(elastic.get(k, 0)) + int(v)
+        for k, v in (rec.get("front_door") or {}).items():
+            if k == "gauges":
+                for g, gv in (v or {}).items():
+                    front["gauges"][g] = max(int(front["gauges"].get(g, 0)),
+                                             int(gv))
+            else:
+                front[k] = int(front.get(k, 0)) + int(v)
         for name, row in (rec.get("locks") or {}).items():
             ent = locks.setdefault(name, {"acquires": 0, "contended": 0,
                                           "max_held_ns": 0})
@@ -139,6 +147,7 @@ def aggregate(records: Sequence[dict]) -> dict:
         "arm_counts": arm_counts,
         "infer": infer,
         "elastic": elastic,
+        "front_door": front,
         "locks": locks,
     }
 
@@ -301,6 +310,21 @@ def render(agg: dict, out=None) -> None:
         for name, row in sorted(lw.items()):
             w(f"  {name:<24} {row['acquires']:>9} {row['contended']:>10} "
               f"{row['max_held_ns'] / 1e6:>8.2f}ms\n")
+
+    fd = agg.get("front_door") or {}
+    if fd.get("attaches") or (fd.get("gauges") or {}).get("open_sockets"):
+        g = fd.get("gauges") or {}
+        leases = fd.get("lease_hits", 0) + fd.get("lease_misses", 0)
+        w(f"\nfront door (event transport): {fd.get('attaches', 0)} "
+          f"attaches, {g.get('open_sockets', 0)} sockets open (peak), "
+          f"{fd.get('wakeups', 0)} loop wakeups, "
+          f"{fd.get('frames', 0)} frames\n")
+        w(f"  worker pool: {g.get('workers_busy', 0)}/"
+          f"{g.get('workers', 0)} busy (peak)\n")
+        if leases:
+            w(f"  recv leases: {fd.get('lease_hits', 0)}/{leases} pooled "
+              f"({fd.get('lease_hits', 0) / leases:.0%} hit rate), "
+              f"{fd.get('lease_drops', 0)} drops\n")
 
     ela = agg.get("elastic") or {}
     if ela.get("resizes") or ela.get("failures"):
