@@ -18,6 +18,7 @@ Layout mirrors the subsystem:
   through a resize gate, re-asserted on the event-driven transport.
 """
 
+import json
 import os
 import signal
 import socket
@@ -206,6 +207,124 @@ def test_corrupt_stream_closes_connection_without_wedging(events_broker):
         s.detach()
 
 
+def _raw_frame(kind, payload: bytes, blobs=()):
+    parts = [protocol._HDR.pack(kind, len(payload), len(blobs)), payload]
+    for b in blobs:
+        parts.append(protocol._BLOB.pack(len(b)))
+        parts.append(b)
+    return b"".join(parts)
+
+
+def _expect_peer_close(sock):
+    sock.settimeout(5.0)
+    try:
+        assert sock.recv(1) == b""      # peer closed, no reply, no hang
+    except ConnectionResetError:
+        pass                            # unread junk in flight → RST: fine
+    finally:
+        sock.close()
+
+
+def _assert_service_alive(broker, tenant):
+    s = _attach(broker, tenant=tenant)
+    try:
+        assert np.allclose(s.allreduce(np.ones(4, np.float32)), 4.0)
+    finally:
+        s.detach()
+
+
+def test_malformed_json_meta_kills_only_that_connection(events_broker):
+    """A frame whose metadata section is not JSON must cost that client its
+    connection — not the loop thread (which serves every session)."""
+    sock = protocol.connect(events_broker.address)
+    sock.sendall(_raw_frame(protocol.HELLO, b"{not json"))
+    _expect_peer_close(sock)
+    _assert_service_alive(events_broker, "after-bad-json")
+
+
+def test_non_object_json_meta_kills_only_that_connection(events_broker):
+    sock = protocol.connect(events_broker.address)
+    sock.sendall(_raw_frame(protocol.HELLO, b"[1,2,3]"))  # valid JSON, wrong
+    _expect_peer_close(sock)                              # shape for meta
+    _assert_service_alive(events_broker, "after-array-meta")
+
+
+def test_hostile_blob_desc_kills_only_that_connection(events_broker):
+    """A blob descriptor with a bad dtype / mismatched shape blows up
+    decode_blob on the loop thread — it must be treated as a corrupt
+    stream, never escape and kill the event loop."""
+    for desc in ({"dtype": "not-a-dtype", "shape": [8]},
+                 {"dtype": "<f4", "shape": [3]},      # 12B shape, 8B blob
+                 {"dtype": "<f4"}):                   # missing "shape"
+        meta = json.dumps({"blobs": [desc]}).encode()
+        sock = protocol.connect(events_broker.address)
+        sock.sendall(_raw_frame(protocol.OP, meta, blobs=[b"\x00" * 8]))
+        _expect_peer_close(sock)
+    # a non-dict desc is tolerated as an undescribed raw blob: the frame
+    # parses and the pre-attach grammar rejects it in-protocol
+    meta = json.dumps({"blobs": ["not-a-dict"]}).encode()
+    sock = protocol.connect(events_broker.address)
+    sock.sendall(_raw_frame(protocol.OP, meta, blobs=[b"\x00" * 8]))
+    kind, _, _ = protocol.recv_frame(sock)
+    assert kind == protocol.ERROR
+    sock.close()
+    _assert_service_alive(events_broker, "after-bad-desc")
+
+
+def test_non_numeric_hello_fields_fail_typed(events_broker):
+    """nranks="x" in HELLO used to raise ValueError past the MPIError-only
+    catch and kill a pool worker; it must come back as a typed ERROR."""
+    sock = protocol.connect(events_broker.address)
+    protocol.send_frame(sock, protocol.HELLO,
+                        {"token": "hunter2", "tenant": "weird",
+                         "nranks": "x"})
+    kind, meta, _ = protocol.recv_frame(sock)
+    assert kind == protocol.ERROR, meta
+    sock.close()
+    _assert_service_alive(events_broker, "after-bad-hello")
+
+
+def test_malformed_op_frames_cannot_exhaust_the_worker_pool(events_broker):
+    """cid="x" in an OP raises ValueError out of _admit_and_run; each such
+    frame must cost one connection, not one pool worker. Send more of them
+    than there are workers — service must still be up afterwards."""
+    nworkers = events_broker.front_door.nworkers
+    for i in range(nworkers + 2):
+        sock = protocol.connect(events_broker.address)
+        protocol.send_frame(sock, protocol.HELLO,
+                            {"token": "hunter2", "tenant": f"badcid-{i}"})
+        kind, _, _ = protocol.recv_frame(sock)
+        assert kind == protocol.LEASE
+        protocol.send_frame(sock, protocol.OP, {"op": "barrier", "cid": "x"})
+        _expect_peer_close(sock)
+    _assert_service_alive(events_broker, "after-bad-cid")
+    # the torn-down leases were revoked, not leaked
+    attached = events_broker.stats()["tenants_attached"]
+    assert not [t for t in attached if t.startswith("badcid-")], attached
+
+
+def test_frame_backlog_pauses_and_resumes(events_broker):
+    """A client pipelining frames faster than service must be bounded by
+    the per-connection high-water mark — and the pause must resume once
+    workers drain the backlog (every pipelined frame still gets served)."""
+    from tpu_mpi.serve.frontdoor import _FRAME_HWM
+    n = _FRAME_HWM * 3                  # well past the mark in one burst
+    sock = protocol.connect(events_broker.address)
+    protocol.send_frame(sock, protocol.HELLO,
+                        {"token": "hunter2", "tenant": "pipeliner"})
+    kind, _, _ = protocol.recv_frame(sock)
+    assert kind == protocol.LEASE
+    sock.sendall(_raw_frame(protocol.PING, b"{}") * n)
+    sock.settimeout(30.0)
+    for _ in range(n):                  # hang here = resume is broken
+        kind, _, _ = protocol.recv_frame(sock)
+        assert kind == protocol.PONG
+    protocol.send_frame(sock, protocol.DETACH, {})
+    kind, _, _ = protocol.recv_frame(sock)
+    assert kind == protocol.BYE
+    sock.close()
+
+
 def test_abrupt_disconnect_revokes_lease(events_broker):
     sock = protocol.connect(events_broker.address)
     protocol.send_frame(sock, protocol.HELLO,
@@ -298,6 +417,42 @@ def test_half_close_drains_reply_and_leaks_no_pump_threads():
     finally:
         router.close()
         b.close()
+
+
+def test_half_close_grace_bounds_idleness_not_drain_time(monkeypatch):
+    """Once one direction EOFs, the grace timer re-arms on activity in the
+    surviving direction: a reply stream still moving bytes past the grace
+    window must never be cut off mid-drain (the grace bounds a peer that
+    went silent, not the total half-open lifetime)."""
+    monkeypatch.setattr(Router, "_HALF_CLOSE_GRACE", 1.0)
+    client, a = socket.socketpair()
+    b, server = socket.socketpair()
+    th = threading.Thread(target=Router._splice, args=(a, b), daemon=True)
+    th.start()
+    payload = b"x" * 1024
+    rounds = 8                          # 2s of trickle: 2x the grace window
+    got = bytearray()
+    try:
+        client.shutdown(socket.SHUT_WR)  # client done sending; reply flows
+        for _ in range(rounds):
+            server.sendall(payload)
+            time.sleep(0.25)
+        server.close()
+        client.settimeout(10.0)
+        while True:
+            try:
+                chunk = client.recv(1 << 16)
+            except ConnectionResetError:
+                break
+            if not chunk:
+                break
+            got.extend(chunk)
+    finally:
+        client.close()
+        server.close()
+        th.join(timeout=10)
+    assert not th.is_alive()
+    assert len(got) == rounds * len(payload), len(got)
 
 
 # ---------------------------------------------------------------------------

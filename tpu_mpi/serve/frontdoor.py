@@ -278,6 +278,12 @@ class _SendSock:
 # parser stages
 _S_HDR, _S_JSON, _S_BLOBLEN, _S_BLOB = range(4)
 
+# per-connection parsed-frame high-water mark: the loop stops feeding a
+# connection's parser past this backlog (the legacy thread had natural
+# one-frame-at-a-time backpressure; this bounds a pipelining client to a
+# fixed number of in-memory frames) and resumes when workers drain below it
+_FRAME_HWM = 32
+
 
 class _Conn:
     """One attached (or attaching) session socket: the incremental frame
@@ -287,7 +293,8 @@ class _Conn:
     ``frames`` and the service bits (under ``lock``)."""
 
     __slots__ = ("sock", "fd", "door", "frames", "lock", "queued", "busy",
-                 "closed", "dead_read", "lease", "proxy", "accepted_at",
+                 "closed", "dead_read", "paused", "lease", "proxy",
+                 "accepted_at",
                  "_stage", "_want", "_got", "_buf", "_view", "_kind",
                  "_json_len", "_nblobs", "_meta", "_blobs", "_bufs",
                  "_blob_i")
@@ -302,6 +309,7 @@ class _Conn:
         self.busy = False              # a worker is servicing this conn
         self.closed = False
         self.dead_read = False         # EOF/corrupt: stop feeding the parser
+        self.paused = False            # frame backlog >= _FRAME_HWM
         self.lease = None              # set after a successful attach
         self.proxy = _SendSock(door, self)
         self.accepted_at = time.monotonic()
@@ -326,9 +334,18 @@ class _Conn:
         """Drain the socket (edge-triggered: read to EAGAIN), advancing the
         parser; complete frames land in ``self.frames``. Returns the number
         of frames produced. Raises ``protocol.Disconnect`` on EOF and
-        ``SessionError`` on a corrupt stream."""
+        ``SessionError`` on a corrupt stream. Stops early (``paused`` set,
+        under ``lock``) once the parsed backlog hits ``_FRAME_HWM`` — the
+        resume pump in ``FrontDoor._release`` restarts it when workers
+        drain below the mark, so a pipelining client holds at most a
+        bounded number of frames in memory."""
         produced = 0
         while True:
+            if len(self.frames) >= _FRAME_HWM:
+                with self.lock:        # recheck: workers drain concurrently
+                    if len(self.frames) >= _FRAME_HWM:
+                        self.paused = True
+                        return produced
             if self._got < self._want:
                 try:
                     n = self.sock.recv_into(self._view[self._got:self._want])
@@ -362,7 +379,15 @@ class _Conn:
             self._meta = {}
             return self._after_meta()
         if self._stage == _S_JSON:
-            self._meta = json.loads(bytes(self._buf).decode())
+            try:
+                meta = json.loads(bytes(self._buf).decode())
+            except (ValueError, UnicodeDecodeError) as e:
+                raise SessionError(
+                    f"malformed session frame metadata: {e}") from None
+            if not isinstance(meta, dict):
+                raise SessionError("session frame metadata must be a JSON "
+                                   f"object, got {type(meta).__name__}")
+            self._meta = meta
             return self._after_meta()
         if self._stage == _S_BLOBLEN:
             (blen,) = protocol._BLOB.unpack(self._buf)
@@ -378,8 +403,17 @@ class _Conn:
         # _S_BLOB complete: wrap the filled prefix of the lease buffer
         descs = self._meta.get("blobs") or []
         raw = self._view[:self._want]
-        self._blobs.append(protocol.decode_blob(
-            raw, descs[self._blob_i] if self._blob_i < len(descs) else None))
+        desc = descs[self._blob_i] \
+            if isinstance(descs, list) and self._blob_i < len(descs) else None
+        try:
+            blob = protocol.decode_blob(raw, desc if isinstance(desc, dict)
+                                        else None)
+        except Exception as e:
+            # hostile desc (bad dtype string, shape/size mismatch, missing
+            # keys): the client's problem, never the loop thread's
+            raise SessionError(
+                f"malformed session frame blob descriptor: {e}") from None
+        self._blobs.append(blob)
         self._blob_i += 1
         return self._next_blob_or_finish()
 
@@ -427,6 +461,7 @@ class FrontDoor:
         self._conns: Dict[int, _Conn] = {}
         self._conns_lock = locksmith.make_lock("frontdoor.conns")
         self._ready = ReadyRing()
+        self._resume: deque = deque()  # paused conns to re-pump (loop drains)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._busy = 0                 # lock: guard frontdoor.conns
@@ -465,8 +500,14 @@ class FrontDoor:
                     self._accept_burst()
                     continue
                 conn = self._conns.get(fd)
-                if conn is None or conn.dead_read:
+                if conn is None:
                     continue
+                self._pump(conn)
+            while True:
+                try:                   # conns workers un-paused since last
+                    conn = self._resume.popleft()   # wait (deque is atomic)
+                except IndexError:
+                    break
                 self._pump(conn)
             now = time.monotonic()
             if now - self._last_mirror >= 1.0:
@@ -512,9 +553,16 @@ class FrontDoor:
             self._pump(conn)
 
     def _pump(self, conn: _Conn) -> None:
+        if conn.dead_read or conn.closed or conn.paused:
+            return
         try:
             produced = conn.feed()
-        except (protocol.Disconnect, SessionError, MPIError):
+        except Exception:
+            # Disconnect/SessionError are the expected stream endings, but
+            # a hostile frame can blow up the decode itself in ways no
+            # enumeration will ever be complete against — and ANY escape
+            # here kills the single loop thread for every attached session.
+            # Every flavor means the same thing: this stream is done.
             conn.dead_read = True
             conn.frames.append(self._EOF)
             produced = 1
@@ -537,20 +585,52 @@ class FrontDoor:
                 self._busy += 1
             try:
                 streaming = self._service(conn, frame)
+            except Exception:
+                # backstop: _service already maps failures to connection
+                # teardown, but a bug (or an exception from the teardown
+                # itself) escaping here would kill the pool worker and
+                # wedge the conn with busy=True forever — absorb it, drop
+                # the one connection, keep the worker.
+                streaming = False
+                self._drop_conn(conn)
             finally:
                 with self._conns_lock:
                     self._busy -= 1
             if not streaming:
                 self._release(conn)
 
+    def _drop_conn(self, conn: _Conn) -> None:
+        """Best-effort teardown of one session (lease revoked, fd closed);
+        never raises — the callers are keep-running paths."""
+        if conn.lease is not None:
+            try:
+                self.broker.revoke_lease(conn.lease, "connection lost",
+                                         close_conn=False)
+            except Exception:
+                pass
+        try:
+            self._close_conn(conn)
+        except Exception:
+            pass
+
     def _release(self, conn: _Conn) -> None:
-        """End of one service slice: clear the per-connection busy bit and
-        re-enqueue when frames are already waiting."""
+        """End of one service slice: clear the per-connection busy bit,
+        re-enqueue when frames are already waiting, and un-pause the read
+        side once the backlog has drained below the high-water mark (the
+        loop thread owns the parser, so resuming is a handoff: queue the
+        conn and wake the loop)."""
         with conn.lock:
             conn.busy = False
             more = bool(conn.frames) and not conn.closed
+            resume = (conn.paused and not conn.closed
+                      and len(conn.frames) < _FRAME_HWM)
+            if resume:
+                conn.paused = False
         if more:
             self._ready.push(conn)
+        if resume:
+            self._resume.append(conn)
+            self._engine.wake()
 
     def _finish_frame(self, frame: list) -> None:
         """Consume a frame exactly once: null the payload slots in place
@@ -610,11 +690,12 @@ class FrontDoor:
                 return True            # the stream thread releases busy
             broker._serve_op(lease, meta, frame[2])
             return False
-        except (protocol.Disconnect, SessionError, OSError):
-            if conn.lease is not None:
-                broker.revoke_lease(conn.lease, "connection lost",
-                                    close_conn=False)
-            self._close_conn(conn)
+        except Exception:
+            # the legacy thread's teardown semantics, exactly: Disconnect/
+            # SessionError/OSError are the expected endings, and any other
+            # client-triggered exception (non-numeric cid/nranks, etc.)
+            # costs that client its connection — never a pool worker.
+            self._drop_conn(conn)
             return False
         finally:
             if not handed_off:
@@ -643,9 +724,14 @@ class FrontDoor:
         t0 = time.perf_counter()
         try:
             lease = broker.attach_tenant(conn.proxy, meta)
-        except MPIError as e:
+        except Exception as e:
+            # typed MPIErrors cross the wire as-is; anything else a hostile
+            # HELLO can trigger (non-numeric nranks, bad field types) is
+            # the client's malformed request, reported as such
+            err = e if isinstance(e, MPIError) else SessionError(
+                f"malformed HELLO: {type(e).__name__}: {e}")
             protocol.send_frame(conn.proxy, protocol.ERROR,
-                                protocol.error_meta(e))
+                                protocol.error_meta(err))
             self._close_conn(conn)
             return
         attach_us = (time.perf_counter() - t0) * 1e6
@@ -665,11 +751,8 @@ class FrontDoor:
         here scale with concurrent *streams*, not with attached sockets."""
         try:
             self.broker._serve_generate(lease, frame[1], frame[2])
-        except (protocol.Disconnect, SessionError, OSError):
-            if conn.lease is not None:
-                self.broker.revoke_lease(conn.lease, "connection lost",
-                                         close_conn=False)
-            self._close_conn(conn)
+        except Exception:
+            self._drop_conn(conn)
         finally:
             self._finish_frame(frame)
             self._release(conn)
@@ -709,11 +792,17 @@ class FrontDoor:
                       "lease_drops": lp["drops"]}
             open_sockets = len(self._conns)
             busy = self._busy
-        deltas = {k: v - self._mirrored.get(k, 0) for k, v in counts.items()}
-        deltas = {k: v for k, v in deltas.items() if v}
+            # delta-vs-mirror and the mirror update must be one atomic
+            # step: this runs on the loop thread AND on worker threads
+            # (stats() -> broker.stats()), and two callers working from
+            # the same baseline would double-count every delta
+            deltas = {k: v - self._mirrored.get(k, 0)
+                      for k, v in counts.items()}
+            deltas = {k: v for k, v in deltas.items() if v}
+            if deltas:
+                self._mirrored.update(counts)
         if deltas:
             perfvars.note_front_door(**deltas)
-            self._mirrored.update(counts)
         perfvars.set_front_door_gauges(open_sockets=open_sockets,
                                        workers=self.nworkers,
                                        workers_busy=busy)

@@ -242,8 +242,10 @@ class Router:
             except OSError:
                 pass
 
-    # grace for the surviving direction once one side has sent EOF: the
-    # broker answers its own EOF promptly, so this only bounds a wedged peer
+    # idle grace for the surviving direction once one side has sent EOF:
+    # the deadline re-arms every time that direction moves bytes, so a
+    # long in-flight drain is never cut off — this only bounds a peer
+    # that has gone silent while half-open
     _HALF_CLOSE_GRACE = 30.0
 
     @staticmethod
@@ -279,16 +281,17 @@ class Router:
                         self.native = False
                 self.open = True
 
-        def _py_pump(d) -> bool:
-            """One fallback pump slice; False = this direction is done."""
+        def _py_pump(d) -> int:
+            """One fallback pump slice; bytes moved, 0 on EAGAIN, -1 when
+            this direction is done."""
             try:
                 chunk = d.src.recv(1 << 16)
             except (BlockingIOError, InterruptedError):
-                return True
+                return 0
             except OSError:
-                return False
+                return -1
             if not chunk:
-                return False
+                return -1
             view = memoryview(chunk)
             deadline = time.monotonic() + Router._HALF_CLOSE_GRACE
             while view.nbytes:
@@ -297,12 +300,13 @@ class Router:
                 except (BlockingIOError, InterruptedError):
                     if not select.select([], [d.dst], [], 1.0)[1] \
                             and time.monotonic() > deadline:
-                        return False    # peer stopped draining: give up
+                        return -1       # peer stopped draining: give up
                 except OSError:
-                    return False
-            return True
+                    return -1
+            return len(chunk)
 
-        def _pump(d) -> bool:
+        def _pump(d) -> int:
+            """Bytes moved this slice, 0 on EAGAIN, -1 on EOF/error."""
             if d.native:
                 try:
                     moved = splice_fd(d.src.fileno(), d.dst.fileno(),
@@ -312,7 +316,9 @@ class Router:
                     # demote the direction to the userspace pump
                     d.native = False
                     return _py_pump(d)
-                return moved != 0       # 0 = EOF; >0 moved; -1 = EAGAIN
+                if moved == 0:
+                    return -1           # 0 = EOF
+                return max(moved, 0)    # >0 moved; -1 = EAGAIN
             return _py_pump(d)
 
         dirs = [_Dir(a, b), _Dir(b, a)]
@@ -327,18 +333,26 @@ class Router:
                 except (OSError, ValueError):
                     break               # a socket died out from under us
                 for d in dirs:
-                    if d.open and d.src in ready and not _pump(d):
+                    if not (d.open and d.src in ready):
+                        continue
+                    moved = _pump(d)
+                    if moved < 0:
                         d.open = False
                         try:            # propagate EOF, read side stays up
                             d.dst.shutdown(socket.SHUT_WR)
                         except OSError:
                             pass
+                    elif moved and first_eof is not None:
+                        # the surviving direction is still draining: re-arm
+                        # the grace so it bounds idleness, not total
+                        # half-open lifetime
+                        first_eof = time.monotonic()
                 if any(d.open for d in dirs) != all(d.open for d in dirs):
                     if first_eof is None:
                         first_eof = time.monotonic()
                     elif time.monotonic() - first_eof > \
                             Router._HALF_CLOSE_GRACE:
-                        break           # lame-duck half: bounded wait
+                        break           # idle lame-duck half: bounded wait
         finally:
             for d in dirs:
                 if d.pipe is not None:
