@@ -1,0 +1,25 @@
+# nprocs: 2
+#
+# Clean fixture: the training tier's gradient-bucket round loop done
+# right — each arm_bucket handle is Started once per step and Waited
+# before the fold, in Start order (the DDP overlap schedule,
+# docs/training.md). Zero lint (L116 stays silent), zero trace.
+import numpy as np
+
+import tpu_mpi as MPI
+from tpu_mpi.train import arm_bucket
+
+comm = MPI.COMM_WORLD
+g0 = np.ones(8)
+r0 = np.zeros(8)
+g1 = np.ones(8)
+r1 = np.zeros(8)
+b0 = arm_bucket(g0, r0, comm)
+b1 = arm_bucket(g1, r1, comm)
+
+for _ in range(3):
+    MPI.Start(b0)        # bucket 0's last grad landed mid-backward
+    MPI.Start(b1)        # bucket 1 follows while compute continues
+    MPI.Wait(b0)         # just-in-time completion at the fold
+    MPI.Wait(b1)
+MPI.Barrier(comm)

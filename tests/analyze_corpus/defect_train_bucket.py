@@ -1,0 +1,27 @@
+# nprocs: 2
+# raises: MPIError
+#
+# Defect class: gradient-bucket handle misuse (training tier). Bucket
+# b0 is Started twice with no intervening Wait — the second round's
+# reduction is lost and the runtime raises ERR_REQUEST at the restart.
+# Bucket b1 is Waited without ever being Started — on the legacy lane
+# that Wait blocks forever. The static pass flags both sites (L116)
+# before any rank runs.
+import numpy as np
+
+import tpu_mpi as MPI
+from tpu_mpi.train import arm_bucket
+
+comm = MPI.COMM_WORLD
+g0 = np.ones(8)
+r0 = np.zeros(8)
+g1 = np.ones(8)
+r1 = np.zeros(8)
+b0 = arm_bucket(g0, r0, comm)
+b1 = arm_bucket(g1, r1, comm)
+
+MPI.Start(b0)
+MPI.Start(b0)                     # lint: L116
+MPI.Wait(b0)
+MPI.Wait(b1)                      # lint: L116
+MPI.Barrier(comm)

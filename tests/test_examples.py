@@ -22,6 +22,7 @@ EXAMPLES = os.path.join(REPO, "examples")
     ("07-overlap.py", 4),
     ("08-checkpoint.py", 4),
     ("09-partitioned.py", 2),
+    ("14-ddp-train.py", 4),
 ])
 def test_example_runs(name, nsim):
     env = dict(os.environ)

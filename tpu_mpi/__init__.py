@@ -135,6 +135,11 @@ def __getattr__(name):
         # is only paid for by processes that use it
         import importlib
         return importlib.import_module(".serve", __name__)
+    if name == "train":
+        # lazy like serve: the training tier (docs/training.md) is only
+        # paid for by processes that train
+        import importlib
+        return importlib.import_module(".train", __name__)
     raise AttributeError(f"module 'tpu_mpi' has no attribute {name!r}")
 
 

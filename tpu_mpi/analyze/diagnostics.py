@@ -41,6 +41,8 @@ CODES = {
             "common guard",
     "L115": "lock released on a different path than it was acquired "
             "(missed release on an exception edge)",
+    "L116": "gradient-bucket handle misuse (Start twice without Wait / "
+            "Wait on an unstarted bucket)",
     "T201": "ranks called different collectives in the same round",
     "T202": "collective signature (root/dtype/count) disagrees across ranks",
     "T203": "sent message was never received",

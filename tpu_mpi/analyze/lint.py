@@ -21,7 +21,11 @@ cheap to prove from source alone — before any rank runs:
 - **L110** an operation on a communicator after ``Comm_revoke`` (with no
   intervening ``Comm_agree``) or on the parent after ``Comm_shrink``;
 - **L111** serve-session misuse: an RPC on a detached session, or a
-  ``SessionComm`` passed to a *different* session's operation.
+  ``SessionComm`` passed to a *different* session's operation;
+- **L116** gradient-bucket handle misuse (training tier): a handle
+  produced by ``arm_bucket`` ``Start``ed twice with no intervening
+  ``Wait`` (the second round's reduction is lost), or ``Wait``ed while
+  not started (blocks forever on the legacy lane).
 
 The linter is deliberately conservative: it only trusts what it can resolve
 (literal tags/counts/roots, ``np.zeros``-style buffer shapes, rank variables
@@ -153,6 +157,9 @@ class _Unit:
         self._armed: Dict[str, tuple] = {}      # req var -> (buf var, line)
         # L109: plan var -> {kind, buf, comm, started, freed, init_line}
         self._pers: Dict[str, dict] = {}
+        # L116: gradient-bucket handle var (arm_bucket result) ->
+        # {started: Optional[line], init_line}
+        self._bucket: Dict[str, dict] = {}
         self._freed: set = set()                # comm vars already freed
         # L110: comm var -> ("revoked" | "shrunk", line)
         self._ft: Dict[str, tuple] = {}
@@ -228,6 +235,9 @@ class _Unit:
         for call in calls:
             name = _call_name(call)
             if name is None:
+                # a `<mod>.arm_bucket(...)` from a non-MPI base still
+                # mints a tracked bucket handle (L116)
+                self._bucket_effects(st, call, None)
                 self._method_effects(st, call)
                 continue
             if name == "Win_fence":
@@ -241,6 +251,7 @@ class _Unit:
                                 self._lock_depth > 0))
             self._isend_effects(st, call, name)
             self._persistent_effects(st, call, name)
+            self._bucket_effects(st, call, name)
             self._ft_effects(st, call, name)
         self._auto_arm_effects(st)
         self._mutation_effects(st)
@@ -389,6 +400,65 @@ class _Unit:
                         line, context=f"{p['kind']} at line {p['init_line']}")
         p["started"] = line
 
+    # -- L116 bookkeeping: gradient-bucket handle lifecycle -----------------
+
+    @staticmethod
+    def _is_arm_bucket(call: ast.Call) -> bool:
+        """A call that mints a training-tier bucket handle: bare
+        ``arm_bucket(...)`` or ``<anything>.arm_bucket(...)`` (the
+        distinctive producer name is the whole point — see
+        tpu_mpi.train.ddp.arm_bucket)."""
+        f = call.func
+        return (isinstance(f, ast.Name) and f.id == "arm_bucket") or \
+            (isinstance(f, ast.Attribute) and f.attr == "arm_bucket")
+
+    def _bucket_effects(self, st, call, name):
+        if self._is_arm_bucket(call):
+            target = self._assign_target(st)
+            if target is not None:
+                self._bucket[target] = {"started": None,
+                                        "init_line": call.lineno}
+            return
+        if name in ("Start", "Startall"):
+            reqs: List[str] = []
+            if call.args and isinstance(call.args[0], ast.Name):
+                reqs = [call.args[0].id]
+            elif call.args and isinstance(call.args[0], (ast.List, ast.Tuple)):
+                reqs = [el.id for el in call.args[0].elts
+                        if isinstance(el, ast.Name)]
+            for r in reqs:
+                self._start_bucket(r, call.lineno)
+        elif name in WAIT_NAMES and call.args:
+            a0 = call.args[0]
+            names = [a0] if isinstance(a0, ast.Name) else (
+                list(a0.elts) if isinstance(a0, (ast.List, ast.Tuple)) else [])
+            for el in names:
+                if isinstance(el, ast.Name):
+                    self._wait_bucket(el.id, call.lineno)
+
+    def _start_bucket(self, req: str, line: int):
+        b = self._bucket.get(req)
+        if b is None:
+            return
+        if b["started"] is not None:
+            self.L.diag("L116",
+                        f"gradient bucket {req!r} Started twice (previous "
+                        f"Start at line {b['started']}) with no intervening "
+                        f"Wait — the second round's reduction is lost",
+                        line, context=f"arm_bucket at line {b['init_line']}")
+        b["started"] = line
+
+    def _wait_bucket(self, req: str, line: int):
+        b = self._bucket.get(req)
+        if b is None:
+            return
+        if b["started"] is None:
+            self.L.diag("L116",
+                        f"Wait on gradient bucket {req!r} which is not "
+                        f"started — blocks forever on the legacy lane",
+                        line, context=f"arm_bucket at line {b['init_line']}")
+        b["started"] = None
+
     # -- L110 bookkeeping: revoked / shrunk communicators -------------------
 
     def _ft_effects(self, st, call, name):
@@ -483,8 +553,12 @@ class _Unit:
             self._armed.pop(base, None)
             if base in self._pers:
                 self._pers[base]["started"] = None
+            if base in self._bucket:
+                self._wait_bucket(base, call.lineno)
         elif meth in ("start", "Start") and base in self._pers:
             self._start_plan(base, call.lineno)
+        elif meth in ("start", "Start") and base in self._bucket:
+            self._start_bucket(base, call.lineno)
         elif meth == "free":
             if base in self._pers:
                 self._pers[base]["freed"] = call.lineno
@@ -547,6 +621,9 @@ class _Unit:
         if not (isinstance(st.value, ast.Call)
                 and _call_name(st.value) in PERSISTENT_INITS):
             self._pers.pop(target, None)
+        if not (isinstance(st.value, ast.Call)
+                and self._is_arm_bucket(st.value)):
+            self._bucket.pop(target, None)
         if not (isinstance(st.value, ast.Call)
                 and self._session_is_attach_value(st.value)):
             self._sessions.pop(target, None)

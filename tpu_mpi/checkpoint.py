@@ -296,3 +296,17 @@ def load_sharded(path: str, comm: Comm, *, shard: int | None = None) -> Any:
         File.close(fh)
     Barrier(comm)
     return _unflatten(entry["spec"], leaves)
+
+
+def load_all_shards(path: str, comm: Comm) -> list:
+    """Resharding helper: read EVERY rank shard of a ``save_sharded``
+    file, in writer-rank order, regardless of the reader comm's size.
+
+    This is the restore half of elastic resharding (docs/training.md
+    "Resize and resume"): a world that shrank, grew, or replaced ranks
+    since the checkpoint was written reassembles the writers' global
+    state from all N shards and re-partitions it for its own size. Each
+    caller reads the whole file; callers that only need a slice should
+    use ``load_sharded(..., shard=s)`` directly."""
+    return [load_sharded(path, comm, shard=s)
+            for s in range(shard_count(path, comm))]

@@ -295,6 +295,16 @@ class Config:
     # byte floor for the heuristic to prefer the two-level "hier"
     # composite on multi-domain worlds (measured tables override).
     hier_min_bytes: int = 4096
+    # training tier (docs/training.md): gradient-bucket capacity in bytes
+    # for the DDP backward pass — gradients pack into size-bounded
+    # buckets (reverse-layer order) and each bucket rides one persistent
+    # Allreduce, so the knob trades per-op overhead (small buckets)
+    # against overlap opportunity (a single huge bucket cannot overlap).
+    train_bucket_bytes: int = 1 << 20
+    # ZeRO-style sharded-state mode: partition optimizer state and flat
+    # master params 1/nranks (Reduce_scatter the grad, Allgather the
+    # updated params) instead of replicating them per rank.
+    train_shard_state: bool = False
     # elastic capacity (docs/fault-tolerance.md "Elastic recovery"):
     # enables the broker-side autoscaler loop that re-spawns ranks after a
     # failure and grows/retires capacity from the load signals the broker
@@ -399,6 +409,8 @@ _ENV_MAP = {
     "plan_cache_max": "TPU_MPI_PLAN_CACHE_MAX",
     "domains": "TPU_MPI_DOMAINS",
     "hier_min_bytes": "TPU_MPI_HIER_MIN_BYTES",
+    "train_bucket_bytes": "TPU_MPI_TRAIN_BUCKET_BYTES",
+    "train_shard_state": "TPU_MPI_TRAIN_SHARD_STATE",
     "elastic": "TPU_MPI_ELASTIC",
     "elastic_min_ranks": "TPU_MPI_ELASTIC_MIN_RANKS",
     "elastic_max_ranks": "TPU_MPI_ELASTIC_MAX_RANKS",

@@ -159,6 +159,7 @@ DIAGNOSTIC_CODES = {
     "L113": ERR_DEADLOCK,               # blocking under a dispatch/pool lock
     "L114": ERR_INTERN,                 # unguarded cross-thread field write
     "L115": ERR_LOCK_ORDER,             # release path differs from acquire
+    "L116": ERR_REQUEST,                # gradient-bucket handle misuse
     "T201": ERR_COLLECTIVE_MISMATCH,    # collective order mismatch (traced)
     "T202": ERR_COLLECTIVE_MISMATCH,    # collective signature mismatch
     "T203": ERR_PENDING,                # sent message never received

@@ -174,6 +174,7 @@ class PlanCache:
         self._cap_gen = None            # config.GENERATION the caps reflect
         self._cap = self.CAP
         self._auto_cap = self.AUTO_CAP
+        self._reserved = 0              # bucket-aware floor (reserve())
         # prime the knob read now: the first-ever config.load() bumps
         # GENERATION, which must not happen inside a later put() (it would
         # invalidate the very plan being stored)
@@ -216,11 +217,23 @@ class PlanCache:
             return
         with self._lock:
             cap, _ = self._caps()
+            cap = max(cap, self._reserved)
             self._plans[key] = plan
             self._plans.move_to_end(key)
             while len(self._plans) > cap:
                 self._plans.popitem(last=False)
                 self.evictions += 1
+
+    def reserve(self, n: int) -> int:
+        """Bucket-aware arm hint (ISSUE-19): raise the effective LRU
+        capacity floor to at least ``n`` plans so a set of persistent
+        gradient-bucket plans armed together can never evict itself (or
+        be evicted by concurrent shape churn) mid-step. Monotonic — the
+        floor only grows; the configured cap still applies when larger.
+        Returns the effective floor."""
+        with self._lock:
+            self._reserved = max(self._reserved, int(n))
+            return self._reserved
 
     # -- auto-arm table (ISSUE-11) ------------------------------------------
 
@@ -355,7 +368,9 @@ class PlanCache:
             cap, auto_cap = self._caps()
             return {"entries": len(self._plans), "hits": self.hits,
                     "misses": self.misses,
-                    "cap": cap, "evictions": self.evictions,
+                    "cap": max(cap, self._reserved),
+                    "reserved": self._reserved,
+                    "evictions": self.evictions,
                     "auto": {"tracked": len(self._auto),
                              "armed": sum(1 for e in self._auto.values()
                                           if e.reg is not None),
@@ -370,6 +385,15 @@ class PlanCache:
 #: The process-wide plan cache. ``Comm.free`` invalidates per-cid; config
 #: reloads invalidate by generation.
 plans = PlanCache()
+
+
+def hint_buckets(comm, nbuckets: int) -> int:
+    """Bucket-aware arm hint from the training tier (docs/training.md):
+    before arming a gradient-bucket set on ``comm``, guarantee the plan
+    cache holds the whole set — one plan per bucket, doubled for the
+    send/recv signature pair a control lane may also arm, plus headroom
+    for unrelated concurrent traffic. Returns the effective floor."""
+    return plans.reserve(2 * int(nbuckets) + 8)
 
 
 class ChunkProgress:

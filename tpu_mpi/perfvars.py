@@ -503,6 +503,57 @@ def infer_snapshot() -> dict:
         return {**_infer, "gauges": dict(_infer_gauges)}
 
 
+# -- training block (tpu_mpi.train) ------------------------------------------
+#
+# Process-global like the infer block: a training step spans every rank of
+# the job, and the trainer lives above any single comm. Counters (steps,
+# buckets, bucket_flushes, starts, waits, reshards, wait_ns,
+# comm_window_ns, step_ns) accumulate; gauges (nbuckets, bucket_bytes,
+# world) overwrite. A bounded per-step sample list feeds the stats
+# renderer's p50/p99 without unbounded growth.
+
+_train: Dict[str, int] = {}
+_train_gauges: Dict[str, int] = {}
+_train_steps: List[int] = []
+_TRAIN_STEP_CAP = 4096
+
+
+def note_train(**counts: int) -> None:
+    """Accumulate training counters (steps, bucket_flushes, starts,
+    waits, reshards, wait_ns, comm_window_ns, step_ns, ...)."""
+    with _store_lock:
+        for k, v in counts.items():
+            _train[k] = _train.get(k, 0) + int(v)
+
+
+def set_train_gauges(**vals: int) -> None:
+    """Overwrite training gauges (nbuckets, bucket_bytes, world)."""
+    with _store_lock:
+        for k, v in vals.items():
+            _train_gauges[k] = int(v)
+
+
+def note_train_step(ns: int) -> None:
+    """Record one optimizer-step duration sample (nanoseconds) for the
+    p50/p99 rendering; also accumulates steps/step_ns counters."""
+    with _store_lock:
+        _train["steps"] = _train.get("steps", 0) + 1
+        _train["step_ns"] = _train.get("step_ns", 0) + int(ns)
+        if len(_train_steps) < _TRAIN_STEP_CAP:
+            _train_steps.append(int(ns))
+
+
+def train_snapshot() -> dict:
+    """The train block of :func:`snapshot` (may be empty): accumulated
+    counters, latest gauges under ``"gauges"``, and the bounded step-time
+    sample list under ``"step_ns_samples"``."""
+    with _store_lock:
+        if not _train and not _train_gauges:
+            return {}
+        return {**_train, "gauges": dict(_train_gauges),
+                "step_ns_samples": list(_train_steps)}
+
+
 # -- elastic-capacity block (tpu_mpi.elastic) ---------------------------------
 #
 # Process-global like the infer block: resizes span the whole pool, so
@@ -720,7 +771,8 @@ def snapshot(rank: Optional[int] = None, reset: bool = False) -> dict:
     return {"schema": 1, "kind": "tpu_mpi-pvars", "level": level(),
             "topology": _topology_stamp(),
             "comms": comms, "plan_cache": plans.stats(),
-            "infer": infer_snapshot(), "elastic": elastic_snapshot(),
+            "infer": infer_snapshot(), "train": train_snapshot(),
+            "elastic": elastic_snapshot(),
             "serve_frame": serve_frame_snapshot(),
             "front_door": front_door_snapshot(),
             "locks": locks_snapshot()}
@@ -749,6 +801,9 @@ def reset() -> None:
         _store.clear()
         _infer.clear()
         _infer_gauges.clear()
+        _train.clear()
+        _train_gauges.clear()
+        _train_steps.clear()
         _elastic.clear()
         _elastic_gauges.clear()
         _serve_frame.clear()

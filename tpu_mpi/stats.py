@@ -37,6 +37,7 @@ def aggregate(records: Sequence[dict]) -> dict:
     auto = {"tracked": 0, "armed": 0, "arms": 0, "demotions": 0, "hits": 0,
             "evictions": 0, "signatures": {}}
     infer: Dict[str, Any] = {"gauges": {}}
+    train: Dict[str, Any] = {"gauges": {}, "step_ns_samples": []}
     elastic: Dict[str, Any] = {"gauges": {}}
     front: Dict[str, Any] = {"gauges": {}}
     batch = {"flushes": 0, "ops": 0}
@@ -61,6 +62,15 @@ def aggregate(records: Sequence[dict]) -> dict:
                                              int(gv))
             else:
                 infer[k] = int(infer.get(k, 0)) + int(v)
+        for k, v in (rec.get("train") or {}).items():
+            if k == "gauges":
+                for g, gv in (v or {}).items():
+                    train["gauges"][g] = max(int(train["gauges"].get(g, 0)),
+                                             int(gv))
+            elif k == "step_ns_samples":
+                train["step_ns_samples"].extend(int(s) for s in (v or ()))
+            else:
+                train[k] = int(train.get(k, 0)) + int(v)
         for k, v in (rec.get("elastic") or {}).items():
             if k == "gauges":
                 for g, gv in (v or {}).items():
@@ -146,10 +156,19 @@ def aggregate(records: Sequence[dict]) -> dict:
                              if explore["calls"] else None),
         "arm_counts": arm_counts,
         "infer": infer,
+        "train": train,
         "elastic": elastic,
         "front_door": front,
         "locks": locks,
     }
+
+
+def _pctl(samples: Sequence[int], q: float) -> float:
+    """Nearest-rank percentile of a sample list (q in [0, 1])."""
+    s = sorted(samples)
+    if not s:
+        return 0.0
+    return float(s[min(len(s) - 1, int(q * len(s)))])
 
 
 def _fmt_bytes(n: float) -> str:
@@ -302,6 +321,29 @@ def render(agg: dict, out=None) -> None:
               f"{g.get('kv_prefix_entries_max', 0)} registry entries, "
               f"{g.get('kv_cow_forks', 0)} CoW forks\n")
 
+    tr = agg.get("train") or {}
+    if tr.get("steps"):
+        g = tr.get("gauges") or {}
+        samples = tr.get("step_ns_samples") or []
+        p50 = _pctl(samples, 0.50) / 1e6
+        p99 = _pctl(samples, 0.99) / 1e6
+        window = tr.get("comm_window_ns", 0)
+        waited = tr.get("wait_ns", 0)
+        ofrac = (1.0 - waited / window) if window > 0 else None
+        w(f"\ntraining: {tr['steps']} steps on world "
+          f"{g.get('world', 0)}, step p50 {p50:.2f}ms / p99 {p99:.2f}ms\n")
+        w(f"  gradient buckets: {g.get('nbuckets', 0)} x "
+          f"{_fmt_bytes(g.get('bucket_bytes', 0))} cap, "
+          f"{tr.get('bucket_flushes', 0)} flushes "
+          f"({tr.get('starts', 0)} starts / {tr.get('waits', 0)} waits on "
+          f"persistent handles)\n")
+        if ofrac is not None:
+            w(f"  overlap: {ofrac:.0%} of the {window / 1e6:.2f}ms comm "
+              f"window hidden behind backward compute\n")
+        if tr.get("reshards"):
+            w(f"  reshard events: {tr['reshards']} "
+              f"(checkpoint loads repartitioned across the world)\n")
+
     lw = agg.get("locks") or {}
     if lw:
         w("\nlock contention (TPU_MPI_LOCKCHECK witness):\n")
@@ -399,6 +441,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                "explore": agg["explore"],
                "explore_fraction": agg["explore_fraction"],
                "infer": agg["infer"],
+               "train": agg["train"],
                "elastic": agg["elastic"],
                "arm_counts": {f"{c}|{a}": n
                               for (c, a), n in sorted(

@@ -1,0 +1,44 @@
+"""tpu_mpi.train — the data-parallel training tier (docs/training.md).
+
+Two trainers over the host collective path:
+
+- :class:`DDPTrainer` (``ddp.py``): replicated state, bucketed gradient
+  Allreduces on persistent handles ``Start``ed mid-backward and
+  ``Wait``ed just-in-time at the fold — communication overlaps the rest
+  of the backward pass (`TPU_MPI_TRAIN_BUCKET_BYTES` sizes the buckets).
+- :class:`FSDPTrainer` (``fsdp.py``): ZeRO-style sharded state
+  (`TPU_MPI_TRAIN_SHARD_STATE`) — ``Reduce_scatter`` the grad,
+  ``Allgather`` the updated params, optimizer state 1/nranks per rank.
+
+:func:`make_trainer` picks between them from config.  Both checkpoint
+through the CRC'd sharded format with full resharding on load, which is
+what makes mid-training shrink→grow resizes resumable bitwise.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from .. import config as _config
+from .bucketer import Bucket, GradBucketer
+from .ddp import DDPTrainer, arm_bucket
+from .fsdp import FSDPTrainer
+
+__all__ = ["Bucket", "GradBucketer", "DDPTrainer", "FSDPTrainer",
+           "arm_bucket", "make_trainer"]
+
+
+def make_trainer(params: Dict[str, np.ndarray], comm, *,
+                 shard_state: Optional[bool] = None, **kw):
+    """Build the configured trainer: FSDP when ``shard_state`` (default:
+    `TPU_MPI_TRAIN_SHARD_STATE`), else DDP.  Keyword args pass through."""
+    if shard_state is None:
+        shard_state = _config.load().train_shard_state
+    if shard_state:
+        kw.pop("bucket_bytes", None)
+        kw.pop("overlap", None)
+        kw.pop("grad_order", None)
+        return FSDPTrainer(params, comm, **kw)
+    return DDPTrainer(params, comm, **kw)

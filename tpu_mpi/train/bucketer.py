@@ -1,0 +1,120 @@
+"""Gradient bucketing for the data-parallel training tier.
+
+The backward pass produces gradients in reverse-layer order (the last
+layer's grad is ready first).  :class:`GradBucketer` packs them, in that
+arrival order, into size-bounded flat buckets so each bucket can ride ONE
+persistent Allreduce the moment its last gradient lands — while the host
+is still producing gradients for earlier layers.  The bucket byte bound
+(`TPU_MPI_TRAIN_BUCKET_BYTES`, default 1 MiB) trades per-op overhead
+(small buckets → many rounds) against overlap opportunity (one huge
+bucket completes only when the whole backward does, so nothing overlaps).
+
+Buckets are laid out ONCE from the parameter spec and then reused every
+step: `send`/`recv` buffers are preallocated float64 flats, and packing
+copies into preexisting views — the per-step fold allocates nothing
+(the host-path analog of the donate_argnums discipline the in-graph tier
+uses).  A parameter larger than the bound gets a bucket of its own.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Bucket", "GradBucketer"]
+
+
+class Bucket:
+    """One flat gradient bucket: a contiguous send/recv pair plus the
+    per-parameter views that pack and unpack it in place."""
+
+    __slots__ = ("index", "names", "send", "recv", "_views", "_pending",
+                 "_arrived")
+
+    def __init__(self, index: int, spec: Sequence[Tuple[str, int]]) -> None:
+        self.index = index
+        self.names = [name for name, _ in spec]
+        total = sum(n for _, n in spec)
+        self.send = np.zeros(total, dtype=np.float64)
+        self.recv = np.zeros(total, dtype=np.float64)
+        self._views: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        off = 0
+        for name, n in spec:
+            self._views[name] = (self.send[off:off + n],
+                                 self.recv[off:off + n])
+            off += n
+        self._pending = set(self.names)
+        self._arrived: set = set()
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.send.nbytes)
+
+    def add(self, name: str, grad: np.ndarray) -> bool:
+        """Copy ``grad`` into this bucket's send flat.  Returns True when
+        the bucket is full (every owned gradient has arrived)."""
+        view, _ = self._views[name]
+        np.copyto(view, np.asarray(grad, dtype=np.float64).reshape(-1))
+        self._arrived.add(name)
+        return len(self._arrived) == len(self.names)
+
+    def out_view(self, name: str) -> np.ndarray:
+        """The reduced gradient for ``name`` (a view into ``recv``)."""
+        return self._views[name][1]
+
+    def reset(self) -> None:
+        self._arrived.clear()
+
+
+class GradBucketer:
+    """Size-bounded reverse-layer-order bucket layout over a fixed
+    parameter spec ``[(name, element_count), ...]``.
+
+    The spec order is the ARRIVAL order (reverse-layer: pass the last
+    layer first).  Layout happens once in ``__init__``; each training
+    step calls :meth:`add` per gradient and gets the bucket back when its
+    last member lands, then :meth:`reset` before the next step.
+    """
+
+    def __init__(self, spec: Sequence[Tuple[str, int]],
+                 bucket_bytes: int = 1 << 20) -> None:
+        if bucket_bytes < 8:
+            raise ValueError(f"bucket_bytes {bucket_bytes} < one element")
+        self.bucket_bytes = int(bucket_bytes)
+        self.buckets: List[Bucket] = []
+        self._owner: Dict[str, Bucket] = {}
+        cur: List[Tuple[str, int]] = []
+        cur_bytes = 0
+        for name, count in spec:
+            n = int(count)
+            nbytes = n * 8
+            if cur and cur_bytes + nbytes > self.bucket_bytes:
+                self._seal(cur)
+                cur, cur_bytes = [], 0
+            cur.append((name, n))
+            cur_bytes += nbytes
+        if cur:
+            self._seal(cur)
+
+    def _seal(self, spec: List[Tuple[str, int]]) -> None:
+        b = Bucket(len(self.buckets), spec)
+        self.buckets.append(b)
+        for name in b.names:
+            self._owner[name] = b
+
+    def __len__(self) -> int:
+        return len(self.buckets)
+
+    def add(self, name: str, grad: np.ndarray):
+        """Route one gradient to its bucket.  Returns the :class:`Bucket`
+        when this grad completed it, else None."""
+        b = self._owner[name]
+        return b if b.add(name, grad) else None
+
+    def out_view(self, name: str) -> np.ndarray:
+        return self._owner[name].out_view(name)
+
+    def reset(self) -> None:
+        for b in self.buckets:
+            b.reset()

@@ -1,0 +1,211 @@
+"""Data-parallel trainer: bucketed gradient Allreduce on the persistent
+fast path, overlapped with the remaining backward pass.
+
+The contract with the model is deliberately thin: the caller owns the
+forward/backward (JAX, numpy, anything) and feeds this trainer the
+gradients one tensor at a time, **in reverse-layer order** — the order a
+backward pass produces them.  The trainer packs them into size-bounded
+flat buckets (:mod:`tpu_mpi.train.bucketer`), and the moment a bucket's
+last gradient lands it ``Start``s that bucket's persistent Allreduce
+handle while the caller keeps producing gradients for earlier layers.
+The ``Wait``s happen just-in-time at the optimizer fold, in Start order,
+so the first Wait's batched-submission flush (ISSUE-11) drains every
+stacked bucket round through one rendezvous wakeup and the rest return
+from completed state.
+
+``overlap=False`` is the measurement control: identical bucket layout and
+traffic, but each bucket rides a plain blocking ``Allreduce`` at flush
+time (which the auto-arm table promotes onto the same registered path
+after a few steps — same combine, bitwise-identical results).
+
+The optimizer is SGD with momentum, folded in place over preallocated
+flats: the per-step hot path allocates nothing (the host-path analog of
+the in-graph tier's donate_argnums discipline, SNIPPETS [1]/[2]).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import config as _config
+from .. import perfvars as _pv
+from .. import checkpoint as _ckpt
+from ..collective import Allreduce, Allreduce_init, Bcast
+from ..operators import SUM
+from ..overlap import hint_buckets
+from ..pointtopoint import Start, Wait
+from .bucketer import GradBucketer
+
+__all__ = ["DDPTrainer", "arm_bucket"]
+
+
+def arm_bucket(send: np.ndarray, recv: np.ndarray, comm) -> object:
+    """Arm ONE persistent Allreduce handle for a gradient bucket.
+
+    The distinctive name is load-bearing: the analyzer's L116 lint keys
+    on calls named ``arm_bucket`` to track bucket-handle Start/Wait
+    pairing statically (docs/observability.md).  Start/Wait the returned
+    handle exactly alternately — Start twice without a Wait loses a
+    round; Wait on a never-Started handle blocks forever on the legacy
+    lane.
+    """
+    return Allreduce_init(send, recv, SUM, comm)
+
+
+class DDPTrainer:
+    """Bucketed-overlap data-parallel SGD(momentum) over one comm.
+
+    ``params`` is a dict ``name -> np.ndarray``; arrays are copied into
+    float64 master storage at init and broadcast from rank 0 so every
+    rank starts identical.  ``grad_order`` fixes the gradient arrival
+    order (default: reversed dict order = reverse-layer for a dict built
+    in forward order); the bucket layout, and therefore the fold order
+    and the bitwise result, depend only on it — never on timing.
+    """
+
+    def __init__(self, params: Dict[str, np.ndarray], comm, *,
+                 lr: float = 0.1, momentum: float = 0.9,
+                 bucket_bytes: Optional[int] = None, overlap: bool = True,
+                 grad_order: Optional[Sequence[str]] = None) -> None:
+        self.comm = comm
+        self.lr = float(lr)
+        self.momentum = float(momentum)
+        self.overlap = bool(overlap)
+        if bucket_bytes is None:
+            bucket_bytes = _config.load().train_bucket_bytes
+        self.order: List[str] = list(grad_order) if grad_order is not None \
+            else list(reversed(list(params)))
+        if set(self.order) != set(params):
+            raise ValueError("grad_order must cover exactly the params")
+
+        # float64 master COPIES (never alias the caller's arrays),
+        # rank-0 values broadcast everywhere
+        self.params: Dict[str, np.ndarray] = {
+            name: np.array(params[name], dtype=np.float64, copy=True)
+            for name in params}
+        for name in self.order:
+            Bcast(self.params[name], 0, comm)
+        self._flat = {name: p.reshape(-1) for name, p in self.params.items()}
+        self._mom = {name: np.zeros_like(f) for name, f in self._flat.items()}
+
+        self.bucketer = GradBucketer(
+            [(name, self._flat[name].size) for name in self.order],
+            bucket_bytes)
+        hint_buckets(comm, len(self.bucketer))
+        self._handles = None
+        if self.overlap:
+            self._handles = [arm_bucket(b.send, b.recv, comm)
+                             for b in self.bucketer.buckets]
+        self.step_count = 0
+        self._wait_ns = 0
+        self._window_ns = 0
+        _pv.set_train_gauges(nbuckets=len(self.bucketer),
+                             bucket_bytes=int(bucket_bytes),
+                             world=comm.size())
+
+    # -- per-step fold ------------------------------------------------------
+
+    def step(self, grads: Iterable[Tuple[str, np.ndarray]]) -> None:
+        """One optimizer step from an iterable of ``(name, grad)`` in the
+        configured arrival order.  Mutates params in place."""
+        t_step = time.perf_counter_ns()
+        started: List[Tuple[int, int]] = []   # (bucket index, t0)
+        wait_ns = 0
+        window_ns = 0
+        for name, grad in grads:
+            b = self.bucketer.add(name, grad)
+            if b is None:
+                continue
+            _pv.note_train(bucket_flushes=1)
+            if self.overlap:
+                Start(self._handles[b.index])
+                _pv.note_train(starts=1)
+                started.append((b.index, time.perf_counter_ns()))
+            else:
+                t0 = time.perf_counter_ns()
+                Allreduce(b.send, b.recv, SUM, self.comm)
+                t1 = time.perf_counter_ns()
+                # blocking control: the whole comm window is wait
+                wait_ns += t1 - t0
+                window_ns += t1 - t0
+        if self.overlap:
+            for idx, t0 in started:
+                t1 = time.perf_counter_ns()
+                Wait(self._handles[idx])
+                t2 = time.perf_counter_ns()
+                _pv.note_train(waits=1)
+                wait_ns += t2 - t1
+                window_ns += t2 - t0
+        self._fold()
+        self.bucketer.reset()
+        self.step_count += 1
+        self._wait_ns += wait_ns
+        self._window_ns += window_ns
+        _pv.note_train(wait_ns=wait_ns, comm_window_ns=window_ns)
+        _pv.note_train_step(time.perf_counter_ns() - t_step)
+
+    def _fold(self) -> None:
+        inv = 1.0 / self.comm.size()
+        mu, lr = self.momentum, self.lr
+        for name in self.order:
+            g = self.bucketer.out_view(name)   # reduced SUM, reused scratch
+            g *= inv                           # mean gradient, in place
+            m = self._mom[name]
+            m *= mu
+            m += g
+            np.multiply(m, lr, out=g)          # g now holds the update
+            self._flat[name] -= g
+
+    def overlap_fraction(self) -> float:
+        """1 − (blocked Wait time / Start→Wait-return comm window), over
+        the trainer's lifetime.  The control lane is fully blocking, so
+        its fraction is 0 by construction."""
+        if self._window_ns <= 0:
+            return 0.0
+        return 1.0 - (self._wait_ns / self._window_ns)
+
+    def opt_state_bytes(self) -> int:
+        """Optimizer-state footprint (the momentum flats): full-size per
+        rank — the quantity FSDP shards 1/nranks."""
+        return sum(m.nbytes for m in self._mom.values())
+
+    # -- checkpoint / reshard ----------------------------------------------
+
+    def _pack_state(self) -> np.ndarray:
+        return np.concatenate([self._flat[n] for n in self.order]
+                              + [self._mom[n] for n in self.order])
+
+    def _unpack_state(self, flat: np.ndarray) -> None:
+        off = 0
+        for dst in ([self._flat[n] for n in self.order]
+                    + [self._mom[n] for n in self.order]):
+            np.copyto(dst, flat[off:off + dst.size])
+            off += dst.size
+        if off != flat.size:
+            raise ValueError(
+                f"checkpoint state has {flat.size} elements, trainer "
+                f"needs {off}")
+
+    def save(self, path: str) -> None:
+        """Collectively checkpoint params + momentum + step, sharded
+        1/nranks (PR 8 CRC'd format): rank r writes slice r of the packed
+        global state.  Any later world can :meth:`load` it back."""
+        full = self._pack_state()
+        parts = np.array_split(full, self.comm.size())
+        _ckpt.save_sharded(
+            path, {"step": np.array([self.step_count], dtype=np.int64),
+                   "state": parts[self.comm.rank()]}, self.comm)
+
+    def load(self, path: str) -> int:
+        """Restore from :meth:`save`, resharding when the writer world
+        differs from (or was replaced relative to) this one: every rank
+        reads ALL shards and reassembles the global packed state.
+        Returns the restored step count."""
+        shards = _ckpt.load_all_shards(path, self.comm)
+        self._unpack_state(np.concatenate([s["state"] for s in shards]))
+        self.step_count = int(shards[0]["step"][0])
+        _pv.note_train(reshards=1)
+        return self.step_count

@@ -1,0 +1,149 @@
+"""ZeRO-style sharded-state data parallelism (`TPU_MPI_TRAIN_SHARD_STATE`).
+
+Where :class:`~tpu_mpi.train.ddp.DDPTrainer` replicates the optimizer
+state on every rank, this trainer shards it 1/nranks (ZeRO stage ~2 over
+the host path, after SNIPPETS [3]'s ``shard_params`` mesh partitioning):
+
+- master params live in ONE padded flat vector; rank r owns slice r;
+- the per-step fold is ``Reduce_scatter_block`` (each rank receives only
+  the reduced gradient for its own slice), an in-place SGD(momentum)
+  update of just that slice, then an IN_PLACE ``Allgather`` that
+  republishes the updated slices into every rank's full flat;
+- the momentum buffer — the real optimizer state — is slice-sized, so
+  peak optimizer-state bytes scale ~1/nranks vs DDP
+  (:meth:`opt_state_bytes`, asserted in tests and the benchmark lane).
+
+All buffers are preallocated in ``__init__``; the step path copies into
+preexisting views and folds in place, allocating nothing (SNIPPETS
+[1]/[2] donate discipline).  The gradient mean divides by nranks BEFORE
+the momentum fold, exactly like the DDP fold, so a same-seed FSDP run
+tracks the DDP loss curve.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from .. import perfvars as _pv
+from .. import checkpoint as _ckpt
+from ..buffers import IN_PLACE
+from ..collective import Allgather, Bcast, Reduce_scatter_block
+from ..operators import SUM
+
+__all__ = ["FSDPTrainer"]
+
+
+class FSDPTrainer:
+    """Sharded-state SGD(momentum) over one comm.
+
+    Same thin contract as DDP: the caller feeds ``(name, grad)`` pairs
+    per step (any order — FSDP folds once over the whole flat, so there
+    is no bucket schedule to respect).
+    """
+
+    def __init__(self, params: Dict[str, np.ndarray], comm, *,
+                 lr: float = 0.1, momentum: float = 0.9) -> None:
+        self.comm = comm
+        self.lr = float(lr)
+        self.momentum = float(momentum)
+        size = comm.size()
+        self.order: List[str] = list(params)
+        shapes = {n: np.asarray(params[n]).shape for n in self.order}
+        counts = {n: int(np.prod(shapes[n], dtype=np.int64)) or 1
+                  for n in self.order}
+        n = sum(counts.values())
+        self._n = n
+        self._padded = ((n + size - 1) // size) * size
+        self._shard = self._padded // size
+        lo = comm.rank() * self._shard
+
+        # ONE padded flat for master params; per-param shaped views
+        self._flat = np.zeros(self._padded, dtype=np.float64)
+        self.params: Dict[str, np.ndarray] = {}
+        off = 0
+        for name in self.order:
+            c = counts[name]
+            view = self._flat[off:off + c]
+            np.copyto(view, np.asarray(params[name],
+                                       dtype=np.float64).reshape(-1))
+            self.params[name] = view.reshape(shapes[name])
+            off += c
+        Bcast(self._flat, 0, comm)
+
+        # padded flat gradient staging + per-param pack views
+        self._gradflat = np.zeros(self._padded, dtype=np.float64)
+        self._gviews = {}
+        off = 0
+        for name in self.order:
+            c = counts[name]
+            self._gviews[name] = self._gradflat[off:off + c]
+            off += c
+
+        # shard-sized state: the reduced grad landing zone and the
+        # momentum buffer (THE optimizer state that shards 1/nranks)
+        self._gshard = np.zeros(self._shard, dtype=np.float64)
+        self._mshard = np.zeros(self._shard, dtype=np.float64)
+        self._my_slice = self._flat[lo:lo + self._shard]
+        self.step_count = 0
+
+    def step(self, grads: Iterable[Tuple[str, np.ndarray]]) -> None:
+        """One sharded optimizer step; mutates params in place."""
+        t_step = time.perf_counter_ns()
+        for name, grad in grads:
+            v = self._gviews[name]
+            np.copyto(v, np.asarray(grad, dtype=np.float64).reshape(-1))
+        t0 = time.perf_counter_ns()
+        Reduce_scatter_block(self._gradflat, self._gshard, SUM, self.comm)
+        self._gshard *= 1.0 / self.comm.size()
+        self._mshard *= self.momentum
+        self._mshard += self._gshard
+        np.multiply(self._mshard, self.lr, out=self._gshard)
+        self._my_slice -= self._gshard
+        Allgather(IN_PLACE, self._flat, self._shard, self.comm)
+        t1 = time.perf_counter_ns()
+        self.step_count += 1
+        _pv.note_train(bucket_flushes=1, wait_ns=t1 - t0,
+                       comm_window_ns=t1 - t0)
+        _pv.note_train_step(time.perf_counter_ns() - t_step)
+
+    def opt_state_bytes(self) -> int:
+        """Optimizer-state footprint: the shard-sized momentum buffer —
+        ~1/nranks of the DDP equivalent."""
+        return int(self._mshard.nbytes)
+
+    # -- checkpoint / reshard ----------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Checkpoint this rank's OWN slice of params + momentum (the
+        natural ZeRO sharding: no gather, no replication)."""
+        _ckpt.save_sharded(
+            path, {"step": np.array([self.step_count], dtype=np.int64),
+                   "params": self._my_slice.copy(),
+                   "mom": self._mshard.copy(),
+                   "n": np.array([self._n], dtype=np.int64)}, self.comm)
+
+    def load(self, path: str) -> int:
+        """Restore from :meth:`save`, resharding across a different world
+        size: reassemble the writers' global flats, then re-slice for
+        this comm."""
+        shards = _ckpt.load_all_shards(path, self.comm)
+        pfull = np.concatenate([s["params"] for s in shards])
+        mfull = np.concatenate([s["mom"] for s in shards])
+        n = int(shards[0]["n"][0])
+        if n != self._n:
+            raise ValueError(
+                f"checkpoint holds {n} params, trainer has {self._n}")
+        lo = self.comm.rank() * self._shard
+        # writers may have padded to a different multiple: only the first
+        # n elements are real state, the rest re-zeroes
+        self._flat[:n] = pfull[:n]
+        self._flat[n:] = 0.0
+        mglobal = np.zeros(self._padded, dtype=np.float64)
+        mglobal[:n] = mfull[:n]
+        np.copyto(self._mshard, mglobal[lo:lo + self._shard])
+        self.step_count = int(shards[0]["step"][0])
+        _pv.note_train(reshards=1)
+        return self.step_count
