@@ -1,0 +1,114 @@
+"""End-to-end request trace of one tenant ``generate`` through the serve
+stack — the committed evidence for docs/observability.md "request tracing".
+
+What it exercises, all in one process (thread backend, cpu-sim):
+
+    session.generate()  ->  Router (splice)  ->  Broker admission
+        ->  fair-queue wait  ->  InferScheduler  ->  per-rank engine steps
+
+With ``TPU_MPI_TRACE_SAMPLE=1`` the session mints a trace context in the
+HELLO/OP metadata, the router stamps its splice span, the broker brackets
+admission and the queue wait, and every rank's op scope hangs its phase
+spans (rendezvous/fold/copy) under the same trace id. The script drains
+the span buffer, checks the tree is whole — ONE trace id spanning client,
+router, broker, and rank lanes with monotone timestamps — and writes the
+Chrome-trace rendering (``analyze.timeline.spans_to_chrome``) as the
+artifact CI schema-gates.
+
+Run:
+    python benchmarks/trace_serve.py \
+        [--json benchmarks/results/trace-serve-cpusim.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# sample every request and keep pvars on so rank op scopes emit phase spans
+os.environ["TPU_MPI_TRACE_SAMPLE"] = "1"
+os.environ["TPU_MPI_PVARS"] = "1"
+
+
+def run(nranks: int = 4) -> tuple[dict, list]:
+    from tpu_mpi import serve, tracectx
+    from tpu_mpi.serve.router import Router
+
+    tracectx.reset()                      # start from an empty buffer
+    b = serve.Broker(nranks=nranks, token="trace", infer=True)
+    b.run_in_thread()
+    router = Router([b.address], token="trace", mode="splice")
+    router.run_in_thread()
+    try:
+        with serve.attach(router.address, tenant="trace-demo",
+                          token="trace") as s:
+            toks = s.generate([1, 2, 3, 4, 5, 6, 7], max_new=8)
+            assert len(toks) == 8
+        spans = tracectx.drain()
+    finally:
+        router.close()
+        b.close()
+
+    roots = [s for s in spans
+             if s["name"] == "client:generate" and s["parent"] is None]
+    assert len(roots) == 1, f"want one generate root, got {len(roots)}"
+    tid = roots[0]["trace"]
+    tree = [s for s in spans if s["trace"] == tid]
+    whos = {s["who"] for s in tree}
+    names = {s["name"] for s in tree}
+    assert "client" in whos and "broker" in whos, whos
+    assert any(w.startswith("rank ") for w in whos), whos
+    assert "broker:generate" in names, names
+    assert "queue" in names, names            # fair-queue wait bracket
+    phases = {s["name"] for s in tree
+              if any(s["name"] == p for p in ("rendezvous", "fold", "copy"))}
+    assert phases, f"no rank phase spans in {sorted(names)}"
+    # every span closed, timestamps sane, parents resolve inside the tree
+    sids = {s["span"] for s in tree}
+    for s in tree:
+        assert s["t1"] is not None and s["t1"] >= s["t0"], s
+        assert s["parent"] is None or s["parent"] in sids, s
+    # the router hop: a splicing router forwards op frames as raw bytes
+    # (it cannot stamp per-op spans without parsing them), so its splice
+    # span lives in the session's ATTACH trace and the generate root
+    # links to it — follow the link, the route must be there
+    attach_tid = roots[0].get("link")
+    assert attach_tid, "generate root carries no attach-trace link"
+    route = [s for s in spans if s["trace"] == attach_tid]
+    route_names = {s["name"] for s in route}
+    assert "router:splice" in route_names, route_names
+    assert "client:attach" in route_names, route_names
+    both = tree + route
+    summary = {"trace_id": tid, "attach_trace_id": attach_tid,
+               "spans": len(tree), "route_spans": len(route),
+               "whos": sorted(whos), "phases": sorted(phases),
+               "nranks": nranks,
+               "status_error": sum(1 for s in both
+                                   if s["status"] != "ok")}
+    return summary, both
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--nranks", type=int, default=4)
+    ap.add_argument("--json", default=None,
+                    help="write the Chrome-trace span rendering here")
+    args = ap.parse_args()
+    summary, tree = run(args.nranks)
+    print(json.dumps(summary, indent=2))
+    if args.json:
+        from tpu_mpi.analyze import timeline
+        timeline.write_spans(args.json, tree)
+        print(f"trace -> {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -137,3 +137,42 @@ def test_capability_tables():
         assert {"ici_gbps", "hbm_gbps", "hbm_gib", "cores", "bf16_tflops"} <= set(row)
     assert capabilities("v5e")["hbm_gbps"] == 819.0
     assert capabilities("nonsense")["hbm_gbps"] == 819.0  # fallback row
+
+
+def test_telemetry_knob_defaults(clean_env):
+    cfg = config.load(refresh=True)
+    assert cfg.trace_sample == 0.0          # tracing is opt-in
+    assert cfg.flight_ring == 256           # flight recorder is always-on
+    assert cfg.flight_dir == ""             # "" -> tempdir at dump time
+    assert cfg.serve_slo_us == 0            # no fleet-wide objective
+
+
+@pytest.mark.parametrize("var,bad", [
+    ("TPU_MPI_TRACE_SAMPLE", "1.5"),
+    ("TPU_MPI_TRACE_SAMPLE", "-0.1"),
+    ("TPU_MPI_TRACE_SAMPLE", "yes"),
+    ("TPU_MPI_FLIGHT_RING", "-1"),
+    ("TPU_MPI_FLIGHT_RING", "many"),
+    ("TPU_MPI_PVARS_HIST_BINS", "0"),
+    ("TPU_MPI_PVARS_HIST_BINS", "-3"),
+    ("TPU_MPI_SERVE_SLO_US", "-500"),
+])
+def test_telemetry_knobs_fail_loudly(clean_env, monkeypatch, var, bad):
+    """Satellite: a bad telemetry knob is an MPIError at load, not a
+    silently-ignored string — misconfigured observability must not look
+    like observability."""
+    monkeypatch.setenv(var, bad)
+    with pytest.raises(MPIError):
+        config.load(refresh=True)
+    monkeypatch.delenv(var)
+    config.load(refresh=True)               # and the cache recovers
+
+
+def test_telemetry_knobs_good_values(clean_env, monkeypatch):
+    monkeypatch.setenv("TPU_MPI_TRACE_SAMPLE", "0.25")
+    monkeypatch.setenv("TPU_MPI_FLIGHT_RING", "0")      # 0 disables
+    monkeypatch.setenv("TPU_MPI_SERVE_SLO_US", "2000")
+    cfg = config.load(refresh=True)
+    assert cfg.trace_sample == 0.25
+    assert cfg.flight_ring == 0
+    assert cfg.serve_slo_us == 2000

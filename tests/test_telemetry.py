@@ -1,0 +1,602 @@
+"""The telemetry plane (ISSUE 20, docs/observability.md): request-scoped
+tracing edges, the crash flight recorder, Prometheus live export, and the
+SLO burn-rate grow signal.
+
+The tracing edge tests pin the propagation invariants: sampling changes
+NOTHING about results (bitwise), the context survives both router modes,
+a revoked lease closes its spans with error status, and concurrent load
+never cross-wires span parenting."""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import tpu_mpi
+from tpu_mpi import config, flight, serve, stats, tracectx
+from tpu_mpi.error import MPIError
+from tpu_mpi.serve import protocol
+from tpu_mpi.serve.router import Router
+
+TOKEN = "hunter2"
+
+
+@pytest.fixture
+def sampled(monkeypatch):
+    """Every request traced; restores the config snapshot afterwards."""
+    monkeypatch.setenv("TPU_MPI_TRACE_SAMPLE", "1")
+    config.load(refresh=True)
+    tracectx.reset()
+    yield
+    monkeypatch.delenv("TPU_MPI_TRACE_SAMPLE", raising=False)
+    config.load(refresh=True)
+    tracectx.reset()
+
+
+@pytest.fixture
+def flight_tmp(tmp_path, monkeypatch):
+    """Small ring dumping into tmp_path; reset before and after."""
+    monkeypatch.setenv("TPU_MPI_FLIGHT_RING", "32")
+    monkeypatch.setenv("TPU_MPI_FLIGHT_DIR", str(tmp_path))
+    config.load(refresh=True)
+    flight.reset()
+    yield tmp_path
+    monkeypatch.delenv("TPU_MPI_FLIGHT_RING", raising=False)
+    monkeypatch.delenv("TPU_MPI_FLIGHT_DIR", raising=False)
+    config.load(refresh=True)
+    flight.reset()
+
+
+def _attach(broker_or_addr, **kw):
+    addr = getattr(broker_or_addr, "address", broker_or_addr)
+    kw.setdefault("token", TOKEN)
+    return serve.attach(addr, **kw)
+
+
+def _tree(spans, trace_id):
+    return [s for s in spans if s["trace"] == trace_id]
+
+
+# ---------------------------------------------------------------------------
+# TraceCtx unit surface
+# ---------------------------------------------------------------------------
+
+def test_tracectx_meta_roundtrip(sampled):
+    ctx, rec = tracectx.start_root("client:op", "client")
+    assert ctx is not None and ctx.sampled
+    back = tracectx.TraceCtx.from_meta({"trace": ctx.to_meta()})
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id and back.sampled
+    tracectx.end_span(rec)
+    (only,) = tracectx.drain(ctx.trace_id)
+    assert only["span"] == ctx.span_id and only["status"] == "ok"
+    assert tracectx.TraceCtx.from_meta({}) is None
+    assert tracectx.TraceCtx.from_meta({"trace": "garbage"}) is None
+
+
+def test_unsampled_is_free():
+    config.load(refresh=True)              # trace_sample defaults to 0
+    assert not tracectx.enabled()
+    ctx, rec = tracectx.start_root("client:op", "client")
+    assert ctx is None and rec is None
+    tracectx.end_span(rec)                 # no-op, no crash
+
+
+# ---------------------------------------------------------------------------
+# Propagation edges (satellite d)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def broker2():
+    b = serve.Broker(nranks=2, token=TOKEN)
+    b.run_in_thread()
+    yield b
+    b.close()
+
+
+def test_sampled_vs_unsampled_bitwise_identical(broker2, monkeypatch):
+    """Tracing must be a pure observer: the same Allreduce, sampled and
+    unsampled, returns bitwise-identical bytes."""
+    x = np.linspace(-3, 7, 64, dtype=np.float32)
+    monkeypatch.setenv("TPU_MPI_TRACE_SAMPLE", "1")
+    config.load(refresh=True)
+    tracectx.reset()
+    try:
+        with _attach(broker2, tenant="bit-on") as s:
+            on = s.allreduce(x)
+        spans = tracectx.drain()
+        assert any(sp["name"] == "client:allreduce" for sp in spans)
+        monkeypatch.setenv("TPU_MPI_TRACE_SAMPLE", "0")
+        config.load(refresh=True)
+        tracectx.reset()
+        with _attach(broker2, tenant="bit-off") as s:
+            off = s.allreduce(x)
+        assert not tracectx.drain()
+    finally:
+        monkeypatch.delenv("TPU_MPI_TRACE_SAMPLE", raising=False)
+        config.load(refresh=True)
+    assert on.dtype == off.dtype
+    assert on.tobytes() == off.tobytes()
+
+
+def test_trace_covers_queue_and_ranks(broker2, sampled):
+    with _attach(broker2, tenant="cover") as s:
+        s.allreduce(np.ones(16, np.float32))
+    spans = tracectx.drain()
+    root = next(sp for sp in spans if sp["name"] == "client:allreduce")
+    tree = _tree(spans, root["trace"])
+    names = {sp["name"] for sp in tree}
+    whos = {sp["who"] for sp in tree}
+    assert "broker:allreduce" in names and "queue" in names
+    assert {"rank 0", "rank 1"} <= whos or "client" in whos  # pvars may be off
+    # parenting is a tree rooted at the client span
+    sids = {sp["span"] for sp in tree}
+    for sp in tree:
+        assert sp["parent"] is None or sp["parent"] in sids
+
+
+def test_trace_survives_router_redirect(broker2, sampled):
+    router = Router([broker2.address], token=TOKEN, mode="redirect")
+    router.run_in_thread()
+    try:
+        with _attach(router.address, tenant="via-redirect") as s:
+            assert s.allreduce(np.ones(4))[0] == 2.0
+    finally:
+        router.close()
+    spans = tracectx.drain()
+    root = next(sp for sp in spans if sp["name"] == "client:attach")
+    tree = _tree(spans, root["trace"])
+    names = {sp["name"] for sp in tree}
+    # ONE trace id covers the redirected handshake: the router's answer
+    # span and the home broker's attach span both joined it
+    assert "router:redirect" in names
+    assert "broker:attach" in names
+    assert root.get("hops") == 2           # client followed one redirect
+
+
+def test_trace_survives_router_splice(broker2, sampled):
+    router = Router([broker2.address], token=TOKEN, mode="splice")
+    router.run_in_thread()
+    try:
+        with _attach(router.address, tenant="via-splice") as s:
+            s.allreduce(np.ones(4))
+    finally:
+        router.close()
+    spans = tracectx.drain()
+    attach_root = next(sp for sp in spans if sp["name"] == "client:attach")
+    attach_names = {sp["name"] for sp in _tree(spans, attach_root["trace"])}
+    assert "router:splice" in attach_names and "broker:attach" in attach_names
+    # the op trace flowed THROUGH the splice to the broker untouched,
+    # and its root links back to the routed attach trace
+    op_root = next(sp for sp in spans if sp["name"] == "client:allreduce")
+    op_names = {sp["name"] for sp in _tree(spans, op_root["trace"])}
+    assert "broker:allreduce" in op_names
+    assert op_root.get("link") == attach_root["trace"]
+
+
+def test_revoked_lease_closes_spans_with_error(sampled):
+    """Ops queued behind a paused dispatcher when the lease is revoked
+    must close their client AND broker spans with error status."""
+    b = serve.Broker(nranks=2, token=TOKEN)
+    b.run_in_thread()
+    try:
+        s = _attach(b, tenant="doomed")
+        b.fq.pause()
+        errs = []
+
+        def op():
+            try:
+                s.allreduce(np.ones(4))
+            except Exception as e:          # noqa: BLE001
+                errs.append(e)
+
+        t = threading.Thread(target=op)
+        t.start()
+        deadline = time.monotonic() + 5
+        while not b.fq.stats()["tenants"].get("doomed", {}).get("queued"):
+            assert time.monotonic() < deadline, "op never queued"
+            time.sleep(0.005)
+        with b._lease_lock:
+            lease = b._leases["doomed"]
+        b.revoke_lease(lease, "test chaos")
+        t.join(timeout=10)
+        assert errs, "revocation did not surface to the client"
+    finally:
+        b.fq.resume()
+        b.close()
+    spans = tracectx.drain()
+    root = next(sp for sp in spans if sp["name"] == "client:allreduce")
+    assert root["status"] == "error"
+    tree = _tree(spans, root["trace"])
+    broker_side = [sp for sp in tree if sp["who"] == "broker"]
+    assert broker_side and all(sp["status"] == "error" for sp in broker_side)
+
+
+def test_concurrent_load_keeps_parenting(sampled):
+    """Backpressure/interleaving on the event-driven front door must not
+    cross-wire parents: every trace stays a closed tree with one root."""
+    b = serve.Broker(nranks=2, token=TOKEN, transport="events")
+    b.run_in_thread()
+    try:
+        def worker(i):
+            with _attach(b, tenant=f"load{i}") as s:
+                for _ in range(5):
+                    s.allreduce(np.ones(8, np.float32))
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        b.close()
+    spans = tracectx.drain()
+    by_trace = {}
+    for sp in spans:
+        by_trace.setdefault(sp["trace"], []).append(sp)
+    op_trees = 0
+    for tree in by_trace.values():
+        roots = [sp for sp in tree if sp["parent"] is None]
+        assert len(roots) == 1, f"trace with {len(roots)} roots"
+        sids = {sp["span"] for sp in tree}
+        whos = {sp["who"] for sp in tree}
+        for sp in tree:
+            assert sp["parent"] is None or sp["parent"] in sids
+            assert sp["t1"] is not None
+        if roots[0]["name"] == "client:allreduce":
+            op_trees += 1
+            assert "broker" in whos
+    assert op_trees == 20                  # 4 tenants x 5 ops, none merged
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder (tentpole part 2)
+# ---------------------------------------------------------------------------
+
+def test_flight_disabled_is_inert(monkeypatch):
+    monkeypatch.setenv("TPU_MPI_FLIGHT_RING", "0")
+    config.load(refresh=True)
+    flight.reset()
+    try:
+        assert not flight.enabled()
+        flight.note("anything", detail=1)
+        assert flight.auto_dump("whatever") is None
+    finally:
+        monkeypatch.delenv("TPU_MPI_FLIGHT_RING", raising=False)
+        config.load(refresh=True)
+        flight.reset()
+
+
+def test_flight_ring_bounds_and_orders(flight_tmp):
+    for i in range(100):
+        flight.note("tick", seq=i)
+    snap = flight._get_ring().snapshot()
+    assert len(snap) == 32                 # capacity, not 100
+    seqs = [r["seq"] for r in snap]
+    assert seqs == sorted(seqs) and seqs[-1] == 99   # newest survive
+
+
+def test_flight_dump_crc_roundtrip_and_render(flight_tmp):
+    flight.note("op_dispatch", tenant="t0", op="allreduce")
+    flight.note("error", type="ProcFailedError", code=69)
+    path = flight.dump(str(flight_tmp / "dump.json"), reason="unit")
+    payload = flight.read_dump(path)
+    assert payload["reason"] == "unit"
+    kinds = [e["kind"] for e in payload["events"]]
+    assert kinds == ["op_dispatch", "error"]
+    text = flight.render(payload)
+    assert "op_dispatch" in text and "tenant=t0" in text
+    # flip a byte in the body: the CRC check must refuse it
+    raw = json.loads(open(path).read())
+    raw["events"][0]["tenant"] = "tampered"
+    open(path, "w").write(json.dumps(raw))
+    with pytest.raises(ValueError, match="CRC"):
+        flight.read_dump(path)
+
+
+def test_fatal_error_construction_auto_dumps(flight_tmp):
+    from tpu_mpi.error import ProcFailedError
+    flight.note("op_dispatch", tenant="t1", op="bcast")
+    ProcFailedError("rank 1 died mid-bcast")   # construction hooks the dump
+    dumps = [p for p in os.listdir(flight_tmp) if p.startswith("flight-")]
+    assert len(dumps) == 1
+    payload = flight.read_dump(str(flight_tmp / dumps[0]))
+    assert payload["reason"] == "error-ProcFailedError"
+    kinds = [e["kind"] for e in payload["events"]]
+    assert "op_dispatch" in kinds and "error" in kinds
+    err = next(e for e in payload["events"] if e["kind"] == "error")
+    assert err["type"] == "ProcFailedError" and err["code"] == 69
+
+
+def test_nonfatal_error_notes_but_never_dumps(flight_tmp):
+    with pytest.raises(MPIError):
+        raise MPIError("just an argument problem", code=13)
+    assert not [p for p in os.listdir(flight_tmp) if p.startswith("flight-")]
+    kinds = [r["kind"] for r in flight._get_ring().snapshot()]
+    assert "error" in kinds
+
+
+def test_analyze_flight_cli(flight_tmp):
+    flight.note("lease_revoke", tenant="cli", reason="test")
+    path = flight.dump(str(flight_tmp / "cli.json"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "tpu_mpi.analyze", "flight", path],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "lease_revoke" in out.stdout and "tenant=cli" in out.stdout
+
+
+def test_revocation_notes_land_in_ring(flight_tmp):
+    b = serve.Broker(nranks=2, token=TOKEN)
+    b.run_in_thread()
+    try:
+        _attach(b, tenant="noted").detach()
+    finally:
+        b.close()
+    kinds = [r["kind"] for r in flight._get_ring().snapshot()]
+    assert "lease_revoke" in kinds        # detach goes through revoke path
+
+
+# ---------------------------------------------------------------------------
+# Live export: Prometheus text + watch mode (tentpole part 3)
+# ---------------------------------------------------------------------------
+
+def test_prometheus_roundtrip_unit():
+    report = {
+        "tenants": {"t0": {"ops": 7, "slo": {"burn": 1.5}},
+                    "t-two": {"ops": 0}},
+        "queue": {"dispatched": 12, "depth": 0, "paused": False},
+        "weird": float("nan"),            # non-finite: skipped, not emitted
+        "name": "broker-1",               # strings: skipped
+    }
+    text = stats.to_prometheus(report)
+    assert text.endswith("\n")
+    parsed = stats.parse_prometheus(text)
+    assert parsed['tpu_mpi_tenant_ops{tenant="t0"}'] == 7.0
+    assert parsed['tpu_mpi_tenant_slo_burn{tenant="t0"}'] == 1.5
+    assert parsed["tpu_mpi_queue_dispatched"] == 12.0
+    assert parsed["tpu_mpi_queue_paused"] == 0.0
+    assert not any("weird" in k or "name" in k for k in parsed)
+    with pytest.raises(ValueError):
+        stats.parse_prometheus("this is not exposition format\n")
+
+
+def test_metrics_frame_on_both_transports():
+    from tpu_mpi.serve.broker import _metrics_client
+    for transport in ("threads", "events"):
+        b = serve.Broker(nranks=2, token=TOKEN, transport=transport)
+        b.run_in_thread()
+        try:
+            with _attach(b, tenant="m0") as s:
+                s.allreduce(np.ones(4))
+                text = _metrics_client(b.address, TOKEN)
+        finally:
+            b.close()
+        parsed = stats.parse_prometheus(text)
+        assert parsed.get("tpu_mpi_pool_nranks") == 2.0, (transport, text)
+        assert any(k.startswith("tpu_mpi_") and 'tenant="m0"' in k
+                   for k in parsed), transport
+
+
+def test_metrics_frame_rejects_bad_token():
+    from tpu_mpi.serve.broker import _metrics_client
+    b = serve.Broker(nranks=2, token=TOKEN)
+    b.run_in_thread()
+    try:
+        with pytest.raises(MPIError):
+            _metrics_client(b.address, "wrong")
+    finally:
+        b.close()
+
+
+def test_watch_fleet_streams_deltas_and_tolerates_dead_broker():
+    healthy = {"address": "a:1",
+               "queue": {"dispatched": 10, "rejected_busy": 0},
+               "totals": {"bytes_sent": 100},
+               "ledger": {"tenants": {"t0": {"slo": {
+                   "burn": 1.5, "miss_frac": 0.015, "budget": 0.01,
+                   "target_us": 2000, "ops": 40}}}}}
+    later = json.loads(json.dumps(healthy))
+    later["queue"]["dispatched"] = 25
+    frames = iter([[healthy, {"address": "b:2", "error": "conn refused"}],
+                   [later]])
+    out = io.StringIO()
+    rc = stats.watch_fleet(lambda: next(frames), interval=0.01,
+                           iterations=2, out=out, sleep=lambda s: None)
+    assert rc == 0                         # broker main uses it as exit code
+    text = out.getvalue()
+    assert "a:1" in text and "ERROR" in text and "conn refused" in text
+    assert "+15" in text                   # second frame shows the delta
+    assert "burn 1.50" in text             # SLO plane rendered per tenant
+
+
+def test_aggregate_handles_empty_and_partial_records():
+    """Satellite a: mid-stream broker death leaves partial records; the
+    aggregator must not throw on any of them."""
+    assert stats.aggregate([])["nranks"] == []
+    partials = [{}, {"comms": None}, {"address": "x", "error": "dead"},
+                {"comms": [], "plan_cache": None}]
+    agg = stats.aggregate(partials)
+    assert agg["nranks"] == [] and agg["totals"]["bytes_sent"] == 0
+    merged = stats.aggregate([
+        {"comms": [{"size": 2, "bytes_sent": 10, "sends": 1}]},
+        {"address": "gone", "error": "unreachable"},
+    ])
+    assert merged["totals"]["bytes_sent"] == 10
+    assert merged["nranks"] == [2]
+
+
+# ---------------------------------------------------------------------------
+# SLO burn rate (tentpole part 4)
+# ---------------------------------------------------------------------------
+
+def test_slo_row_math():
+    from tpu_mpi.serve.ledger import Ledger
+    obj = {"target_us": 1000, "budget": 0.01}
+    # log2-us buckets: bucket 11 covers [1024, 2048)us -> fully missed
+    hist = [0] * 24
+    hist[5] = 90                           # [16, 32)us: hits
+    hist[11] = 10                          # misses
+    row = Ledger._slo_row(hist, obj)
+    assert row["ops"] == 100 and row["misses"] == 10
+    assert row["miss_frac"] == 0.1
+    assert row["burn"] == 10.0             # 0.1 / 0.01
+    assert Ledger._slo_row([0] * 24, obj)["burn"] == 0.0
+
+
+def test_set_objective_validates():
+    b = serve.Broker(nranks=2, token=TOKEN)
+    try:
+        with pytest.raises(MPIError):
+            b.ledger.set_objective("t", target_us=0)
+        with pytest.raises(MPIError):
+            b.ledger.set_objective("t", target_us=100, budget=0.0)
+        with pytest.raises(MPIError):
+            b.ledger.set_objective("t", target_us=100, budget=1.5)
+        b.ledger.set_objective("t", target_us=100, budget=0.05)
+    finally:
+        b.close()
+
+
+def test_slo_burn_reported_and_triggers_elastic_grow(monkeypatch):
+    """The acceptance lane: measured latencies that bust a (deliberately
+    impossible) objective must surface burn > 1 in the ledger report and
+    grow the pool through the elastic controller with reason 'slo burn'."""
+    from tpu_mpi.elastic import ElasticController
+    for k, v in (("INTERVAL_MS", "3600000"), ("COOLDOWN_MS", "0"),
+                 ("HYSTERESIS", "1"), ("MAX_RANKS", "3")):
+        monkeypatch.setenv(f"TPU_MPI_ELASTIC_{k}", str(v))
+    monkeypatch.setenv("TPU_MPI_PVARS", "1")
+    config.load(refresh=True)
+    b = serve.Broker(nranks=2, token=TOKEN)
+    b.run_in_thread()
+    try:
+        ctrl = ElasticController(b)        # not started: ticks by hand
+        b.ledger.set_objective("burny", target_us=1)   # everything misses
+        with _attach(b, tenant="burny") as s:
+            for _ in range(8):
+                s.allreduce(np.ones(256, np.float64))
+            s.pcontrol(2)                  # flush measured books
+        rep = b.ledger.report()
+        slo = rep["tenants"]["burny"].get("slo")
+        assert slo is not None and slo["ops"] >= 8
+        assert slo["burn"] > 1.0
+        assert b.ledger.max_burn_rate() == slo["burn"]
+        assert b.elastic_state["resizes"] == 0
+        ctrl._tick()                       # hysteresis=1: grows immediately
+        assert b.elastic_state["resizes"] == 1
+        last = b.elastic_state["last_resize"]
+        assert last["reason"] == "slo burn" and last["grew"] == 1
+        assert b.pool.healthy() == [0, 1, 2]
+        assert b.elastic_state["signals"]["slo_burn"] == slo["burn"]
+    finally:
+        b.close()
+        for k in ("INTERVAL_MS", "COOLDOWN_MS", "HYSTERESIS", "MAX_RANKS"):
+            monkeypatch.delenv(f"TPU_MPI_ELASTIC_{k}", raising=False)
+        monkeypatch.delenv("TPU_MPI_PVARS", raising=False)
+        config.load(refresh=True)
+
+
+def test_fleet_default_objective_from_config(monkeypatch):
+    monkeypatch.setenv("TPU_MPI_SERVE_SLO_US", "1")
+    monkeypatch.setenv("TPU_MPI_PVARS", "1")
+    config.load(refresh=True)
+    b = serve.Broker(nranks=2, token=TOKEN)
+    b.run_in_thread()
+    try:
+        with _attach(b, tenant="fleet") as s:
+            for _ in range(4):
+                s.allreduce(np.ones(64))
+            s.pcontrol(2)
+        rep = b.ledger.report()
+        slo = rep["tenants"]["fleet"].get("slo")
+        assert slo is not None and slo["target_us"] == 1
+        assert slo["burn"] > 1.0           # 1us objective: all real ops miss
+    finally:
+        b.close()
+        monkeypatch.delenv("TPU_MPI_SERVE_SLO_US", raising=False)
+        monkeypatch.delenv("TPU_MPI_PVARS", raising=False)
+        config.load(refresh=True)
+
+
+# ---------------------------------------------------------------------------
+# Timeline schema (satellite b)
+# ---------------------------------------------------------------------------
+
+def test_chrome_schema_v2_names_lanes():
+    from tpu_mpi.analyze import timeline
+    evs = [{"kind": "coll", "rank": 0, "op": "allreduce", "cid": 1, "seq": 0,
+            "peer": None, "tag": None, "count": 4, "dtype": "f32",
+            "algo": "star", "t": None, "t_start": 1.0, "t_end": 1.1,
+            "phases": [("fold", 1.01, 1.02)]},
+           {"kind": "serve", "rank": -1, "op": "dispatch", "cid": None,
+            "seq": 1, "peer": None, "tag": None, "count": None,
+            "dtype": None, "algo": None, "t": 1.05, "t_start": None,
+            "t_end": None, "phases": None}]
+    rec = timeline.to_chrome(evs)
+    assert rec["otherData"]["schema"] == timeline.SCHEMA == 2
+    meta = {(e["pid"], e["name"]): e["args"] for e in rec["traceEvents"]
+            if e["ph"] == "M"}
+    assert meta[(0, "process_name")] == {"name": "rank 0"}
+    assert meta[(0, "thread_name")] == {"name": "rank 0"}
+    assert meta[(-1, "process_name")] == {"name": "broker"}
+
+
+def test_spans_to_chrome_lanes_and_args(tmp_path):
+    from tpu_mpi.analyze import timeline
+    spans = [
+        {"trace": "t1", "span": "a", "parent": None, "name": "client:gen",
+         "who": "client", "t0": 10.0, "t1": 10.5, "status": "ok"},
+        {"trace": "t1", "span": "b", "parent": "a", "name": "gen",
+         "who": "rank 1", "t0": 10.1, "t1": 10.4, "status": "ok",
+         "nbytes": 64},
+        {"trace": "t1", "span": "c", "parent": "a", "name": "broker:gen",
+         "who": "broker", "t0": 10.05, "t1": None, "status": "ok"},
+    ]
+    rec = timeline.spans_to_chrome(spans)
+    assert rec["otherData"] == {"tool": "tpu_mpi.analyze.timeline",
+                                "schema": 2, "content": "spans"}
+    names = {e["args"]["name"]: e["pid"] for e in rec["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names["rank 1"] == 1            # rank lanes keep their rank pid
+    assert names["broker"] >= 1000 and names["client"] >= 1000
+    slices = {e["args"]["span"]: e for e in rec["traceEvents"]
+              if e["ph"] == "X"}
+    assert slices["b"]["args"]["parent"] == "a"
+    assert slices["b"]["args"]["nbytes"] == 64
+    assert slices["b"]["pid"] == 1
+    assert slices["c"]["args"]["status"] == "open"   # unfinished span
+    # writer round-trips through JSON on disk
+    path = timeline.write_spans(str(tmp_path / "spans.json"), spans)
+    assert json.load(open(path))["otherData"]["schema"] == 2
+
+
+def test_committed_serve_trace_artifact_schema():
+    """The committed artifact the CI job gates: one generate trace id
+    covering client, broker-queue, and rank phase spans."""
+    path = os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                        "results", "trace-serve-cpusim.json")
+    rec = json.load(open(path))
+    assert rec["otherData"]["schema"] == 2
+    assert rec["otherData"]["content"] == "spans"
+    slices = [e for e in rec["traceEvents"] if e["ph"] == "X"]
+    gen_root = next(e for e in slices if e["name"] == "client:generate")
+    tid = gen_root["args"]["trace"]
+    tree = [e for e in slices if e["args"]["trace"] == tid]
+    lanes = {e["pid"] for e in tree}
+    names = {e["name"] for e in tree}
+    assert {"broker:generate", "queue"} <= names
+    assert {0, 1, 2, 3} & lanes            # rank lanes carry phase spans
+    assert {"rendezvous", "fold"} & names
+    # the route (router splice) lives in the linked attach trace
+    link = gen_root["args"]["link"]
+    route = [e for e in slices if e["args"]["trace"] == link]
+    assert any(e["name"] == "router:splice" for e in route)

@@ -1177,6 +1177,13 @@ class FailureDetector:
                 continue
             age = tr.peer_age_ms(peer)
             if age == -2 or age > self.timeout_ms:
+                # flight recorder: the verdict itself is the crash-grade
+                # event — record it (and dump) before the declaration
+                # cascades into ProcFailedError raises on blocked waiters
+                from . import flight
+                flight.note("peer_declared_dead", peer=peer,
+                            age_ms=int(age), timeout_ms=self.timeout_ms)
+                flight.auto_dump("peer-failed")
                 ctx.peer_failed(peer)
 
 

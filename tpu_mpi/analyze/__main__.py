@@ -14,7 +14,10 @@ Commands:
   (:mod:`tpu_mpi.analyze.explore`); record one with ``TPU_MPI_TRACE=1
   TPU_MPI_TRACE_DUMP=<prefix>`` and pass the prefix here;
 - ``verify <trace prefix or files>`` — the cross-rank trace verifier
-  (:func:`tpu_mpi.analyze.matcher.verify_trace`) over dumped traces.
+  (:func:`tpu_mpi.analyze.matcher.verify_trace`) over dumped traces;
+- ``flight <dump.json>`` — CRC-verify and render a crash flight-recorder
+  dump (:mod:`tpu_mpi.flight`): the timeline of spans, lifecycle notes and
+  typed errors recorded in the seconds before the process died.
 
 Every command prints diagnostics and exits 1 if any were found.
 """
@@ -57,6 +60,21 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 1
         print("trace verifies clean")
         return 0
+    if cmd == "flight":
+        if not rest:
+            print("usage: python -m tpu_mpi.analyze flight <dump.json>")
+            return 2
+        from .. import flight
+        status = 0
+        for path in rest:
+            try:
+                payload = flight.read_dump(path)
+            except (OSError, ValueError, KeyError) as e:
+                print(f"{path}: {e}")
+                status = 1
+                continue
+            print(flight.render(payload))
+        return status
     print(f"unknown command {cmd!r}\n{_USAGE}")
     return 2
 

@@ -29,6 +29,11 @@ import os
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
+# Chrome-trace envelope version stamped in otherData. 2 added thread_name
+# metadata rows, named the synthetic broker lane, and the request-span
+# renderer (spans_to_chrome).
+SCHEMA = 2
+
 
 def clock_offsets(comm: Any, rounds: int = 5) -> List[float]:
     """Per-comm-rank clock offsets to rank 0 (collective: all ranks call).
@@ -108,12 +113,19 @@ def to_chrome(event_dicts: Sequence[dict],
     trace: List[dict] = []
     pids = sorted({d["rank"] for d in event_dicts})
     for pid in pids:
+        # negative pids are synthetic lanes (events.BROKER_RANK) — name
+        # them for what they are so Perfetto rows read "broker", not
+        # "rank -1"
+        lane = f"rank {pid}" if pid >= 0 else "broker"
         trace.append({"ph": "M", "pid": pid, "tid": 0,
                       "name": "process_name",
-                      "args": {"name": f"rank {pid}"}})
+                      "args": {"name": lane}})
         trace.append({"ph": "M", "pid": pid, "tid": 0,
                       "name": "process_sort_index",
                       "args": {"sort_index": pid}})
+        trace.append({"ph": "M", "pid": pid, "tid": 0,
+                      "name": "thread_name",
+                      "args": {"name": lane}})
     for d in event_dicts:
         rank = d["rank"]
         args = {k: d[k] for k in ("cid", "seq", "peer", "tag", "count",
@@ -144,13 +156,91 @@ def to_chrome(event_dicts: Sequence[dict],
                 "args": args,
             })
     return {"traceEvents": trace, "displayTimeUnit": "ms",
-            "otherData": {"tool": "tpu_mpi.analyze.timeline", "schema": 1}}
+            "otherData": {"tool": "tpu_mpi.analyze.timeline",
+                          "schema": SCHEMA}}
 
 
 def write_chrome(path: str, event_dicts: Sequence[dict],
                  offsets: Optional[Dict[int, float]] = None) -> str:
     """Write :func:`to_chrome` output as JSON; returns the path."""
     rec = to_chrome(event_dicts, offsets)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def _span_lane(who: str) -> Optional[int]:
+    """Rank whos ("rank 3") map onto the same pid as the event rows so a
+    request trace and an event trace merge into one timeline; other actors
+    get synthetic pids assigned by spans_to_chrome."""
+    if who.startswith("rank "):
+        try:
+            return int(who.split(None, 1)[1])
+        except ValueError:
+            return None
+    return None
+
+
+def spans_to_chrome(spans: Sequence[dict]) -> dict:
+    """Chrome trace-event JSON from request-span dicts
+    (:func:`tpu_mpi.tracectx.drain`).
+
+    One process row per actor: rank spans land on ``pid`` = world rank
+    (merging cleanly with :func:`to_chrome` rows); non-rank actors
+    (client, router, broker, serve workers) get deterministic pids from
+    1000 up in sorted-name order. Every span becomes a ph="X" complete
+    slice carrying its trace/span/parent ids and status in ``args``, so
+    Perfetto's flow queries (and the CI trace gate) can walk the request
+    tree across lanes. Open spans (t1 is None) render with their reason
+    visible: status "open" and a 1µs sliver at t0."""
+    spans = [s for s in spans if s.get("t0") is not None]
+    base = min((s["t0"] for s in spans), default=0.0)
+    whos = sorted({s["who"] for s in spans})
+    pid_of: Dict[str, int] = {}
+    synth = 1000
+    for who in whos:
+        lane = _span_lane(who)
+        if lane is None:
+            lane, synth = synth, synth + 1
+        pid_of[who] = lane
+    trace: List[dict] = []
+    for who in whos:
+        pid = pid_of[who]
+        trace.append({"ph": "M", "pid": pid, "tid": 0,
+                      "name": "process_name", "args": {"name": who}})
+        trace.append({"ph": "M", "pid": pid, "tid": 0,
+                      "name": "process_sort_index",
+                      "args": {"sort_index": pid}})
+        trace.append({"ph": "M", "pid": pid, "tid": 0,
+                      "name": "thread_name", "args": {"name": who}})
+    core = ("trace", "span", "parent", "name", "who", "t0", "t1")
+    for s in spans:
+        t1 = s.get("t1")
+        args = {"trace": s["trace"], "span": s["span"],
+                "parent": s.get("parent"),
+                "status": s.get("status", "ok") if t1 is not None else "open"}
+        args.update({k: v for k, v in s.items()
+                     if k not in core and k != "status" and v is not None})
+        dur = (t1 - s["t0"]) * 1e6 if t1 is not None else 1.0
+        trace.append({
+            "ph": "X", "pid": pid_of[s["who"]], "tid": 0,
+            "name": s["name"], "cat": "span",
+            "ts": round((s["t0"] - base) * 1e6, 3),
+            "dur": max(0.001, round(dur, 3)), "args": args,
+        })
+    return {"traceEvents": trace, "displayTimeUnit": "ms",
+            "otherData": {"tool": "tpu_mpi.analyze.timeline",
+                          "schema": SCHEMA, "content": "spans"}}
+
+
+def write_spans(path: str, spans: Sequence[dict]) -> str:
+    """Write :func:`spans_to_chrome` output as JSON; returns the path."""
+    rec = spans_to_chrome(spans)
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}"

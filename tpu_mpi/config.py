@@ -92,6 +92,27 @@ class Config:
     trace: bool = False
     # per-rank event ring-buffer capacity while tracing is on.
     trace_buffer: int = 4096
+    # request-scoped distributed tracing (docs/observability.md "Request
+    # traces"): fraction of serve-session ops that mint a trace context
+    # (trace_id + span parenting carried in frame metadata through router,
+    # front door, fair queue and per-rank phase spans). 0.0 (default)
+    # disables span recording entirely — ops carry no trace metadata and
+    # the hot path stays one generation-gated check. 1.0 samples all.
+    trace_sample: float = 0.0
+    # crash flight recorder (docs/observability.md "Flight recorder"):
+    # capacity of the always-on per-process ring of recent spans and
+    # typed-error/lifecycle events, auto-dumped on fatal errors and
+    # SIGTERM. 0 disables the recorder (and the auto-dump hooks).
+    flight_ring: int = 256
+    # directory flight-recorder auto-dumps are written into
+    # ("flight-<pid>-<reason>.json", CRC-stamped); "" = the system temp dir.
+    flight_dir: str = ""
+    # fleet-wide serve SLO (docs/observability.md "SLO burn-rate"): the
+    # per-op latency objective in microseconds applied to every tenant
+    # without an explicit Ledger.set_objective; at most 1% of a tenant's
+    # ops may take this long or longer before its burn rate crosses 1.0
+    # (an elastic grow signal). 0 = no objective.
+    serve_slo_us: int = 0
     # path PREFIX for per-rank trace dumps written at Finalize (one
     # ``<prefix>.rank<N>.trace.json`` per rank); consumed offline by
     # ``python -m tpu_mpi.analyze explore``. "" = no dump.
@@ -364,6 +385,10 @@ _ENV_MAP = {
     "fused_fold": "TPU_MPI_FUSED_FOLD",
     "trace": "TPU_MPI_TRACE",
     "trace_buffer": "TPU_MPI_TRACE_BUFFER",
+    "trace_sample": "TPU_MPI_TRACE_SAMPLE",
+    "flight_ring": "TPU_MPI_FLIGHT_RING",
+    "flight_dir": "TPU_MPI_FLIGHT_DIR",
+    "serve_slo_us": "TPU_MPI_SERVE_SLO_US",
     "trace_dump": "TPU_MPI_TRACE_DUMP",
     "tune_table": "TPU_MPI_TUNE_TABLE",
     "coll_algo": "TPU_MPI_COLL_ALGO",
@@ -514,6 +539,29 @@ def _coerce(name: str, default: Any, raw: Any) -> Any:
                        code=_ec.ERR_ARG) from None
 
 
+def _validate(cfg: Config) -> None:
+    """Range checks for knobs whose type coercion alone cannot catch a
+    value that would corrupt downstream state (histogram shapes, ring
+    sizes, sampling probabilities). Same loud-failure contract as
+    :func:`_coerce`: a bad knob raises ERR_ARG at load, never later."""
+    if not (0.0 <= cfg.trace_sample <= 1.0):
+        raise MPIError(
+            f"config key trace_sample={cfg.trace_sample!r} must be a "
+            f"probability in [0.0, 1.0]", code=_ec.ERR_ARG)
+    if cfg.flight_ring < 0:
+        raise MPIError(
+            f"config key flight_ring={cfg.flight_ring!r} must be >= 0 "
+            f"(0 disables the flight recorder)", code=_ec.ERR_ARG)
+    if cfg.pvars_hist_bins < 1:
+        raise MPIError(
+            f"config key pvars_hist_bins={cfg.pvars_hist_bins!r} must be "
+            f">= 1 (one log2-microsecond bucket minimum)", code=_ec.ERR_ARG)
+    if cfg.serve_slo_us < 0:
+        raise MPIError(
+            f"config key serve_slo_us={cfg.serve_slo_us!r} must be >= 0 "
+            f"(0 disables the fleet SLO)", code=_ec.ERR_ARG)
+
+
 # Bumped whenever the effective config is (re)computed; hot-path callers
 # (``_runtime.deadlock_timeout``) key their caches on it so a
 # ``load(refresh=True)`` invalidates them without taking the lock per call.
@@ -536,7 +584,9 @@ def load(refresh: bool = False) -> Config:
                 raw = file_vals[f.name]
             if raw is not None:
                 merged[f.name] = _coerce(f.name, getattr(cfg, f.name), raw)
-        _cached = cfg.replace(**merged)
+        effective = cfg.replace(**merged)
+        _validate(effective)          # raise BEFORE caching a bad config
+        _cached = effective
         return _cached
 
 

@@ -119,7 +119,14 @@ class ElasticController:
             hr = ss.get("slo_hit_rate")
             fin = ss.get("slo_hits", 0) + ss.get("slo_misses", 0)
             slo_bad = hr is not None and fin >= 4 and hr < 0.9
-        pressured = depth >= self.depth_high or busy_delta > 0 or slo_bad
+        # latency-derived grow signal (docs/observability.md "SLO
+        # burn-rate"): the worst tenant's measured miss fraction over its
+        # error budget — above 1.0 the tenant is burning budget even if the
+        # queue looks shallow (slow ranks, not deep queues)
+        slo_burn = b.ledger.max_burn_rate()
+        burn_bad = slo_burn is not None and slo_burn > 1.0
+        pressured = (depth >= self.depth_high or busy_delta > 0 or slo_bad
+                     or burn_bad)
         if pressured:
             self._pressure_ticks += 1
             self._idle_ticks = 0
@@ -133,6 +140,7 @@ class ElasticController:
             b.elastic_state["signals"] = {
                 "depth": depth, "busy_delta": busy_delta,
                 "ledger_slack_bytes": slack, "slo_bad": slo_bad,
+                "slo_burn": slo_burn,
                 "pressure_ticks": self._pressure_ticks,
                 "idle_ticks": self._idle_ticks}
         if (self._last_resize_mono
@@ -142,7 +150,8 @@ class ElasticController:
         if self._pressure_ticks >= self.hysteresis and cap < self.max_ranks:
             self.target = cap + 1
             self._pressure_ticks = 0
-            self.resize("queue pressure")
+            self.resize("slo burn" if burn_bad and depth < self.depth_high
+                        and busy_delta <= 0 else "queue pressure")
         elif (self.idle_ticks_limit
               and self._idle_ticks >= self.idle_ticks_limit
               and cap > self.min_ranks):

@@ -194,6 +194,15 @@ class MPIError(RuntimeError):
     def __init__(self, msg: str = "MPI error", code: "int | None" = None):
         super().__init__(msg)
         self.code = self.CODE if code is None else int(code)
+        # flight-recorder note (docs/observability.md): crash-grade codes
+        # auto-dump the ring. Lazy import — config imports this module, so
+        # flight (which imports config) can only be reached from here at
+        # call time; any failure must never mask the error being raised.
+        try:
+            from . import flight
+            flight.on_error(self)
+        except Exception:
+            pass
 
     def __str__(self) -> str:  # pretty-print like src/error.jl:21-23
         return f"{self.args[0]} (code {self.code})"

@@ -111,7 +111,7 @@ class StepPlan:
     """One continuous-batching step, identical on every rank: prefills
     then decodes (both rid-ordered), plus sessions to release."""
 
-    __slots__ = ("seq", "prefills", "decodes", "releases")
+    __slots__ = ("seq", "prefills", "decodes", "releases", "trace")
 
     def __init__(self, seq: int, prefills: List[Prefill],
                  decodes: List[Decode], releases: List[int]):
@@ -119,6 +119,9 @@ class StepPlan:
         self.prefills = sorted(prefills, key=lambda p: p.rid)
         self.decodes = sorted(decodes, key=lambda d: d.rid)
         self.releases = sorted(releases)
+        # request tracing: the context of one traced request in this batch
+        # (the step is shared, so its rank phase spans attribute to it)
+        self.trace = None
 
 
 def _gelu(x: np.ndarray) -> np.ndarray:
@@ -332,7 +335,12 @@ class InferEngine:
         def make(rank):
             def run(_r):
                 try:
-                    out = self._rank_step(rank, plan)
+                    if plan.trace is None:
+                        out = self._rank_step(rank, plan)
+                    else:
+                        from .. import tracectx as _tc
+                        with _tc.bind(plan.trace):
+                            out = self._rank_step(rank, plan)
                     if out:
                         with lock:
                             results.update(out)

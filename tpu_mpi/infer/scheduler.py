@@ -56,7 +56,7 @@ class InferRequest:
     __slots__ = ("rid", "tenant", "prompt", "max_new", "slot", "kv_need",
                  "tag", "slo_ms", "deadline", "submitted", "pos",
                  "generated", "out", "state", "pf_done", "pf_chunk",
-                 "draft", "spec_fed")
+                 "draft", "spec_fed", "trace")
 
     def __init__(self, rid: int, tenant: str, prompt: List[int],
                  max_new: int, slot: int, kv_need: int, slo_ms: int):
@@ -82,6 +82,7 @@ class InferRequest:
         self.spec_fed = 1
         self.out: "queue.Queue" = queue.Queue()
         self.state = "pending"
+        self.trace = None                 # TraceCtx of a sampled request
 
     def fail(self, exc: BaseException) -> None:
         if self.state in ("done", "failed"):
@@ -149,7 +150,7 @@ class InferScheduler:
 
     # -- intake --------------------------------------------------------------
     def submit(self, tenant: str, prompt: List[int],
-               max_new: int) -> InferRequest:
+               max_new: int, tctx=None) -> InferRequest:
         """Queue one generation request (validation is the broker's job);
         returns immediately — tokens stream through ``req.out``."""
         if self._dead is not None:
@@ -160,6 +161,7 @@ class InferScheduler:
         need = self.engine.kv_demand(len(prompt), max_new)
         req = InferRequest(rid, tenant, prompt, max_new, slot, need,
                            self.slo_ms)
+        req.trace = tctx
         with self._lock:
             self._pending.append(req)
         self._wake.set()
@@ -290,6 +292,8 @@ class InferScheduler:
             [Decode(r.rid, r.slot, self._draft_feed(r), r.pos)
              for r in decodes],
             [r.rid for r in releases])
+        plan.trace = next((r.trace for r in prefills + decodes
+                           if r.trace is not None), None)
         return plan, prefills, decodes, releases
 
     def pause(self, timeout: float = 30.0) -> bool:

@@ -41,6 +41,7 @@ import threading
 import time
 
 from . import config
+from . import tracectx as _tc
 from typing import Any, Dict, List, Optional, Tuple
 
 monotonic = time.monotonic
@@ -240,12 +241,13 @@ def _acct(comm: Any = None, cid: Optional[int] = None,
 # ---------------------------------------------------------------------------
 
 class _OpScope:
-    __slots__ = ("t0", "spans", "ev")
+    __slots__ = ("t0", "spans", "ev", "trace")
 
     def __init__(self):
         self.t0 = monotonic()
         self.spans: List[Tuple[str, float, float]] = []
         self.ev: Any = None           # the trace Event of this op, if any
+        self.trace: Any = None        # the request TraceCtx, when sampled
 
 
 def scope() -> Optional[_OpScope]:
@@ -261,6 +263,11 @@ def op_begin() -> Optional[_OpScope]:
     if _tls.scope is not None:
         return None
     sc = _OpScope()
+    if _tc.enabled():
+        # request tracing: adopt the TraceCtx the serve-tier rank worker
+        # bound to this thread, so the op's phase spans become children of
+        # the request span (one tuple compare when sampling is off)
+        sc.trace = _tc.current()
     _tls.scope = sc
     return sc
 
@@ -287,6 +294,18 @@ def op_end(sc: _OpScope, comm: Any = None, coll: Optional[str] = None,
         ev.t_end = t1
         if sc.spans:
             ev.phases = list(sc.spans)
+    if sc.trace is not None:
+        # per-rank request span: the op bracket parents under the request
+        # context, and each measured phase nests under the op span
+        from ._runtime import current_env
+        env = current_env()
+        who = f"rank {env[1]}" if env is not None else "rank ?"
+        rec = _tc.emit_span(sc.trace, coll or "op", who, sc.t0, t1,
+                            algo=algo, nbytes=nbytes)
+        if rec is not None and sc.spans:
+            pctx = _tc.TraceCtx(rec["trace"], rec["span"], True)
+            for name, s0, s1 in sc.spans:
+                _tc.emit_span(pctx, name, who, s0, s1)
     if not enabled() or coll is None:
         return
     acct = _acct(comm)

@@ -55,7 +55,9 @@ import numpy as np
 
 from .. import config
 from .. import error as _ec
+from .. import flight as _flight
 from .. import locksmith
+from .. import tracectx as _tc
 from ..analyze import events as _ev
 from ..error import MPIError, PoolDegradedError, ProcFailedError, SessionError
 from .._runtime import CidNamespace, SpmdContext, set_current_tenant, set_env
@@ -85,7 +87,8 @@ class PoolOp:
     rank workers. ``done`` fires once every member rank finished."""
 
     __slots__ = ("oid", "tenant", "kind", "cid", "parts", "reduce",
-                 "root", "nbytes", "done", "results", "error")
+                 "root", "nbytes", "done", "results", "error",
+                 "trace", "t_submit")
 
     def __init__(self, oid: int, tenant: str, kind: str, cid: int,
                  parts: List[np.ndarray], reduce: str, root: int):
@@ -100,6 +103,12 @@ class PoolOp:
         self.done = threading.Event()
         self.results: Optional[list] = None
         self.error: Optional[BaseException] = None
+        # request tracing (tpu_mpi.tracectx): the sampled request's context,
+        # bound to the rank-worker TLS while the op executes so pvar
+        # op-scopes emit their phase spans under it; t_submit brackets the
+        # fair-queue wait span reconstructed at pop time.
+        self.trace: Optional[_tc.TraceCtx] = None
+        self.t_submit: Optional[float] = None
 
 
 class _ThreadPool:
@@ -389,7 +398,13 @@ class _ThreadPool:
         def make(i):
             def run(rank):
                 try:
-                    results[i] = self._execute(op, comm, i, rank)
+                    if op.trace is None:
+                        results[i] = self._execute(op, comm, i, rank)
+                    else:
+                        # bind the request's trace to this rank worker so
+                        # the pvar op-scope emits its phase spans under it
+                        with _tc.bind(op.trace):
+                            results[i] = self._execute(op, comm, i, rank)
                 except BaseException as e:      # noqa: BLE001 - sent as ERROR
                     op.error = e
                 finally:
@@ -1264,6 +1279,17 @@ class Broker:
             _ev.record_serve(self.pool.ctx, "dispatch", cid=op.cid,
                              tenant=op.tenant, kind=op.kind, oid=op.oid,
                              nbytes=op.nbytes)
+            if _flight.enabled():
+                # the crash dump must NAME the in-flight op: when a rank
+                # dies mid-collective this is the last dispatch in the ring
+                _flight.note("op_dispatch", tenant=op.tenant, op=op.kind,
+                             oid=op.oid, cid=op.cid, nbytes=op.nbytes)
+            if op.trace is not None and op.t_submit is not None:
+                # the fair-queue wait, reconstructed at pop time: DRR decided
+                # when this op's tenant got its turn
+                _tc.emit_span(op.trace, "queue", "broker", op.t_submit,
+                              time.monotonic(), tenant=op.tenant,
+                              kind=op.kind, oid=op.oid)
             if op.kind == "generate":
                 # DRR decided its admission slot; the scheduler batches it
                 # from here — the fq slot frees immediately so a streaming
@@ -1332,6 +1358,7 @@ class Broker:
                                "(TPU_MPI_SESSION_TOKEN mismatch)")
 
     def attach_tenant(self, conn, meta: dict) -> Lease:
+        t0_span = time.monotonic()
         self._check_token(meta.get("token"))
         # a resize holds the gate while the rank map is in flux: attaches
         # queue here and land on the post-resize pool (tests drive this)
@@ -1369,6 +1396,10 @@ class Broker:
         self.ledger.open_tenant(tenant)
         _ev.record_serve(self.pool.ctx, "lease", cid=root_cid, tenant=tenant,
                          base=ns.base, limit=ns.limit)
+        ctx = _tc.TraceCtx.from_meta(meta)
+        if ctx is not None and ctx.sampled:
+            _tc.emit_span(ctx, "broker:attach", "broker", t0_span,
+                          time.monotonic(), tenant=tenant)
         return lease
 
     def revoke_lease(self, lease: Lease, reason: str, *,
@@ -1401,6 +1432,12 @@ class Broker:
         _ev.record_serve(self.pool.ctx, "lease_revoke", tenant=lease.tenant,
                          reason=reason, base=lease.ns.base,
                          limit=lease.ns.limit)
+        if _flight.enabled():
+            _flight.note("lease_revoke", tenant=lease.tenant, reason=reason)
+            if reason != "client detached":
+                # involuntary revocation: snapshot the ring so whoever
+                # debugs the eviction sees the seconds leading up to it
+                _flight.auto_dump("lease-revoke")
         if close_conn:
             try:
                 lease.conn.close()
@@ -1419,6 +1456,21 @@ class Broker:
             try:
                 self._check_token(meta.get("token"))
                 protocol.send_frame(conn, protocol.STATS, self.stats())
+            except MPIError as e:
+                protocol.send_frame(conn, protocol.ERROR,
+                                    protocol.error_meta(e))
+            finally:
+                conn.close()
+            return
+        if kind == protocol.METRICS:
+            # lease-less Prometheus scrape: the text exposition of the same
+            # snapshot STATS returns (docs/observability.md "Live export")
+            try:
+                self._check_token(meta.get("token"))
+                from .. import stats as _stats
+                protocol.send_frame(conn, protocol.METRICS,
+                                    {"text": _stats.to_prometheus(
+                                        self.stats())})
             except MPIError as e:
                 protocol.send_frame(conn, protocol.ERROR,
                                     protocol.error_meta(e))
@@ -1465,6 +1517,13 @@ class Broker:
                     with lease.send_lock:
                         protocol.send_frame(conn, protocol.STATS, self.stats())
                     continue
+                if kind == protocol.METRICS:
+                    from .. import stats as _stats
+                    text = _stats.to_prometheus(self.stats())
+                    with lease.send_lock:
+                        protocol.send_frame(conn, protocol.METRICS,
+                                            {"text": text})
+                    continue
                 if kind != protocol.OP:
                     raise SessionError(
                         f"unexpected {protocol.KIND_NAMES.get(kind, kind)} "
@@ -1499,6 +1558,29 @@ class Broker:
                                 reply_arrays)
 
     def _admit_and_run(self, lease: Lease, meta: dict, arrays: list):
+        """Traced wrapper: open the broker's span for a sampled request
+        (everything downstream — queue wait, per-rank phases — nests under
+        it), run admission + execution, and close it ok/error. An untraced
+        request pays one dict lookup."""
+        ctx = _tc.TraceCtx.from_meta(meta)
+        if ctx is None:
+            return self._admitted(lease, meta, arrays, None)
+        rec = _tc.start_span(ctx, f"broker:{meta.get('op')}", "broker",
+                             tenant=lease.tenant)
+        try:
+            reply_meta, reply_arrays = self._admitted(
+                lease, meta, arrays, _tc.child_for_span(rec, ctx))
+        except BaseException as e:
+            _tc.end_span(rec, status="error", error=type(e).__name__)
+            raise
+        _tc.end_span(rec)
+        # RESULT frames echo the context so a client (or mid-path proxy)
+        # can stitch replies to requests without a side table
+        reply_meta["trace"] = ctx.to_meta()
+        return reply_meta, reply_arrays
+
+    def _admitted(self, lease: Lease, meta: dict, arrays: list,
+                  tctx: Optional[_tc.TraceCtx]):
         opname = meta.get("op")
         cid = int(meta.get("cid", lease.root_cid))
         if cid not in lease.comms:
@@ -1534,10 +1616,12 @@ class Broker:
                     [np.asarray(a) for a in arrays],
                     str(meta.get("reduce", "sum")),
                     int(meta.get("root", 0)))
+        op.trace = tctx
         if opname in ("allreduce", "bcast"):
             # admission book is the quota authority; breach = typed reject
             self.ledger.charge(lease.tenant, op.nbytes)
         try:
+            op.t_submit = time.monotonic()
             self.fq.submit(op)
         except MPIError as e:
             if getattr(e, "retriable", False):
@@ -1565,9 +1649,14 @@ class Broker:
         queue) then repeated RESULT frames ``{"stream": True, "tokens":
         [...], "done": bool}`` as the scheduler emits tokens. Typed errors
         (SLO eviction, revocation) arrive as a terminal ERROR frame."""
+        ctx = _tc.TraceCtx.from_meta(meta)
+        rec = _tc.start_span(ctx, "broker:generate", "broker",
+                             tenant=lease.tenant)
         try:
-            req = self._admit_generate(lease, meta, arrays)
+            req = self._admit_generate(lease, meta, arrays,
+                                       tctx=_tc.child_for_span(rec, ctx))
         except MPIError as e:
+            _tc.end_span(rec, status="error", error=type(e).__name__)
             with lease.send_lock:
                 protocol.send_frame(lease.conn, protocol.ERROR,
                                     protocol.error_meta(e))
@@ -1586,19 +1675,26 @@ class Broker:
                          "done": False,
                          "tokens": [int(t) for t in payload]})
             elif kind == "done":
+                _tc.end_span(rec, rid=req.rid)
+                done_meta = {"op": "generate", "rid": req.rid,
+                             "stream": True, "done": True, "tokens": [],
+                             **payload}
+                if ctx is not None and ctx.sampled:
+                    done_meta["trace"] = ctx.to_meta()
                 with lease.send_lock:
-                    protocol.send_frame(
-                        lease.conn, protocol.RESULT,
-                        {"op": "generate", "rid": req.rid, "stream": True,
-                         "done": True, "tokens": [], **payload})
+                    protocol.send_frame(lease.conn, protocol.RESULT,
+                                        done_meta)
                 return
             else:
+                _tc.end_span(rec, status="error",
+                             error=type(payload).__name__)
                 with lease.send_lock:
                     protocol.send_frame(lease.conn, protocol.ERROR,
                                         protocol.error_meta(payload))
                 return
 
-    def _admit_generate(self, lease: Lease, meta: dict, arrays: list):
+    def _admit_generate(self, lease: Lease, meta: dict, arrays: list,
+                        tctx: Optional[_tc.TraceCtx] = None):
         if self._infer_sched is None:
             raise MPIError(
                 "this broker has no inference engine (start it with "
@@ -1636,7 +1732,9 @@ class Broker:
         op = PoolOp(next(self._oid), lease.tenant, "generate",
                     lease.root_cid, [], "sum", 0)
         op.nbytes = nbytes
+        op.trace = tctx
         try:
+            op.t_submit = time.monotonic()
             self.fq.submit(op)
         except MPIError as e:
             if getattr(e, "retriable", False):
@@ -1648,7 +1746,8 @@ class Broker:
         if op.error is not None:
             raise op.error
         return self._infer_sched.submit(lease.tenant,
-                                        [int(t) for t in prompt], max_new)
+                                        [int(t) for t in prompt], max_new,
+                                        tctx=tctx)
 
     def _validate_arrays(self, lease: Lease, opname: str, arrays: list,
                          meta: dict) -> None:
@@ -1781,6 +1880,19 @@ def _stats_client(address: str, token: str) -> dict:
         sock.close()
 
 
+def _metrics_client(address: str, token: str) -> str:
+    """One Prometheus scrape: the broker's METRICS frame text."""
+    sock = protocol.connect(address)
+    try:
+        protocol.send_frame(sock, protocol.METRICS, {"token": token})
+        kind, meta, _ = protocol.recv_frame(sock)
+        if kind == protocol.ERROR:
+            protocol.raise_for_error(meta)
+        return str(meta.get("text", ""))
+    finally:
+        sock.close()
+
+
 def main(argv: Optional[list] = None) -> int:
     """``tpurun --serve [--socket SPEC] [--nranks N] [--stats]``."""
     import argparse
@@ -1831,6 +1943,15 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--stats", action="store_true",
                    help="report per-tenant usage of a running broker and "
                         "exit")
+    p.add_argument("--watch", action="store_true",
+                   help="with --stats: keep polling and stream interval "
+                        "deltas/rates (unreachable brokers render an "
+                        "error row, the stream continues)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="--watch poll interval in seconds (default 2)")
+    p.add_argument("--metrics", action="store_true",
+                   help="with --stats: print the Prometheus text "
+                        "exposition (the METRICS frame) instead of JSON")
     args = p.parse_args(argv)
 
     cfg = config.load()
@@ -1845,6 +1966,23 @@ def main(argv: Optional[list] = None) -> int:
             p.error("--stats needs --socket/--brokers or "
                     "TPU_MPI_SERVE_SOCKET/TPU_MPI_SERVE_BROKERS")
         token = cfg.session_token if args.token is None else args.token
+        if args.metrics:
+            for s in sockets:
+                sys.stdout.write(_metrics_client(s, token))
+            return 0
+        if args.watch:
+            from .. import stats as _stats
+
+            def poll() -> list:
+                out = []
+                for s in sockets:
+                    try:
+                        out.append(_stats_client(s, token))
+                    except Exception as e:  # noqa: BLE001 - rendered as row
+                        out.append({"address": s, "error": str(e)})
+                return out
+
+            return _stats.watch_fleet(poll, interval=args.interval)
         reports = [_stats_client(s, token) for s in sockets]
         if len(reports) == 1:
             print(json.dumps(reports[0], indent=2, default=str))
@@ -1881,6 +2019,7 @@ def main(argv: Optional[list] = None) -> int:
                     infer=True if args.infer else None,
                     elastic=True if args.elastic else None,
                     backend=args.backend, shard=args.shard)
+    _flight.install_signal_hook()         # SIGTERM dumps the flight ring
     broker.start()
     print(f"tpu_mpi serve: broker up — pool={args.nranks} ranks "
           f"({broker.pool.kind}), socket={broker.address}, "

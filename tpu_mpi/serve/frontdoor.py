@@ -677,6 +677,13 @@ class FrontDoor:
                     protocol.send_frame(conn.proxy, protocol.STATS,
                                         broker.stats())
                 return False
+            if kind == protocol.METRICS:
+                from .. import stats as _stats
+                text = _stats.to_prometheus(broker.stats())
+                with lease.send_lock:
+                    protocol.send_frame(conn.proxy, protocol.METRICS,
+                                        {"text": text})
+                return False
             if kind != protocol.OP:
                 raise SessionError(
                     f"unexpected {protocol.KIND_NAMES.get(kind, kind)} "
@@ -709,6 +716,20 @@ class FrontDoor:
                 broker._check_token(meta.get("token"))
                 protocol.send_frame(conn.proxy, protocol.STATS,
                                     broker.stats())
+            except MPIError as e:
+                protocol.send_frame(conn.proxy, protocol.ERROR,
+                                    protocol.error_meta(e))
+            self._close_conn(conn)
+            return
+        if kind == protocol.METRICS:
+            # lease-less Prometheus scrape off the event loop — a fleet
+            # scraper needs no session and costs no listener thread
+            try:
+                broker._check_token(meta.get("token"))
+                from .. import stats as _stats
+                protocol.send_frame(conn.proxy, protocol.METRICS,
+                                    {"text": _stats.to_prometheus(
+                                        broker.stats())})
             except MPIError as e:
                 protocol.send_frame(conn.proxy, protocol.ERROR,
                                     protocol.error_meta(e))

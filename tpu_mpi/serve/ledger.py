@@ -89,6 +89,67 @@ class Ledger:
         self._tenants: Dict[str, dict] = {}
         self._flushes = 0
         self._last_flush: Optional[float] = None
+        # per-tenant latency objectives (docs/observability.md "SLO burn"):
+        # {"target_us": ..., "budget": tolerated miss fraction}
+        self._objectives: Dict[str, dict] = {}
+
+    # -- SLO objectives (latency burn-rate) -----------------------------------
+    def set_objective(self, tenant: str, target_us: int,
+                      budget: float = 0.01) -> None:
+        """Give ``tenant`` a latency objective: at most ``budget`` of its
+        ops may take ``target_us`` or longer. The burn rate reported per
+        flush is observed-miss-fraction / budget — above 1.0 the tenant is
+        spending error budget faster than the objective allows, and the
+        elastic controller treats it as grow pressure."""
+        target_us, budget = int(target_us), float(budget)
+        if target_us <= 0 or not 0.0 < budget <= 1.0:
+            raise MPIError(
+                f"SLO objective target_us={target_us} budget={budget} "
+                f"invalid (need target_us > 0 and 0 < budget <= 1)",
+                code=_ec.ERR_ARG)
+        with self._lock:
+            self._objectives[tenant] = {"target_us": target_us,
+                                        "budget": budget}
+
+    @staticmethod
+    def _default_objective() -> Optional[dict]:
+        """The fleet-wide objective TPU_MPI_SERVE_SLO_US applies to every
+        tenant without an explicit one (0 = no objective)."""
+        from .. import config as _cfg
+        us = int(getattr(_cfg.load(), "serve_slo_us", 0))
+        return {"target_us": us, "budget": 0.01} if us > 0 else None
+
+    @staticmethod
+    def _slo_row(hist, obj: dict) -> dict:
+        """Fold one tenant's merged log2-µs latency histogram against its
+        objective. Bucket ``i`` covers [2^(i-1), 2^i) µs (bucket 0 is
+        [0, 1)); a bucket whose lower edge clears the target counts as
+        missed in full — the conservative reading of a histogram."""
+        total = sum(hist)
+        miss = sum(c for i, c in enumerate(hist)
+                   if (0 if i == 0 else 1 << (i - 1)) >= obj["target_us"])
+        frac = (miss / total) if total else 0.0
+        return {"target_us": obj["target_us"], "budget": obj["budget"],
+                "ops": int(total), "misses": int(miss),
+                "miss_frac": round(frac, 6),
+                "burn": round(frac / obj["budget"], 4)}
+
+    def max_burn_rate(self) -> Optional[float]:
+        """The worst per-tenant SLO burn over the last measured flush —
+        the elastic controller's latency-derived grow signal. None when no
+        tenant has an objective (or none has measured latency yet)."""
+        default = self._default_objective()
+        worst: Optional[float] = None
+        with self._lock:
+            for t, e in self._tenants.items():
+                obj = self._objectives.get(t) or default
+                hist = e.get("lat_hist")
+                if obj is None or not hist:
+                    continue
+                burn = self._slo_row(hist, obj)["burn"]
+                if worst is None or burn > worst:
+                    worst = burn
+        return worst
 
     def _entry(self, tenant: str) -> dict:
         e = self._tenants.get(tenant)
@@ -152,25 +213,34 @@ class Ledger:
         the owning tenant (None -> pool). Returns the pool-total row; the
         invariant ``sum(tenant rows) == pool totals`` holds by
         construction because every comm record lands in exactly one row."""
-        books, totals = self._attribute(snapshot, owner_of_cid)
+        books, hists, totals = self._attribute(snapshot, owner_of_cid)
         with self._lock:
             for t in self._tenants:
                 self._tenants[t]["measured"] = books.pop(t, {})
+                self._tenants[t]["lat_hist"] = hists.get(t) or []
             for t, row in books.items():
-                self._entry(t)["measured"] = row
+                e = self._entry(t)
+                e["measured"] = row
+                e["lat_hist"] = hists.get(t) or []
             self._flushes += 1
             self._last_flush = time.time()
         return totals
 
     # -- reporting -----------------------------------------------------------
     def report(self) -> dict:
+        default_obj = self._default_objective()   # config read OUTSIDE the lock
         with self._lock:
-            return self._report_locked()
+            return self._report_locked(default_obj)
 
-    def _report_locked(self) -> dict:
+    def _report_locked(self, default_obj: Optional[dict] = None) -> dict:
         tenants = {}
         for t, e in self._tenants.items():
-            tenants[t] = {k: v for k, v in e.items()}
+            row = {k: v for k, v in e.items()}
+            obj = self._objectives.get(t) or default_obj
+            hist = e.get("lat_hist")
+            if obj is not None and hist:
+                row["slo"] = self._slo_row(hist, obj)
+            tenants[t] = row
         return {"quota_bytes": self.quota_bytes, "tenants": tenants,
                 "flushes": self._flushes, "last_flush": self._last_flush}
 
@@ -181,15 +251,19 @@ class Ledger:
         STATS fast path (a 1k-tenant fleet polling stats must not take the
         ledger lock three times per request; ISSUE 15 satellite).
         Returns ``(pool_totals, report)``."""
-        books, totals = self._attribute(snapshot, owner_of_cid)
+        books, hists, totals = self._attribute(snapshot, owner_of_cid)
+        default_obj = self._default_objective()   # config read OUTSIDE the lock
         with self._lock:
             for t in self._tenants:
                 self._tenants[t]["measured"] = books.pop(t, {})
+                self._tenants[t]["lat_hist"] = hists.get(t) or []
             for t, row in books.items():
-                self._entry(t)["measured"] = row
+                e = self._entry(t)
+                e["measured"] = row
+                e["lat_hist"] = hists.get(t) or []
             self._flushes += 1
             self._last_flush = time.time()
-            return totals, self._report_locked()
+            return totals, self._report_locked(default_obj)
 
     @staticmethod
     def _attribute(snapshot: dict,
@@ -202,6 +276,7 @@ class Ledger:
         totals = {f: 0 for f in fields}
         totals["coll_ops"] = 0
         books: Dict[str, dict] = {}
+        hists: Dict[str, list] = {}
         for rec in snapshot.get("comms", ()):
             tenant = owner_of_cid(rec.get("cid")) or POOL_TENANT
             row = books.setdefault(tenant, {f: 0 for f in fields}
@@ -213,4 +288,15 @@ class Ledger:
             nops = sum(int(v) for v in (rec.get("ops") or {}).values())
             row["coll_ops"] += nops
             totals["coll_ops"] += nops
-        return books, totals
+            # merged log2-µs latency histogram (all collectives of this
+            # tenant's comms) — the SLO burn-rate input. Kept OUT of the
+            # measured row: that book is scalar counters whose tenant rows
+            # sum to the pool totals, and a list would break every
+            # consumer that folds it.
+            for buckets in (rec.get("hist") or {}).values():
+                h = hists.setdefault(tenant, [])
+                if len(h) < len(buckets):
+                    h.extend([0] * (len(buckets) - len(h)))
+                for i, c in enumerate(buckets):
+                    h[i] += int(c)
+        return books, hists, totals

@@ -55,10 +55,12 @@ BYE = 8
 PING = 9
 PONG = 10
 REDIRECT = 11      # router -> client: re-dial your home broker directly
+METRICS = 12       # both: Prometheus-text exposition request/reply
 
 KIND_NAMES = {HELLO: "HELLO", LEASE: "LEASE", OP: "OP", RESULT: "RESULT",
               ERROR: "ERROR", STATS: "STATS", DETACH: "DETACH", BYE: "BYE",
-              PING: "PING", PONG: "PONG", REDIRECT: "REDIRECT"}
+              PING: "PING", PONG: "PONG", REDIRECT: "REDIRECT",
+              METRICS: "METRICS"}
 
 _HDR = struct.Struct("!BIH")
 _BLOB = struct.Struct("!I")

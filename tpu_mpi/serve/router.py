@@ -38,6 +38,7 @@ from typing import Dict, List, Optional
 
 from .. import config
 from .. import locksmith
+from .. import tracectx as _tc
 from ..error import MPIError, SessionError
 from . import protocol
 
@@ -78,6 +79,12 @@ def merge_stats(reports: List[dict]) -> dict:
                                "flushes": 0, "last_flush": None},
                     "tenants_attached": []}
     for i, rep in enumerate(reports):
+        if rep.get("error"):
+            # an unreachable broker mid-poll: keep its {address, error} row
+            # in the fleet view instead of failing the whole merge
+            merged["brokers"].append({"address": rep.get("address"),
+                                      "error": str(rep.get("error"))})
+            continue
         merged["brokers"].append({
             "address": rep.get("address"), "backend": rep.get("backend"),
             "shard": rep.get("shard"), "pool": rep.get("pool"),
@@ -186,6 +193,9 @@ class Router:
             if kind == protocol.STATS:
                 self._handle_stats(conn, meta)
                 return
+            if kind == protocol.METRICS:
+                self._handle_metrics(conn, meta)
+                return
             if kind != protocol.HELLO:
                 protocol.send_frame(conn, protocol.ERROR, protocol.error_meta(
                     SessionError(f"router expects HELLO or STATS, got "
@@ -211,16 +221,37 @@ class Router:
                 reports.append({"address": b, "error": str(e)})
         protocol.send_frame(conn, protocol.STATS, merge_stats(reports))
 
+    def _handle_metrics(self, conn, meta: dict) -> None:
+        """Fleet Prometheus scrape: every broker's METRICS text, joined
+        (an unreachable broker becomes a comment line, not a failure)."""
+        from .broker import _metrics_client
+        token = meta.get("token")
+        parts = []
+        for b in self.brokers:
+            try:
+                parts.append(_metrics_client(b, token))
+            except (MPIError, OSError) as e:
+                parts.append(f"# {b} unreachable: {e}\n")
+        protocol.send_frame(conn, protocol.METRICS, {"text": "".join(parts)})
+
     def _handle_hello(self, conn, meta: dict, arrays: list) -> None:
         # the session key IS the tenant id; a keyless HELLO gets a router-
         # generated one so its home is stable for the connection's lifetime
         meta = dict(meta)
         tenant = meta.get("tenant") or f"rt{next(self._tenant_seq)}"
         meta["tenant"] = tenant
+        # request tracing: the HELLO's trace context passes through the hop
+        # untouched (redirect echoes it back, splice forwards it verbatim);
+        # the router contributes its own span for the routing decision
+        tctx = _tc.TraceCtx.from_meta(meta)
+        t0_span = time.monotonic()
         home = assign_broker(tenant, self.brokers)
         if self.mode == "redirect":
             protocol.send_frame(conn, protocol.REDIRECT,
                                 {"home": home, "tenant": tenant})
+            if tctx is not None and tctx.sampled:
+                _tc.emit_span(tctx, "router:redirect", "router", t0_span,
+                              time.monotonic(), tenant=tenant, home=home)
             return
         try:
             upstream = protocol.connect(home)
@@ -229,6 +260,9 @@ class Router:
                 SessionError(f"home broker {home} for tenant {tenant!r} "
                              f"unreachable: {e}")))
             return
+        if tctx is not None and tctx.sampled:
+            _tc.emit_span(tctx, "router:splice", "router", t0_span,
+                          time.monotonic(), tenant=tenant, home=home)
         with self._routes_lock:
             self.routes[tenant] = home
         try:

@@ -23,6 +23,7 @@ import numpy as np
 
 from .. import config
 from .. import locksmith
+from .. import tracectx as _tc
 from ..error import SessionError
 from . import protocol
 
@@ -45,10 +46,17 @@ class SessionComm:
 class ClientSession:
     """One tenant's attachment to a broker (use :func:`attach`)."""
 
-    def __init__(self, sock, lease_meta: dict, address: str):
+    def __init__(self, sock, lease_meta: dict, address: str,
+                 attach_trace: Optional[str] = None):
         self._sock = sock
         self._lock = locksmith.make_lock("session.rpc")   # one RPC in flight
         self.address = address
+        # the attach handshake's trace id: op root spans link to it so a
+        # viewer can hop from any request to the session's route (the
+        # router splice/redirect span lives in the ATTACH trace — a
+        # splicing router never parses op frames, so per-op router spans
+        # cannot exist by design)
+        self.attach_trace = attach_trace
         self.tenant: str = lease_meta["tenant"]
         self.ranks: List[int] = list(lease_meta["ranks"])
         self.cid_base: int = int(lease_meta["cid_base"])
@@ -80,7 +88,21 @@ class ClientSession:
         return rkind, rmeta, rarrays
 
     def _op(self, meta: dict, arrays=()) -> tuple:
-        _, rmeta, rarrays = self._rpc(protocol.OP, meta, arrays)
+        # trace birth (docs/observability.md "Request traces"): a sampled
+        # op mints the trace here and the root span brackets the whole
+        # client-observed RPC; every downstream hop parents under it
+        ctx, rec = _tc.start_root(f"client:{meta.get('op')}", "client",
+                                  tenant=self.tenant,
+                                  link=self.attach_trace)
+        if ctx is not None:
+            meta = dict(meta)
+            meta["trace"] = ctx.to_meta()
+        try:
+            _, rmeta, rarrays = self._rpc(protocol.OP, meta, arrays)
+        except BaseException as e:
+            _tc.end_span(rec, status="error", error=type(e).__name__)
+            raise
+        _tc.end_span(rec)
         return rmeta, rarrays
 
     def _cid(self, comm: Optional[SessionComm]) -> int:
@@ -120,34 +142,44 @@ class ClientSession:
         an SLO eviction raises the retriable
         :class:`~tpu_mpi.error.SLOExpiredError`."""
         arr = np.ascontiguousarray(np.asarray(prompt, dtype=np.int32))
-        with self._lock:
-            if self._closed:
-                raise SessionError("session is detached")
-            protocol.send_frame(self._sock, protocol.OP,
-                                {"op": "generate", "cid": self.comm.cid,
-                                 "max_new": int(max_new)}, [arr])
-            tokens: List[int] = []
-            while True:
-                try:
-                    rkind, rmeta, _ = protocol.recv_frame(self._sock)
-                except protocol.Disconnect as e:
-                    self._closed = True
-                    raise SessionError(
-                        f"broker at {self.address} hung up mid-stream: "
-                        f"{e}") from None
-                if rkind == protocol.ERROR:
-                    protocol.raise_for_error(rmeta)
-                if rkind != protocol.RESULT:
-                    raise SessionError(
-                        f"expected streamed RESULT, got "
-                        f"{protocol.KIND_NAMES.get(rkind, rkind)}")
-                new = [int(t) for t in rmeta.get("tokens", ())]
-                tokens.extend(new)
-                if on_token is not None:
-                    for t in new:
-                        on_token(t)
-                if rmeta.get("done"):
-                    return tokens
+        ctx, rec = _tc.start_root("client:generate", "client",
+                                  tenant=self.tenant,
+                                  link=self.attach_trace)
+        op_meta = {"op": "generate", "cid": self.comm.cid,
+                   "max_new": int(max_new)}
+        if ctx is not None:
+            op_meta["trace"] = ctx.to_meta()
+        try:
+            with self._lock:
+                if self._closed:
+                    raise SessionError("session is detached")
+                protocol.send_frame(self._sock, protocol.OP, op_meta, [arr])
+                tokens: List[int] = []
+                while True:
+                    try:
+                        rkind, rmeta, _ = protocol.recv_frame(self._sock)
+                    except protocol.Disconnect as e:
+                        self._closed = True
+                        raise SessionError(
+                            f"broker at {self.address} hung up mid-stream: "
+                            f"{e}") from None
+                    if rkind == protocol.ERROR:
+                        protocol.raise_for_error(rmeta)
+                    if rkind != protocol.RESULT:
+                        raise SessionError(
+                            f"expected streamed RESULT, got "
+                            f"{protocol.KIND_NAMES.get(rkind, rkind)}")
+                    new = [int(t) for t in rmeta.get("tokens", ())]
+                    tokens.extend(new)
+                    if on_token is not None:
+                        for t in new:
+                            on_token(t)
+                    if rmeta.get("done"):
+                        _tc.end_span(rec, tokens=len(tokens))
+                        return tokens
+        except BaseException as e:
+            _tc.end_span(rec, status="error", error=type(e).__name__)
+            raise
 
     # -- communicator management ---------------------------------------------
     def comm_dup(self, comm: Optional[SessionComm] = None) -> SessionComm:
@@ -230,6 +262,12 @@ def attach(address: Optional[str] = None, *, token: Optional[str] = None,
         hello["tenant"] = tenant
     if nranks is not None:
         hello["nranks"] = int(nranks)
+    # a sampled attach is traced too: ONE context for the whole handshake,
+    # kept across the REDIRECT hop so the redirected HELLO carries the
+    # same trace_id (the propagation edge tests pin this)
+    ctx, rec = _tc.start_root("client:attach", "client")
+    if ctx is not None:
+        hello["trace"] = ctx.to_meta()
     # one REDIRECT hop allowed: a router in redirect mode answers HELLO
     # with the tenant's home broker and the data path goes direct
     for _hop in range(2):
@@ -239,6 +277,7 @@ def attach(address: Optional[str] = None, *, token: Optional[str] = None,
             kind, meta, _ = protocol.recv_frame(sock)
         except protocol.Disconnect as e:
             sock.close()
+            _tc.end_span(rec, status="error", error="Disconnect")
             raise SessionError(f"broker at {address} hung up during attach: "
                                f"{e}") from None
         if kind == protocol.REDIRECT:
@@ -249,12 +288,17 @@ def attach(address: Optional[str] = None, *, token: Optional[str] = None,
             continue
         if kind == protocol.ERROR:
             sock.close()
+            _tc.end_span(rec, status="error", error="broker-error")
             protocol.raise_for_error(meta)
         if kind != protocol.LEASE:
             sock.close()
+            _tc.end_span(rec, status="error", error="bad-frame")
             raise SessionError(f"expected LEASE, got "
                                f"{protocol.KIND_NAMES.get(kind, kind)}")
-        return ClientSession(sock, meta, address)
+        _tc.end_span(rec, hops=_hop + 1)
+        return ClientSession(sock, meta, address,
+                             attach_trace=ctx.trace_id if ctx else None)
+    _tc.end_span(rec, status="error", error="redirect-loop")
     raise SessionError(f"attach followed a REDIRECT to {address} and was "
                        f"redirected again — router loop?")
 

@@ -9,14 +9,24 @@ plan-cache hit rate, and the chunk-pipeline overlap fraction.
 ``tpurun --stats <dir-or-files>`` reports existing dumps;
 ``tpurun --stats -- <launch args...>`` runs a launch with dumping enabled
 into a temp dir and reports it when the job exits (zero-setup profiling).
+
+The serve tier's live export reuses this module (docs/observability.md
+"Live export"): :func:`to_prometheus` flattens a broker STATS snapshot to
+the Prometheus text exposition the ``METRICS`` frame serves, and
+:func:`watch_fleet` drives ``tpurun --serve --stats --watch`` — interval
+deltas and rates over a polled broker fleet, tolerating unreachable
+brokers mid-stream.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
+import re
 import sys
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+import time as _time
+from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple)
 
 from . import perfvars
 from . import tune
@@ -102,7 +112,9 @@ def aggregate(records: Sequence[dict]) -> dict:
             ent["armed"] = ent["armed"] or bool(sig.get("armed"))
             ent["hit_rate"] = (round(ent["hits"] / ent["calls"], 4)
                                if ent["calls"] else None)
-        for comm in rec.get("comms", ()):
+        # partial record (a broker that died mid-STATS leaves {address,
+        # error}, or a truncated dump leaves comms: null) — skip, don't throw
+        for comm in rec.get("comms") or ():
             nranks.add(int(comm.get("size") or 0))
             for k in ("bytes_sent", "bytes_recv", "sends", "recvs", "wait_s"):
                 tot[k] += comm.get(k, 0)
@@ -378,6 +390,148 @@ def render(agg: dict, out=None) -> None:
         if g.get("pool_size"):
             w(f"  pool {g['pool_size']}/{g.get('target_size', '?')} ranks"
               + (" (DEGRADED)" if g.get("degraded") else "") + "\n")
+
+
+# -- Prometheus text exposition (serve METRICS frame) -------------------------
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_]")
+_LINE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+
+
+def _prom_label(v: str) -> str:
+    return v.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def to_prometheus(report: dict, prefix: str = "tpu_mpi") -> str:
+    """Flatten a broker STATS snapshot (any nested dict of counters) to
+    the Prometheus text exposition. Numeric leaves become
+    ``<prefix>_<path_joined_by_underscores>``; entries under a ``tenants``
+    dict become one series per tenant with a ``tenant`` label instead of a
+    name component. Strings, lists and None are skipped — the exposition
+    carries numbers, the JSON STATS frame carries everything."""
+    lines: List[str] = []
+
+    def emit(path: List[str], labels: Tuple[Tuple[str, str], ...],
+             value: float) -> None:
+        name = _NAME_OK.sub("_", "_".join([prefix] + path))
+        lab = ("{" + ",".join(f'{k}="{_prom_label(v)}"' for k, v in labels)
+               + "}") if labels else ""
+        if isinstance(value, float) and not math.isfinite(value):
+            return                        # NaN/inf: not a scrapeable sample
+        lines.append(f"{name}{lab} {value}")
+
+    def walk(path: List[str], val: Any,
+             labels: Tuple[Tuple[str, str], ...]) -> None:
+        if isinstance(val, bool):
+            emit(path, labels, int(val))
+        elif isinstance(val, (int, float)):
+            emit(path, labels, val)
+        elif isinstance(val, dict):
+            if path and path[-1] == "tenants":
+                for t in sorted(val):
+                    walk(path[:-1] + ["tenant"], val[t],
+                         labels + (("tenant", str(t)),))
+            else:
+                for k in sorted(val, key=str):
+                    walk(path + [str(k)], val[k], labels)
+        # strings / lists / None: intentionally not exported
+
+    walk([], report, ())
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Parse a text exposition back into ``{series: value}`` (series =
+    metric name plus its literal label block). Malformed lines raise
+    ``ValueError`` — the CI round-trip gate wants loud, not lossy."""
+    out: Dict[str, float] = {}
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln or ln.startswith("#"):
+            continue
+        m = _LINE.match(ln)
+        if m is None:
+            raise ValueError(f"unparseable exposition line: {ln!r}")
+        out[m.group(1) + (m.group(2) or "")] = float(m.group(3))
+    return out
+
+
+# -- fleet watch (tpurun --serve --stats --watch) -----------------------------
+
+def _watch_counters(rep: dict) -> Dict[str, float]:
+    q = rep.get("queue") or {}
+    tot = rep.get("totals") or {}
+    return {"dispatched": float(q.get("dispatched", 0) or 0),
+            "rejected_busy": float(q.get("rejected_busy", 0) or 0),
+            "bytes_sent": float(tot.get("bytes_sent", 0) or 0)}
+
+
+def render_watch(records: Sequence[dict], prev: Dict[str, dict],
+                 dt: float, out=None) -> None:
+    """One watch frame: per broker, counter deltas/rates since the last
+    poll; unreachable brokers render their ``{address, error}`` row and
+    the stream keeps going (satellite: partial fleets stay watchable)."""
+    out = out or sys.stdout
+    w = out.write
+    stamp = _time.strftime("%H:%M:%S")
+    for rep in records:
+        addr = str(rep.get("address"))
+        if rep.get("error"):
+            w(f"{stamp} {addr}: ERROR {rep['error']}\n")
+            continue
+        cur = _watch_counters(rep)
+        base = prev.get(addr)
+        if base is None:
+            d = {k: 0.0 for k in cur}
+        else:
+            d = {k: cur[k] - base.get(k, 0.0) for k in cur}
+        rate = (d["dispatched"] / dt) if dt > 0 else 0.0
+        q = rep.get("queue") or {}
+        depth = sum(int(t.get("queued", 0) or 0)
+                    for t in (q.get("tenants") or {}).values())
+        tenants = rep.get("tenants_attached") or []
+        w(f"{stamp} {addr}  ops {int(cur['dispatched'])} "
+          f"(+{int(d['dispatched'])}, {rate:.1f}/s)  "
+          f"sent {_fmt_bytes(cur['bytes_sent'])} "
+          f"(+{_fmt_bytes(max(0.0, d['bytes_sent']))})  "
+          f"busy-rej +{int(d['rejected_busy'])}  depth {depth}  "
+          f"tenants {len(tenants)}\n")
+        for t, row in sorted(((rep.get("ledger") or {}).get("tenants")
+                              or {}).items()):
+            slo = (row or {}).get("slo")
+            if not slo:
+                continue
+            w(f"         slo {t}: burn {slo['burn']:.2f} "
+              f"(miss {slo['miss_frac']:.2%} of budget "
+              f"{slo['budget']:.0%}, target {slo['target_us']}us, "
+              f"{slo['ops']} ops)\n")
+
+
+def watch_fleet(poll: Callable[[], List[dict]], interval: float = 2.0,
+                iterations: Optional[int] = None, out=None,
+                sleep: Callable[[float], None] = _time.sleep) -> int:
+    """Poll ``poll()`` (a list of per-broker STATS records, each either a
+    report or ``{"address", "error"}``) every ``interval`` seconds and
+    stream delta frames until interrupted (or ``iterations`` polls, for
+    tests). The loop survives any single broker going unreachable."""
+    prev: Dict[str, Dict[str, float]] = {}
+    last = _time.monotonic()
+    n = 0
+    while iterations is None or n < iterations:
+        records = poll()
+        now = _time.monotonic()
+        render_watch(records, prev, dt=max(now - last, 1e-9), out=out)
+        last = now
+        prev = {str(r.get("address")): _watch_counters(r)
+                for r in records if not r.get("error")}
+        n += 1
+        if iterations is not None and n >= iterations:
+            break
+        try:
+            sleep(interval)
+        except KeyboardInterrupt:
+            break
+    return 0
 
 
 def _launch_and_collect(launch_args: List[str]) -> List[dict]:
